@@ -1,0 +1,23 @@
+"""perceiver_io_tpu — a TPU-native (JAX/Flax/XLA/Pallas) Perceiver framework.
+
+Implements the full capability surface of Perceiver (arXiv:2103.03206),
+Perceiver IO (arXiv:2107.14795) and Perceiver AR (arXiv:2202.07765) —
+feature parity target is krasserm/perceiver-io v0.11.1 — redesigned
+TPU-first: static shapes throughout, fixed-capacity KV caches, SPMD
+parallelism over `jax.sharding.Mesh`, and Pallas attention kernels for
+the hot ops.
+
+Layer map (mirrors the reference's four stacked layers, re-drawn for JAX):
+
+  L5  CLI       perceiver_io_tpu.scripts      auto-CLI over config dataclasses
+  L4  Training  perceiver_io_tpu.training     jitted train_step, optax, orbax
+  L3  Tasks     perceiver_io_tpu.models       text / vision / audio task models
+  L2  Core      perceiver_io_tpu.core         attention, encoder/decoder, AR
+  L1  Data      perceiver_io_tpu.data         host-side iterators feeding JAX
+  ops           perceiver_io_tpu.ops          Pallas kernels
+  parallel      perceiver_io_tpu.parallel     mesh / sharding rules
+"""
+
+__version__ = "0.1.0"
+
+from perceiver_io_tpu.core import config as config  # noqa: F401

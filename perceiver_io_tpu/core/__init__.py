@@ -1,0 +1,36 @@
+from perceiver_io_tpu.core.adapter import (
+    ClassificationOutputAdapter,
+    TiedTokenOutputAdapter,
+    TokenInputAdapter,
+    TokenInputAdapterWithRotarySupport,
+    TokenOutputAdapter,
+    TrainableQueryProvider,
+)
+from perceiver_io_tpu.core.attention import KVCache, MultiHeadAttention, init_kv_cache
+from perceiver_io_tpu.core.config import (
+    CausalSequenceModelConfig,
+    ClassificationDecoderConfig,
+    DecoderConfig,
+    EncoderConfig,
+    PerceiverARConfig,
+    PerceiverIOConfig,
+)
+from perceiver_io_tpu.core.modules import (
+    MLP,
+    CausalSequenceModel,
+    CrossAttention,
+    CrossAttentionLayer,
+    PerceiverAR,
+    PerceiverDecoder,
+    PerceiverEncoder,
+    PerceiverIO,
+    SelfAttention,
+    SelfAttentionBlock,
+    SelfAttentionLayer,
+)
+from perceiver_io_tpu.core.position import (
+    FourierPositionEncoding,
+    RotaryPositionEmbedding,
+    frequency_position_encoding,
+    positions,
+)

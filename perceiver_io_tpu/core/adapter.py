@@ -1,0 +1,165 @@
+"""Input/output adapters and query providers — the modality extension seam.
+
+Behavioral parity with the reference adapters
+(reference: perceiver/model/core/adapter.py:8-151). A new modality plugs in
+one input adapter, one output adapter and one query provider; everything else
+is generic (demonstrated by the reference's root-level time-series app).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from perceiver_io_tpu.core.position import frequency_position_encoding, positions
+
+
+class TrainableQueryProvider(nn.Module):
+    """Learnable cross-attention query array: the latent array in Perceiver IO
+    encoders and the output query in most decoders
+    (reference: adapter.py:63-83)."""
+
+    num_queries: int
+    num_query_channels: int
+    init_scale: float = 0.02
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x=None) -> jnp.ndarray:
+        query = self.param(
+            "query",
+            nn.initializers.normal(stddev=self.init_scale),
+            (self.num_queries, self.num_query_channels),
+        )
+        return query.astype(self.dtype)[None, ...]
+
+
+class TokenInputAdapter(nn.Module):
+    """Token embedding + (optional) learned absolute position embedding.
+
+    When the input is shorter than the provided absolute positions the
+    right-most position codes are used (reference: adapter.py:105-114 —
+    sliding-window decoding).
+    """
+
+    vocab_size: int
+    max_seq_len: int
+    num_input_channels: int
+    abs_pos_emb: bool = True
+    init_scale: float = 0.02
+    dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        self.txt_embedding = nn.Embed(
+            self.vocab_size,
+            self.num_input_channels,
+            embedding_init=nn.initializers.normal(stddev=self.init_scale),
+            dtype=self.dtype,
+            name="txt_embedding",
+        )
+        if self.abs_pos_emb:
+            self.pos_embedding = nn.Embed(
+                self.max_seq_len,
+                self.num_input_channels,
+                embedding_init=nn.initializers.normal(stddev=self.init_scale),
+                dtype=self.dtype,
+                name="pos_embedding",
+            )
+
+    def embed(self, x: jnp.ndarray, abs_pos: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+        if not self.abs_pos_emb:
+            return self.txt_embedding(x)
+        if abs_pos is None:
+            abs_pos = positions(x.shape[0], x.shape[1])
+        elif x.shape[1] < abs_pos.shape[1]:
+            abs_pos = abs_pos[:, -x.shape[1] :]
+        abs_pos = jnp.clip(abs_pos, 0, self.max_seq_len - 1)
+        return self.txt_embedding(x) + self.pos_embedding(abs_pos)
+
+    def __call__(self, x: jnp.ndarray, abs_pos: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+        return self.embed(x, abs_pos)
+
+    def attend(self, x: jnp.ndarray) -> jnp.ndarray:
+        """Logits against the tied token embedding (x @ E^T)."""
+        return self.txt_embedding.attend(x)
+
+
+class TokenInputAdapterWithRotarySupport(TokenInputAdapter):
+    """Token adapter that additionally emits the rotary frequency position
+    encoding for its absolute positions (reference: adapter.py:22-32,117-135).
+
+    Returns ``(embedded, frq_pos_enc)`` where ``frq_pos_enc`` has
+    ``rotated_channels_per_head`` channels. Unlike the reference, the
+    frequency encoding follows the *full* ``abs_pos`` even when ``x`` is
+    shorter (cached decoding) — callers slice per-query rows by value.
+    """
+
+    rotated_channels_per_head: int = 0
+
+    def __call__(self, x: jnp.ndarray, abs_pos: Optional[jnp.ndarray] = None):
+        if abs_pos is None:
+            abs_pos = positions(x.shape[0], x.shape[1])
+        embedded = self.embed(x, abs_pos)
+        frq = frequency_position_encoding(abs_pos, self.rotated_channels_per_head)
+        return embedded, frq
+
+
+class ClassificationOutputAdapter(nn.Module):
+    """Linear head over decoder output; squeezes a single output query
+    (reference: adapter.py:39-49)."""
+
+    num_classes: int
+    num_output_query_channels: int
+    init_scale: float = 0.02
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = nn.Dense(
+            self.num_classes,
+            kernel_init=nn.initializers.normal(stddev=self.init_scale),
+            dtype=self.dtype,
+            name="linear",
+        )(x)
+        if x.shape[1] == 1:
+            x = jnp.squeeze(x, axis=1)
+        return x
+
+
+class TokenOutputAdapter(nn.Module):
+    """Independent (untied) linear head to vocab logits."""
+
+    vocab_size: int
+    num_output_query_channels: int
+    init_scale: float = 0.02
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        return nn.Dense(
+            self.vocab_size,
+            kernel_init=nn.initializers.normal(stddev=self.init_scale),
+            dtype=self.dtype,
+            name="linear",
+        )(x)
+
+
+class TiedTokenOutputAdapter(nn.Module):
+    """Logits tied to the token embedding: ``x @ E^T (+ bias)``
+    (reference: adapter.py:138-150). The embedding table is supplied by the
+    caller via an ``attend`` callable to keep parameters owned by the input
+    adapter."""
+
+    vocab_size: int
+    emb_bias: bool = True
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, attend) -> jnp.ndarray:
+        logits = attend(x)
+        if self.emb_bias:
+            bias = self.param("bias", nn.initializers.zeros, (self.vocab_size,))
+            logits = logits + bias.astype(logits.dtype)
+        return logits

@@ -1,0 +1,222 @@
+"""Multi-head QKV attention with a fixed-capacity KV cache.
+
+Behavioral parity with the reference attention primitive
+(reference: perceiver/model/core/modules.py:23-170): separate q/k/v/o
+projections with independently sizeable qk/v channel counts, optional causal
+masking (right-aligned when query and key lengths differ), key padding masks,
+rotary embeddings on q and/or k, and KV caching.
+
+TPU-first differences from the reference:
+
+- The KV cache is a **pre-allocated fixed-capacity buffer + valid-length
+  scalar** written with ``lax.dynamic_update_slice`` instead of a growing
+  ``cat`` (XLA requires static shapes). Keys/values are stored *unrotated*,
+  exactly like the reference (modules.py:117-121 caches before rotation), and
+  rotation is re-applied per call from per-slot encodings.
+- Rotary encodings are passed as **per-position arrays** aligned by the
+  caller (``rope_q`` to the queries, ``rope_k`` to the kv slots). Alignment
+  from dynamic cache lengths is computed from position *values* with static
+  shapes, so one compiled step serves every fill level.
+- Scores and softmax are computed in float32 regardless of the activation
+  dtype (bfloat16-safe); the MXU matmuls keep the activation dtype.
+- ``max_heads_parallel`` (reference: modules.py:142-166) is honored as a
+  statically-unrolled chunk loop; with the Pallas flash-attention path it is
+  unnecessary.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from flax import struct
+from jax import lax
+
+from perceiver_io_tpu.core.position import apply_rotary_pos_emb
+
+
+@struct.dataclass
+class KVCache:
+    """Fixed-capacity cache: ``k``/``v`` are (B, capacity, C) with valid data
+    in slots [0, length); ``length`` is a traced int32 scalar."""
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+    length: jnp.ndarray
+
+    @property
+    def capacity(self) -> int:
+        return self.k.shape[1]
+
+
+def init_kv_cache(
+    batch_size: int,
+    capacity: int,
+    num_qk_channels: int,
+    num_v_channels: int,
+    dtype=jnp.float32,
+) -> KVCache:
+    """Empty cache (length 0) — the analog of the reference's
+    ``empty_kv_cache`` (modules.py:282-285) with pre-allocated capacity."""
+    return KVCache(
+        k=jnp.zeros((batch_size, capacity, num_qk_channels), dtype),
+        v=jnp.zeros((batch_size, capacity, num_v_channels), dtype),
+        length=jnp.zeros((), jnp.int32),
+    )
+
+
+@struct.dataclass
+class AttentionOutput:
+    last_hidden_state: jnp.ndarray
+    kv_cache: Optional[KVCache] = None
+
+
+class MultiHeadAttention(nn.Module):
+    """Multi-head attention per Perceiver IO Appendix E (arXiv:2107.14795).
+
+    :param num_heads: number of attention heads.
+    :param num_q_input_channels: query input channels.
+    :param num_kv_input_channels: key/value input channels.
+    :param num_qk_channels: projected q/k channels (default: q input channels).
+    :param num_v_channels: projected v channels (default: qk channels).
+    :param num_output_channels: output channels (default: q input channels).
+    :param max_heads_parallel: process at most this many heads per matmul
+        (memory bound); default all heads.
+    :param causal_attention: apply a causal mask; queries and keys must be
+        right-aligned when their lengths differ.
+    :param dropout: dropout on attention probabilities.
+    """
+
+    num_heads: int
+    num_q_input_channels: int
+    num_kv_input_channels: int
+    num_qk_channels: Optional[int] = None
+    num_v_channels: Optional[int] = None
+    num_output_channels: Optional[int] = None
+    max_heads_parallel: Optional[int] = None
+    causal_attention: bool = False
+    dropout: float = 0.0
+    qkv_bias: bool = True
+    out_bias: bool = True
+    init_scale: float = 0.02
+    dtype: jnp.dtype = jnp.float32
+
+    @property
+    def qk_channels(self) -> int:
+        return self.num_qk_channels if self.num_qk_channels is not None else self.num_q_input_channels
+
+    @property
+    def v_channels(self) -> int:
+        return self.num_v_channels if self.num_v_channels is not None else self.qk_channels
+
+    @property
+    def output_channels(self) -> int:
+        return self.num_output_channels if self.num_output_channels is not None else self.num_q_input_channels
+
+    def setup(self):
+        if self.qk_channels % self.num_heads != 0:
+            raise ValueError("num_qk_channels must be divisible by num_heads")
+        if self.v_channels % self.num_heads != 0:
+            raise ValueError("num_v_channels must be divisible by num_heads")
+        dense = lambda feat, bias, name: nn.Dense(  # noqa: E731
+            feat,
+            use_bias=bias,
+            kernel_init=nn.initializers.normal(stddev=self.init_scale),
+            dtype=self.dtype,
+            name=name,
+        )
+        self.q_proj = dense(self.qk_channels, self.qkv_bias, "q_proj")
+        self.k_proj = dense(self.qk_channels, self.qkv_bias, "k_proj")
+        self.v_proj = dense(self.v_channels, self.qkv_bias, "v_proj")
+        self.o_proj = dense(self.output_channels, self.out_bias, "o_proj")
+        self.attn_dropout = nn.Dropout(self.dropout)
+
+    def __call__(
+        self,
+        x_q: jnp.ndarray,
+        x_kv: jnp.ndarray,
+        pad_mask: Optional[jnp.ndarray] = None,
+        rope_q: Optional[jnp.ndarray] = None,
+        rope_k: Optional[jnp.ndarray] = None,
+        kv_cache: Optional[KVCache] = None,
+        deterministic: bool = True,
+    ) -> AttentionOutput:
+        """Attend ``x_q`` (B, N, Dq) to ``x_kv`` (B, M, Dkv).
+
+        :param pad_mask: boolean key padding mask, True = padding. Shape
+            (B, M) without cache, (B, capacity) with cache (slot-aligned;
+            entries beyond the valid length are ignored).
+        :param rope_q: per-query rotary encodings (B, N, R), or None.
+        :param rope_k: per-slot rotary encodings (B, M | capacity, R), or None.
+        :param kv_cache: fixed-capacity cache; new keys/values are appended
+            at ``cache.length``. The caller must ensure capacity is not
+            exceeded (slide the window first — see generation).
+        """
+        b, n_q = x_q.shape[0], x_q.shape[1]
+        h = self.num_heads
+
+        q = self.q_proj(x_q)
+        k = self.k_proj(x_kv)
+        v = self.v_proj(x_kv)
+
+        if kv_cache is not None:
+            start = kv_cache.length
+            k_slots = lax.dynamic_update_slice(kv_cache.k, k.astype(kv_cache.k.dtype), (0, start, 0))
+            v_slots = lax.dynamic_update_slice(kv_cache.v, v.astype(kv_cache.v.dtype), (0, start, 0))
+            eff_len = start + x_kv.shape[1]
+            new_cache = KVCache(k=k_slots, v=v_slots, length=eff_len)
+        else:
+            k_slots, v_slots = k, v
+            eff_len = x_kv.shape[1]
+            new_cache = None
+
+        n_kv = k_slots.shape[1]
+
+        def split_heads(x, channels_per_head):
+            return x.reshape(b, x.shape[1], h, channels_per_head).transpose(0, 2, 1, 3)
+
+        q = split_heads(q, self.qk_channels // h)
+        k_h = split_heads(k_slots, self.qk_channels // h)
+        v_h = split_heads(v_slots, self.v_channels // h)
+
+        q = q * (self.qk_channels // h) ** -0.5
+
+        if rope_q is not None:
+            q = apply_rotary_pos_emb(q, rope_q[:, None, :, :])
+        if rope_k is not None:
+            k_h = apply_rotary_pos_emb(k_h, rope_k[:, None, :, :])
+
+        # Combined boolean mask (True = masked), shape broadcastable to (B, 1, N, M).
+        kv_idx = jnp.arange(n_kv, dtype=jnp.int32)
+        masked = jnp.zeros((1, 1, 1, n_kv), dtype=bool)
+        if kv_cache is not None:
+            masked = masked | (kv_idx[None, None, None, :] >= eff_len)
+        if pad_mask is not None:
+            masked = masked | pad_mask[:, None, None, :]
+        if self.causal_attention:
+            # Query i's absolute slot index is eff_len - n_q + i (right-aligned).
+            q_abs = eff_len - n_q + jnp.arange(n_q, dtype=jnp.int32)
+            masked = masked | (kv_idx[None, None, None, :] > q_abs[None, None, :, None])
+
+        def attend(q_c, k_c, v_c):
+            scores = jnp.einsum("bhic,bhjc->bhij", q_c, k_c, preferred_element_type=jnp.float32)
+            scores = jnp.where(masked, -jnp.finfo(jnp.float32).max, scores)
+            attn = jax.nn.softmax(scores)
+            attn = self.attn_dropout(attn, deterministic=deterministic)
+            return jnp.einsum("bhij,bhjc->bhic", attn.astype(v_c.dtype), v_c)
+
+        chunk = self.max_heads_parallel or h
+        if chunk >= h:
+            o = attend(q, k_h, v_h)
+        else:
+            o_chunks = [
+                attend(q[:, i : i + chunk], k_h[:, i : i + chunk], v_h[:, i : i + chunk])
+                for i in range(0, h, chunk)
+            ]
+            o = jnp.concatenate(o_chunks, axis=1)
+
+        o = o.transpose(0, 2, 1, 3).reshape(b, n_q, self.v_channels)
+        o = self.o_proj(o)
+        return AttentionOutput(last_hidden_state=o, kv_cache=new_cache)

@@ -1,0 +1,33 @@
+"""Test configuration: force the CPU backend with 8 virtual devices so
+multi-chip sharding tests run anywhere (the driver separately dry-runs the
+multi-chip path on its own device count).
+
+Note: the axon TPU tunnel presets JAX_PLATFORMS=axon and a sitecustomize
+imports jax early, so the env-var route does not stick — the platform must be
+set via jax.config before first backend use. XLA_FLAGS is read at backend
+initialization, so setting it here (before any device query) still works.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture(scope="session")
+def devices():
+    return jax.devices()

@@ -1,0 +1,218 @@
+"""Cached-incremental decoding must equal the full uncached forward — the
+central numerical contract, ported from the reference's crown-jewel test
+(reference: tests/kv_cache_test.py:82-234) onto fixed-capacity caches."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from perceiver_io_tpu.core.attention import init_kv_cache
+from perceiver_io_tpu.core.config import CausalSequenceModelConfig
+from perceiver_io_tpu.core.modules import CausalSequenceModel, CrossAttentionLayer, SelfAttentionBlock
+from perceiver_io_tpu.core.position import frequency_position_encoding, positions
+
+NUM_PREFIX = 8
+NUM_LATENTS = 16
+NUM_CHANNELS = 128
+NUM_HEADS = 8
+NUM_LAYERS = 4
+BATCH_SIZE = 2
+ROPE_DIM = NUM_CHANNELS // NUM_HEADS // 4
+
+ATOL = 1e-5
+
+
+def create_pad_mask(seq_len):
+    pad_mask = np.zeros((BATCH_SIZE, seq_len), dtype=bool)
+    pad_mask[1, :2] = True
+    return jnp.asarray(pad_mask)
+
+
+def create_enc(seq_len, pad_mask=None):
+    shift = None if pad_mask is None else pad_mask.sum(axis=1, keepdims=True).astype(jnp.int32)
+    return frequency_position_encoding(positions(BATCH_SIZE, seq_len, shift=shift), ROPE_DIM)
+
+
+@pytest.fixture(scope="module")
+def self_attn():
+    block = SelfAttentionBlock(
+        num_layers=NUM_LAYERS,
+        num_heads=NUM_HEADS,
+        num_channels=NUM_CHANNELS,
+        num_qk_channels=NUM_CHANNELS // 2,
+        num_v_channels=NUM_CHANNELS // 2,
+        causal_attention=True,
+        num_rotary_layers=-1,
+    )
+    x = jnp.zeros((BATCH_SIZE, NUM_LATENTS, NUM_CHANNELS))
+    params = block.init(jax.random.PRNGKey(0), x)
+    return block, params
+
+
+@pytest.fixture(scope="module")
+def cross_attn():
+    layer = CrossAttentionLayer(
+        num_heads=NUM_HEADS,
+        num_q_input_channels=NUM_CHANNELS,
+        num_kv_input_channels=NUM_CHANNELS,
+        num_qk_channels=NUM_CHANNELS // 2,
+        num_v_channels=NUM_CHANNELS // 2,
+        causal_attention=True,
+    )
+    x = jnp.zeros((BATCH_SIZE, NUM_LATENTS, NUM_CHANNELS))
+    params = layer.init(jax.random.PRNGKey(0), x, x_kv_prefix=jnp.zeros((BATCH_SIZE, NUM_PREFIX, NUM_CHANNELS)))
+    return layer, params
+
+
+@pytest.fixture(scope="module")
+def csm():
+    config = CausalSequenceModelConfig(
+        vocab_size=100,
+        max_seq_len=NUM_LATENTS + NUM_PREFIX,
+        max_latents=NUM_LATENTS,
+        num_channels=NUM_CHANNELS,
+        num_self_attention_layers=NUM_LAYERS,
+        num_self_attention_rotary_layers=-1,
+        output_norm=True,
+    )
+    model = CausalSequenceModel(config)
+    x = jnp.zeros((BATCH_SIZE, NUM_PREFIX + NUM_LATENTS), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), x, prefix_len=NUM_PREFIX)
+    return model, params, config
+
+
+def make_sa_cache(capacity):
+    return tuple(
+        init_kv_cache(BATCH_SIZE, capacity, NUM_CHANNELS // 2, NUM_CHANNELS // 2)
+        for _ in range(NUM_LAYERS)
+    )
+
+
+def test_self_attn_cache(self_attn):
+    block, params = self_attn
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(BATCH_SIZE, NUM_LATENTS, NUM_CHANNELS)), jnp.float32)
+    enc = create_enc(NUM_LATENTS)
+
+    # full forward, caches populated in one shot
+    out_ref = block.apply(params, x, rope_q=enc, rope_k=enc, kv_cache=make_sa_cache(NUM_LATENTS))
+    hidden_ref, cache_ref = out_ref.last_hidden_state, out_ref.kv_cache
+
+    # incremental: one latent at a time against the fixed-capacity cache
+    cache = make_sa_cache(NUM_LATENTS)
+    hidden = []
+    for i in range(NUM_LATENTS):
+        out = block.apply(
+            params, x[:, i : i + 1], rope_q=enc[:, i : i + 1], rope_k=enc, kv_cache=cache
+        )
+        hidden.append(out.last_hidden_state)
+        cache = out.kv_cache
+
+    hidden = jnp.concatenate(hidden, axis=1)
+    assert hidden.shape == hidden_ref.shape
+    np.testing.assert_allclose(np.asarray(hidden), np.asarray(hidden_ref), atol=ATOL)
+
+    for i in range(NUM_LAYERS):
+        np.testing.assert_allclose(np.asarray(cache[i].k), np.asarray(cache_ref[i].k), atol=ATOL)
+        np.testing.assert_allclose(np.asarray(cache[i].v), np.asarray(cache_ref[i].v), atol=ATOL)
+        assert int(cache[i].length) == NUM_LATENTS
+
+
+def test_cross_attn_cache(cross_attn):
+    layer, params = cross_attn
+    rng = np.random.default_rng(1)
+    x_q = jnp.asarray(rng.normal(size=(BATCH_SIZE, NUM_LATENTS, NUM_CHANNELS)), jnp.float32)
+    x_kv_prefix = jnp.asarray(rng.normal(size=(BATCH_SIZE, NUM_PREFIX, NUM_CHANNELS)), jnp.float32)
+
+    total = NUM_PREFIX + NUM_LATENTS
+    pad_mask = create_pad_mask(total)
+    enc = create_enc(total, pad_mask=pad_mask)
+
+    def empty_cache():
+        return init_kv_cache(BATCH_SIZE, total, NUM_CHANNELS // 2, NUM_CHANNELS // 2)
+
+    out_ref = layer.apply(
+        params,
+        x_q,
+        x_kv_prefix=x_kv_prefix,
+        pad_mask=pad_mask,
+        rope_q=enc[:, NUM_PREFIX:],
+        rope_k=enc,
+        kv_cache=empty_cache(),
+    )
+    hidden_ref, cache_ref = out_ref.last_hidden_state, out_ref.kv_cache
+
+    # incremental: prefix + first latent, then one latent at a time
+    cache = empty_cache()
+    hidden = []
+    empty_prefix = jnp.zeros((BATCH_SIZE, 0, NUM_CHANNELS))
+    for i in range(NUM_LATENTS):
+        out = layer.apply(
+            params,
+            x_q[:, i : i + 1],
+            x_kv_prefix=x_kv_prefix if i == 0 else empty_prefix,
+            pad_mask=pad_mask,
+            rope_q=enc[:, NUM_PREFIX + i : NUM_PREFIX + i + 1],
+            rope_k=enc,
+            kv_cache=cache,
+        )
+        hidden.append(out.last_hidden_state)
+        cache = out.kv_cache
+
+    hidden = jnp.concatenate(hidden, axis=1)
+    assert hidden.shape == hidden_ref.shape
+    np.testing.assert_allclose(np.asarray(hidden), np.asarray(hidden_ref), atol=ATOL)
+    np.testing.assert_allclose(np.asarray(cache.k), np.asarray(cache_ref.k), atol=ATOL)
+    np.testing.assert_allclose(np.asarray(cache.v), np.asarray(cache_ref.v), atol=ATOL)
+
+
+def test_csm_cache(csm):
+    model, params, config = csm
+    total = NUM_PREFIX + NUM_LATENTS
+    x = jnp.asarray(np.random.default_rng(2).integers(0, config.vocab_size, size=(BATCH_SIZE, total)))
+    pad_mask = create_pad_mask(total)
+
+    out_ref = model.apply(
+        params,
+        x,
+        prefix_len=NUM_PREFIX,
+        pad_mask=pad_mask,
+        kv_cache=CausalSequenceModel.init_cache(config, BATCH_SIZE),
+    )
+    logits_ref, cache_ref = out_ref.logits, out_ref.kv_cache
+
+    # uncached forward agrees with the cache-populating full forward
+    out_nocache = model.apply(params, x, prefix_len=NUM_PREFIX, pad_mask=pad_mask)
+    np.testing.assert_allclose(np.asarray(out_nocache.logits), np.asarray(logits_ref), atol=ATOL)
+
+    # incremental: init with prefix + 2 latents, then one token at a time
+    cache = CausalSequenceModel.init_cache(config, BATCH_SIZE)
+    out = model.apply(
+        params,
+        x[:, : NUM_PREFIX + 2],
+        prefix_len=NUM_PREFIX,
+        pad_mask=pad_mask[:, : NUM_PREFIX + 2],
+        kv_cache=cache,
+    )
+    logits = [out.logits]
+    cache = out.kv_cache
+
+    for i in range(2, NUM_LATENTS):
+        out = model.apply(
+            params,
+            x[:, NUM_PREFIX + i : NUM_PREFIX + i + 1],
+            prefix_len=NUM_PREFIX,
+            pad_mask=pad_mask,
+            kv_cache=cache,
+            decode=True,
+        )
+        logits.append(out.logits)
+        cache = out.kv_cache
+
+    logits = jnp.concatenate(logits, axis=1)
+    assert logits.shape == logits_ref.shape
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(logits_ref), atol=ATOL)
+
+    for i in range(1 + NUM_LAYERS):
+        np.testing.assert_allclose(np.asarray(cache[i].k), np.asarray(cache_ref[i].k), atol=ATOL)
+        np.testing.assert_allclose(np.asarray(cache[i].v), np.asarray(cache_ref[i].v), atol=ATOL)

@@ -1,0 +1,112 @@
+"""Position encoding contracts (reference: tests exercise these via
+kv_cache_test.py and model tests; shapes per perceiver/model/core/position.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from perceiver_io_tpu.core.position import (
+    FourierPositionEncoding,
+    RotaryPositionEmbedding,
+    apply_rotary_pos_emb,
+    frequency_position_encoding,
+    fourier_position_encodings,
+    positions,
+    rotate_half,
+)
+
+
+def test_positions_basic():
+    pos = positions(2, 5)
+    assert pos.shape == (2, 5)
+    np.testing.assert_array_equal(np.asarray(pos[0]), np.arange(5))
+
+
+def test_positions_shift_clamped():
+    shift = jnp.array([[0], [2]], dtype=jnp.int32)
+    pos = positions(2, 5, shift=shift)
+    np.testing.assert_array_equal(np.asarray(pos[1]), [0, 0, 0, 1, 2])
+
+
+def test_positions_shift_shape_validation():
+    with pytest.raises(ValueError):
+        positions(2, 5, shift=jnp.zeros((2,), jnp.int32))
+
+
+def test_positions_offset():
+    offset = jnp.asarray(3, dtype=jnp.int32)
+    pos = positions(1, 4, offset=offset)
+    np.testing.assert_array_equal(np.asarray(pos[0]), [3, 4, 5, 6])
+
+
+def test_frequency_position_encoding_pairs():
+    """Each inverse frequency is repeated twice (adjacent pairs)."""
+    enc = frequency_position_encoding(positions(1, 8), dim=6)
+    assert enc.shape == (1, 8, 6)
+    enc = np.asarray(enc)
+    np.testing.assert_allclose(enc[..., 0], enc[..., 1])
+    np.testing.assert_allclose(enc[..., 2], enc[..., 3])
+    # position 0 encodes to all zeros
+    np.testing.assert_allclose(enc[0, 0], np.zeros(6))
+
+
+def test_rotate_half():
+    x = jnp.asarray([[1.0, 2.0, 3.0, 4.0]])
+    np.testing.assert_allclose(np.asarray(rotate_half(x)), [[-2.0, 1.0, -4.0, 3.0]])
+
+
+def test_rotary_preserves_norm():
+    """Rotation is an isometry on the rotated channels."""
+    rng = np.random.default_rng(0)
+    t = jnp.asarray(rng.normal(size=(2, 3, 10, 8)), jnp.float32)
+    enc = frequency_position_encoding(positions(2, 10), dim=8)
+    t_rot = apply_rotary_pos_emb(t, enc[:, None])
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(t_rot), axis=-1),
+        np.linalg.norm(np.asarray(t), axis=-1),
+        rtol=1e-5,
+    )
+
+
+def test_rotary_relative_property():
+    """<rot(q, m), rot(k, n)> depends only on m - n."""
+    rng = np.random.default_rng(1)
+    q = jnp.asarray(rng.normal(size=(1, 1, 1, 8)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(1, 1, 1, 8)), jnp.float32)
+
+    def score(m, n):
+        enc_q = frequency_position_encoding(jnp.array([[m]]), dim=8)
+        enc_k = frequency_position_encoding(jnp.array([[n]]), dim=8)
+        qr = apply_rotary_pos_emb(q, enc_q[:, None])
+        kr = apply_rotary_pos_emb(k, enc_k[:, None])
+        return float(jnp.sum(qr * kr))
+
+    assert score(5, 3) == pytest.approx(score(7, 5), rel=1e-4)
+    assert score(10, 0) == pytest.approx(score(12, 2), rel=1e-4)
+
+
+def test_rotary_position_embedding_right_align():
+    rng = np.random.default_rng(2)
+    enc = frequency_position_encoding(positions(1, 10), dim=8)
+    t = jnp.asarray(rng.normal(size=(1, 2, 4, 8)), jnp.float32)
+
+    right = RotaryPositionEmbedding(enc, right_align=True).rotate(t)
+    manual = apply_rotary_pos_emb(t, enc[:, None, -4:, :])
+    np.testing.assert_allclose(np.asarray(right), np.asarray(manual), atol=1e-6)
+
+
+def test_fourier_position_encoding_channels():
+    """C = len(shape) * (2 * bands + 1) (reference: position.py:134-135)."""
+    fpe = FourierPositionEncoding(input_shape=(9, 7), num_frequency_bands=5)
+    assert fpe.num_position_encoding_channels() == 2 * (2 * 5 + 1)
+    enc = fpe(batch_size=3)
+    assert enc.shape == (3, 63, 22)
+
+
+def test_fourier_position_encoding_values():
+    enc = fourier_position_encodings((4,), num_frequency_bands=2)
+    assert enc.shape == (4, 5)
+    # raw positions channel spans [-1, 1]
+    np.testing.assert_allclose(enc[:, 0], [-1.0, -1 / 3, 1 / 3, 1.0], atol=1e-6)
+    # sin channels are odd around the grid center
+    np.testing.assert_allclose(enc[0, 1], -enc[3, 1], atol=1e-6)
