@@ -10,6 +10,7 @@ single compiled decode step serves every cache fill level.
 
 from __future__ import annotations
 
+import functools
 import math
 from typing import Optional, Sequence
 
@@ -112,6 +113,7 @@ class RotaryPositionEmbedding:
         return apply_rotary_pos_emb(t, pos_enc[:, None, :, :])
 
 
+@functools.lru_cache(maxsize=16)
 def fourier_position_encodings(
     input_shape: Sequence[int],
     num_frequency_bands: int,
@@ -122,9 +124,10 @@ def fourier_position_encodings(
     Returns a (prod(input_shape), C) float32 array where
     C = len(input_shape) * (2 * num_frequency_bands + include_positions),
     channel order = [raw positions, sin per dim, cos per dim]
-    (reference: position.py:74-138). Computed with numpy at trace time; XLA
-    treats it as a constant.
+    (reference: position.py:74-138). Computed with numpy at trace time and
+    memoized per grid geometry; XLA treats it as a constant.
     """
+    input_shape = tuple(input_shape)
     coords = [np.linspace(-1.0, 1.0, num=s, dtype=np.float32) for s in input_shape]
     pos = np.stack(np.meshgrid(*coords, indexing="ij"), axis=-1)  # (*shape, ndim)
 
@@ -147,11 +150,11 @@ class FourierPositionEncoding:
     def __init__(self, input_shape: Sequence[int], num_frequency_bands: int):
         self.input_shape = tuple(input_shape)
         self.num_frequency_bands = num_frequency_bands
-        self._enc = fourier_position_encodings(input_shape, num_frequency_bands)
 
     def num_position_encoding_channels(self, include_positions: bool = True) -> int:
+        # analytic — does not build the grid
         return len(self.input_shape) * (2 * self.num_frequency_bands + include_positions)
 
     def __call__(self, batch_size: int) -> jnp.ndarray:
-        enc = jnp.asarray(self._enc)
+        enc = jnp.asarray(fourier_position_encodings(self.input_shape, self.num_frequency_bands))
         return jnp.broadcast_to(enc[None], (batch_size,) + enc.shape)

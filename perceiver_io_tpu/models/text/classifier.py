@@ -1,0 +1,53 @@
+"""Text classifier: text encoder + classification decoder
+(reference: perceiver/model/text/classifier/backend.py:15-46)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from perceiver_io_tpu.core.adapter import ClassificationOutputAdapter, TrainableQueryProvider
+from perceiver_io_tpu.core.config import ClassificationDecoderConfig, PerceiverIOConfig
+from perceiver_io_tpu.core.modules import PerceiverDecoder
+from perceiver_io_tpu.models.text.common import TextEncoderConfig, make_text_encoder, make_text_input_adapter
+
+TextClassifierConfig = PerceiverIOConfig[TextEncoderConfig, ClassificationDecoderConfig]
+
+
+class TextClassifier(nn.Module):
+    config: TextClassifierConfig
+    dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        cfg = self.config
+        self.input_adapter = make_text_input_adapter(cfg.encoder, dtype=self.dtype)
+        self.encoder = make_text_encoder(
+            cfg.encoder,
+            self.input_adapter,
+            num_latents=cfg.num_latents,
+            num_latent_channels=cfg.num_latent_channels,
+            activation_checkpointing=cfg.activation_checkpointing,
+            dtype=self.dtype,
+        )
+        self.decoder = PerceiverDecoder(
+            output_adapter=ClassificationOutputAdapter(
+                num_classes=cfg.decoder.num_classes,
+                num_output_query_channels=cfg.decoder.num_output_query_channels,
+                init_scale=cfg.decoder.init_scale,
+                dtype=self.dtype,
+            ),
+            output_query_provider=TrainableQueryProvider(
+                num_queries=cfg.decoder.num_output_queries,
+                num_query_channels=cfg.decoder.num_output_query_channels,
+                init_scale=cfg.decoder.init_scale,
+                dtype=self.dtype,
+            ),
+            num_latent_channels=cfg.num_latent_channels,
+            activation_checkpointing=cfg.activation_checkpointing,
+            dtype=self.dtype,
+            **cfg.decoder.base_kwargs(),
+        )
+
+    def __call__(self, x, pad_mask=None, deterministic: bool = True):
+        latents = self.encoder(x, pad_mask=pad_mask, deterministic=deterministic)
+        return self.decoder(latents, deterministic=deterministic)

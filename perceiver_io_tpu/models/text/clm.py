@@ -1,0 +1,18 @@
+"""Causal language model — a trivial specialization of the causal sequence
+model (reference: perceiver/model/text/clm/backend.py:6-13)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from perceiver_io_tpu.core.config import CausalSequenceModelConfig
+from perceiver_io_tpu.core.modules import CausalSequenceModel
+
+
+@dataclass
+class CausalLanguageModelConfig(CausalSequenceModelConfig):
+    pass
+
+
+class CausalLanguageModel(CausalSequenceModel):
+    pass

@@ -1,0 +1,55 @@
+"""Shared text encoder configuration
+(reference: perceiver/model/text/common/backend.py:8-41)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax.numpy as jnp
+
+from perceiver_io_tpu.core.adapter import TokenInputAdapter
+from perceiver_io_tpu.core.config import EncoderConfig
+from perceiver_io_tpu.core.modules import PerceiverEncoder
+
+
+@dataclass
+class TextEncoderConfig(EncoderConfig):
+    vocab_size: int = 10003
+    max_seq_len: int = 256
+    num_input_channels: int = 64
+    params: Optional[str] = None  # checkpoint path / repo id for warm start
+
+
+def make_text_input_adapter(config: TextEncoderConfig, dtype=jnp.float32, name="input_adapter") -> TokenInputAdapter:
+    return TokenInputAdapter(
+        vocab_size=config.vocab_size,
+        max_seq_len=config.max_seq_len,
+        num_input_channels=config.num_input_channels,
+        init_scale=config.init_scale,
+        dtype=dtype,
+        name=name,
+    )
+
+
+def make_text_encoder(
+    config: TextEncoderConfig,
+    input_adapter: TokenInputAdapter,
+    num_latents: int,
+    num_latent_channels: int,
+    activation_checkpointing: bool = False,
+    dtype=jnp.float32,
+    name: str = "encoder",
+) -> PerceiverEncoder:
+    """Build the generic text encoder: token adapter + Perceiver IO encoder.
+    The adapter is passed in (not constructed here) so task models can tie
+    output embeddings to it."""
+    return PerceiverEncoder(
+        input_adapter=input_adapter,
+        num_latents=num_latents,
+        num_latent_channels=num_latent_channels,
+        activation_checkpointing=activation_checkpointing,
+        dtype=dtype,
+        name=name,
+        **config.base_kwargs(),
+    )

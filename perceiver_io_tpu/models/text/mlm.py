@@ -1,0 +1,90 @@
+"""Masked language model: text encoder + per-position learned output queries
+with tied or independent token logits
+(reference: perceiver/model/text/mlm/backend.py:18-89)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from perceiver_io_tpu.core.adapter import TiedTokenOutputAdapter, TokenOutputAdapter, TrainableQueryProvider
+from perceiver_io_tpu.core.config import DecoderConfig, PerceiverIOConfig
+from perceiver_io_tpu.core.modules import PerceiverDecoder
+from perceiver_io_tpu.models.text.common import TextEncoderConfig, make_text_encoder, make_text_input_adapter
+
+
+@dataclass
+class TextDecoderConfig(DecoderConfig):
+    num_output_query_channels: Optional[int] = None
+    vocab_size: int = 10003
+    max_seq_len: int = 512
+
+
+MaskedLanguageModelConfig = PerceiverIOConfig[TextEncoderConfig, TextDecoderConfig]
+
+
+class MaskedLanguageModel(nn.Module):
+    """When ``decoder.num_output_query_channels`` is None, output queries have
+    the encoder input channel width and logits are tied to the token embedding;
+    otherwise an independent linear head is used
+    (reference: mlm/backend.py:40-71)."""
+
+    config: MaskedLanguageModelConfig
+    dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        cfg = self.config
+        self.input_adapter = make_text_input_adapter(cfg.encoder, dtype=self.dtype)
+        self.encoder = make_text_encoder(
+            cfg.encoder,
+            self.input_adapter,
+            num_latents=cfg.num_latents,
+            num_latent_channels=cfg.num_latent_channels,
+            activation_checkpointing=cfg.activation_checkpointing,
+            dtype=self.dtype,
+        )
+
+        self.tied = cfg.decoder.num_output_query_channels is None
+        if self.tied:
+            output_query_provider = TrainableQueryProvider(
+                num_queries=cfg.decoder.max_seq_len,
+                num_query_channels=cfg.encoder.num_input_channels,
+                init_scale=cfg.decoder.init_scale,
+                dtype=self.dtype,
+            )
+            self.output_adapter = TiedTokenOutputAdapter(
+                vocab_size=cfg.decoder.vocab_size, dtype=self.dtype
+            )
+        else:
+            output_query_provider = TrainableQueryProvider(
+                num_queries=cfg.decoder.max_seq_len,
+                num_query_channels=cfg.decoder.num_output_query_channels,
+                init_scale=cfg.decoder.init_scale,
+                dtype=self.dtype,
+            )
+            self.output_adapter = TokenOutputAdapter(
+                vocab_size=cfg.decoder.vocab_size,
+                num_output_query_channels=cfg.decoder.num_output_query_channels,
+                init_scale=cfg.decoder.init_scale,
+                dtype=self.dtype,
+            )
+        self.decoder = PerceiverDecoder(
+            output_adapter=self.output_adapter,
+            output_query_provider=output_query_provider,
+            num_latent_channels=cfg.num_latent_channels,
+            activation_checkpointing=cfg.activation_checkpointing,
+            dtype=self.dtype,
+            **cfg.decoder.base_kwargs(),
+        )
+
+    def __call__(self, x_masked, pad_mask=None, deterministic: bool = True):
+        n = x_masked.shape[1]
+        x_latent = self.encoder(x_masked, pad_mask=pad_mask, deterministic=deterministic)
+        if self.tied:
+            logits = self.decoder(x_latent, deterministic=deterministic, attend=self.input_adapter.attend)
+        else:
+            logits = self.decoder(x_latent, deterministic=deterministic)
+        return logits[:, :n, :]

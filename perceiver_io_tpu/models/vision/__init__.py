@@ -1,0 +1,12 @@
+from perceiver_io_tpu.models.vision.image_classifier import (
+    ImageClassifier,
+    ImageClassifierConfig,
+    ImageEncoderConfig,
+    ImageInputAdapter,
+)
+from perceiver_io_tpu.models.vision.optical_flow import (
+    OpticalFlow,
+    OpticalFlowConfig,
+    OpticalFlowDecoderConfig,
+    OpticalFlowEncoderConfig,
+)

@@ -1,0 +1,168 @@
+"""Perceiver IO optical flow: frame-pair patch features are both the encoder
+input and the decoder's per-pixel output queries
+(reference: perceiver/model/vision/optical_flow/backend.py:39-137).
+
+Input layout is (B, 2, H, W, C) — two frames, channels-last; the reference's
+(B, 2, C, H, W) torch layout is transposed on the data side."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from perceiver_io_tpu.core.config import DecoderConfig, EncoderConfig, PerceiverIOConfig
+from perceiver_io_tpu.core.modules import PerceiverDecoder, PerceiverEncoder
+from perceiver_io_tpu.core.position import FourierPositionEncoding
+
+
+@dataclass
+class OpticalFlowEncoderConfig(EncoderConfig):
+    image_shape: Tuple[int, int] = (368, 496)
+    num_patch_input_channels: int = 27
+    num_patch_hidden_channels: int = 64
+    num_frequency_bands: int = 64
+
+
+@dataclass
+class OpticalFlowDecoderConfig(DecoderConfig):
+    image_shape: Tuple[int, int] = (368, 496)
+    rescale_factor: float = 100.0
+
+
+OpticalFlowConfig = PerceiverIOConfig[OpticalFlowEncoderConfig, OpticalFlowDecoderConfig]
+
+
+class OpticalFlowInputAdapter(nn.Module):
+    """Concatenate the two frames' patch features channel-wise, project to
+    hidden width, concat Fourier position encodings
+    (reference: optical_flow/backend.py:39-65)."""
+
+    image_shape: Tuple[int, int]
+    num_patch_input_channels: int
+    num_patch_hidden_channels: int
+    num_frequency_bands: int
+    init_scale: float = 0.02
+
+    @property
+    def position_encoding(self) -> FourierPositionEncoding:
+        return FourierPositionEncoding(
+            input_shape=self.image_shape, num_frequency_bands=self.num_frequency_bands
+        )
+
+    @property
+    def num_input_channels(self) -> int:
+        return self.num_patch_hidden_channels + self.position_encoding.num_position_encoding_channels()
+
+    @nn.compact
+    def __call__(self, x):
+        b, t, h, w, c = x.shape
+        if (h, w) != tuple(self.image_shape) or c != self.num_patch_input_channels or t != 2:
+            raise ValueError(
+                f"Input shape {(t, h, w, c)} incompatible with configured "
+                f"(2, {self.image_shape[0]}, {self.image_shape[1]}, {self.num_patch_input_channels})"
+            )
+        # (B, 2, H, W, C) -> (B, H, W, 2*C), frame-major channel order
+        x = x.transpose(0, 2, 3, 1, 4).reshape(b, h, w, t * c)
+        x = nn.Dense(
+            self.num_patch_hidden_channels,
+            kernel_init=nn.initializers.normal(stddev=self.init_scale),
+            name="linear",
+        )(x)
+        x = x.reshape(b, h * w, self.num_patch_hidden_channels)
+        pos_enc = self.position_encoding(b).astype(x.dtype)
+        return jnp.concatenate([x, pos_enc], axis=-1)
+
+
+class OpticalFlowOutputAdapter(nn.Module):
+    """Linear head to (H, W, 2) flow, divided by ``rescale_factor``
+    (reference: optical_flow/backend.py:68-87)."""
+
+    image_shape: Tuple[int, int]
+    num_output_query_channels: int
+    num_output_image_channels: int = 2
+    rescale_factor: float = 100.0
+    init_scale: float = 0.02
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Dense(
+            self.num_output_image_channels,
+            kernel_init=nn.initializers.normal(stddev=self.init_scale),
+            name="linear",
+        )(x)
+        x = x / self.rescale_factor
+        b = x.shape[0]
+        h, w = self.image_shape
+        return x.reshape(b, h, w, self.num_output_image_channels)
+
+
+class OpticalFlowQueryProvider:
+    """Output queries are the adapted input itself — per-pixel queries
+    (reference: optical_flow/backend.py:90-102)."""
+
+    def __init__(self, num_query_channels: int):
+        self._num_query_channels = num_query_channels
+
+    @property
+    def num_query_channels(self) -> int:
+        return self._num_query_channels
+
+    def __call__(self, x):
+        assert x.shape[-1] == self.num_query_channels
+        return x
+
+
+class OpticalFlow(nn.Module):
+    config: OpticalFlowConfig
+    dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        cfg = self.config
+        input_adapter = OpticalFlowInputAdapter(
+            image_shape=cfg.encoder.image_shape,
+            num_patch_input_channels=cfg.encoder.num_patch_input_channels,
+            num_patch_hidden_channels=cfg.encoder.num_patch_hidden_channels,
+            num_frequency_bands=cfg.encoder.num_frequency_bands,
+            init_scale=cfg.encoder.init_scale,
+            name="input_adapter",
+        )
+        encoder_kwargs = cfg.encoder.base_kwargs()
+        # qk and v channels both default to the adapter width (backend.py:107-111)
+        if encoder_kwargs["num_cross_attention_qk_channels"] is None:
+            encoder_kwargs["num_cross_attention_qk_channels"] = input_adapter.num_input_channels
+        if encoder_kwargs["num_cross_attention_v_channels"] is None:
+            encoder_kwargs["num_cross_attention_v_channels"] = input_adapter.num_input_channels
+        self.encoder = PerceiverEncoder(
+            input_adapter=input_adapter,
+            num_latents=cfg.num_latents,
+            num_latent_channels=cfg.num_latent_channels,
+            activation_checkpointing=cfg.activation_checkpointing,
+            dtype=self.dtype,
+            name="encoder",
+            **encoder_kwargs,
+        )
+        self.decoder = PerceiverDecoder(
+            output_adapter=OpticalFlowOutputAdapter(
+                image_shape=cfg.decoder.image_shape,
+                num_output_query_channels=input_adapter.num_input_channels,
+                rescale_factor=cfg.decoder.rescale_factor,
+                init_scale=cfg.decoder.init_scale,
+            ),
+            output_query_provider=OpticalFlowQueryProvider(
+                num_query_channels=input_adapter.num_input_channels
+            ),
+            num_latent_channels=cfg.num_latent_channels,
+            activation_checkpointing=cfg.activation_checkpointing,
+            dtype=self.dtype,
+            name="decoder",
+            **cfg.decoder.base_kwargs(),
+        )
+
+    def __call__(self, x, deterministic: bool = True):
+        x_latent, x_adapted = self.encoder(
+            x, return_adapted_input=True, deterministic=deterministic
+        )
+        return self.decoder(x_latent, x_adapted=x_adapted, deterministic=deterministic)

@@ -1,0 +1,199 @@
+"""Construction + forward-shape tests for the task models
+(reference pattern: tests/text_classifier_test.py:36-46 and friends)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from perceiver_io_tpu.core.config import ClassificationDecoderConfig
+from perceiver_io_tpu.models.audio import SymbolicAudioModel, SymbolicAudioModelConfig
+from perceiver_io_tpu.models.text import (
+    CausalLanguageModel,
+    CausalLanguageModelConfig,
+    MaskedLanguageModel,
+    MaskedLanguageModelConfig,
+    TextClassifier,
+    TextClassifierConfig,
+    TextDecoderConfig,
+    TextEncoderConfig,
+)
+from perceiver_io_tpu.models.vision import (
+    ImageClassifier,
+    ImageClassifierConfig,
+    ImageEncoderConfig,
+    OpticalFlow,
+    OpticalFlowConfig,
+    OpticalFlowDecoderConfig,
+    OpticalFlowEncoderConfig,
+)
+
+VOCAB = 101
+MAX_SEQ_LEN = 32
+B = 2
+
+
+def small_text_encoder_config():
+    return TextEncoderConfig(
+        vocab_size=VOCAB,
+        max_seq_len=MAX_SEQ_LEN,
+        num_input_channels=32,
+        num_cross_attention_heads=2,
+        num_self_attention_heads=2,
+        num_self_attention_layers_per_block=2,
+    )
+
+
+def test_text_classifier_shapes():
+    config = TextClassifierConfig(
+        encoder=small_text_encoder_config(),
+        decoder=ClassificationDecoderConfig(
+            num_classes=2, num_output_query_channels=32, num_cross_attention_heads=2
+        ),
+        num_latents=8,
+        num_latent_channels=16,
+    )
+    model = TextClassifier(config)
+    x = jnp.zeros((B, MAX_SEQ_LEN), jnp.int32)
+    pad = jnp.zeros((B, MAX_SEQ_LEN), bool)
+    params = model.init(jax.random.PRNGKey(0), x, pad)
+    logits = model.apply(params, x, pad)
+    assert logits.shape == (B, 2)
+
+
+@pytest.mark.parametrize("tied", [True, False])
+def test_masked_language_model_shapes(tied):
+    config = MaskedLanguageModelConfig(
+        encoder=small_text_encoder_config(),
+        decoder=TextDecoderConfig(
+            vocab_size=VOCAB,
+            max_seq_len=MAX_SEQ_LEN,
+            num_output_query_channels=None if tied else 24,
+            num_cross_attention_heads=2,
+        ),
+        num_latents=8,
+        num_latent_channels=16,
+    )
+    model = MaskedLanguageModel(config)
+    n = MAX_SEQ_LEN - 4  # logits truncated to input length
+    x = jnp.zeros((B, n), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), x)
+    logits = model.apply(params, x)
+    assert logits.shape == (B, n, VOCAB)
+
+
+def test_causal_language_model_shapes():
+    config = CausalLanguageModelConfig(
+        vocab_size=VOCAB,
+        max_seq_len=MAX_SEQ_LEN,
+        max_latents=16,
+        num_channels=32,
+        num_heads=4,
+        num_self_attention_layers=2,
+    )
+    model = CausalLanguageModel(config)
+    x = jnp.zeros((B, MAX_SEQ_LEN), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), x, prefix_len=16)
+    out = model.apply(params, x, prefix_len=16)
+    assert out.logits.shape == (B, 16, VOCAB)
+
+
+def test_symbolic_audio_model_vocab():
+    config = SymbolicAudioModelConfig(
+        max_seq_len=MAX_SEQ_LEN, max_latents=16, num_channels=32, num_heads=4, num_self_attention_layers=1
+    )
+    assert config.vocab_size == 389
+    model = SymbolicAudioModel(config)
+    x = jnp.zeros((B, MAX_SEQ_LEN), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), x, prefix_len=16)
+    out = model.apply(params, x, prefix_len=16)
+    assert out.logits.shape == (B, 16, 389)
+
+
+def test_image_classifier_shapes():
+    config = ImageClassifierConfig(
+        encoder=ImageEncoderConfig(
+            image_shape=(14, 14, 1),
+            num_frequency_bands=8,
+            num_cross_attention_heads=1,
+            num_self_attention_heads=2,
+            num_self_attention_layers_per_block=2,
+        ),
+        decoder=ClassificationDecoderConfig(
+            num_classes=10, num_output_query_channels=32, num_cross_attention_heads=1
+        ),
+        num_latents=8,
+        num_latent_channels=16,
+    )
+    model = ImageClassifier(config)
+    x = jnp.zeros((B, 14, 14, 1))
+    params = model.init(jax.random.PRNGKey(0), x)
+    logits = model.apply(params, x)
+    assert logits.shape == (B, 10)
+
+
+def test_image_classifier_rejects_wrong_shape():
+    config = ImageClassifierConfig(
+        encoder=ImageEncoderConfig(image_shape=(14, 14, 1), num_frequency_bands=8),
+        decoder=ClassificationDecoderConfig(num_classes=10, num_output_query_channels=32),
+        num_latents=8,
+        num_latent_channels=16,
+    )
+    model = ImageClassifier(config)
+    with pytest.raises(ValueError, match="different from required shape"):
+        model.init(jax.random.PRNGKey(0), jnp.zeros((B, 16, 16, 1)))
+
+
+def test_optical_flow_shapes():
+    h, w = 16, 24
+    config = OpticalFlowConfig(
+        encoder=OpticalFlowEncoderConfig(
+            image_shape=(h, w),
+            num_patch_input_channels=5,
+            num_patch_hidden_channels=16,
+            num_frequency_bands=4,
+            num_cross_attention_heads=1,
+            num_self_attention_heads=2,
+            num_self_attention_layers_per_block=1,
+        ),
+        decoder=OpticalFlowDecoderConfig(image_shape=(h, w), num_cross_attention_heads=1),
+        num_latents=8,
+        num_latent_channels=16,
+    )
+    model = OpticalFlow(config)
+    x = jnp.zeros((B, 2, h, w, 5))
+    params = model.init(jax.random.PRNGKey(0), x)
+    flow = model.apply(params, x)
+    assert flow.shape == (B, h, w, 2)
+    # rescale_factor shrinks outputs
+    assert float(jnp.max(jnp.abs(flow))) < 1.0
+
+
+def test_weight_shared_encoder_blocks():
+    """Repeated cross-attention with sharing has the same parameter count as a
+    single layer; unshared adds parameters (reference: modules.py:579-602)."""
+    def build(first_shared):
+        cfg = TextClassifierConfig(
+            encoder=TextEncoderConfig(
+                vocab_size=VOCAB,
+                max_seq_len=MAX_SEQ_LEN,
+                num_input_channels=32,
+                num_cross_attention_layers=2,
+                num_self_attention_blocks=2,
+                first_cross_attention_layer_shared=first_shared,
+                first_self_attention_block_shared=True,
+                num_cross_attention_heads=2,
+                num_self_attention_heads=2,
+                num_self_attention_layers_per_block=1,
+            ),
+            decoder=ClassificationDecoderConfig(
+                num_classes=2, num_output_query_channels=32, num_cross_attention_heads=2
+            ),
+            num_latents=8,
+            num_latent_channels=16,
+        )
+        model = TextClassifier(cfg)
+        params = model.init(jax.random.PRNGKey(0), jnp.zeros((B, MAX_SEQ_LEN), jnp.int32), None)
+        return sum(p.size for p in jax.tree.leaves(params))
+
+    assert build(first_shared=False) > build(first_shared=True)
