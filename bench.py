@@ -1,0 +1,134 @@
+"""Benchmark: Perceiver AR causal-LM training throughput at 16k context on
+one TPU chip (the BASELINE.json north-star workload).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "tokens/sec/chip", "vs_baseline": N}
+
+``vs_baseline`` compares measured throughput against an analytic single-A100
+estimate for the same model/step (bf16 312 TFLOPS at 40% MFU — see
+ComputeEstimator parity, reference: examples/scaling/clm/scaling/flops.py).
+Values > 1.0 mean faster than the A100 estimate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def flagship_config(seq_len: int, latents: int):
+    from perceiver_io_tpu.models.text import CausalLanguageModelConfig
+
+    # byte-level Perceiver AR, the reference "small" family scaled to 16k ctx
+    return CausalLanguageModelConfig(
+        vocab_size=262,
+        max_seq_len=seq_len,
+        max_latents=latents,
+        num_channels=512,
+        num_heads=8,
+        num_self_attention_layers=8,
+        cross_attention_dropout=0.5,
+        activation_checkpointing=True,
+    )
+
+
+def train_step_flops(config, batch_size: int, prefix_dropout_keep: float) -> float:
+    """Analytic training FLOPs (fwd+bwd ~ 3x fwd matmuls), Perceiver AR cost
+    model: self-attention part over latents + cross-attention over the
+    (dropout-discounted) prefix (reference: scaling/flops.py:7-88)."""
+    lat, c, layers = config.max_latents, config.num_channels, config.num_self_attention_layers
+    prefix = (config.max_seq_len - lat) * prefix_dropout_keep
+    kv = prefix + lat
+    wf_sa, wf_ca = config.self_attention_widening_factor, config.cross_attention_widening_factor
+
+    # per-token matmul FLOPs (x2 for multiply-add)
+    ca_proj = 2 * lat * (4 * c * c) + 2 * prefix * (2 * c * c)  # q,o over latents; k,v over all kv
+    ca_attn = 2 * 2 * lat * kv * c
+    ca_mlp = 2 * lat * 2 * wf_ca * c * c
+    sa_proj = layers * 2 * lat * 4 * c * c
+    sa_attn = layers * 2 * 2 * lat * lat * c
+    sa_mlp = layers * 2 * lat * 2 * wf_sa * c * c
+    logits = 2 * lat * c * config.vocab_size
+    fwd = ca_proj + ca_attn + ca_mlp + sa_proj + sa_attn + sa_mlp + logits
+    return 3.0 * fwd * batch_size
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--seq-len", type=int, default=16384)
+    p.add_argument("--latents", type=int, default=1024)
+    p.add_argument("--batch-size", type=int, default=1)
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--dtype", default="bfloat16")
+    args = p.parse_args()
+
+    from perceiver_io_tpu.models.text import CausalLanguageModel
+    from perceiver_io_tpu.training import TrainState, clm_loss_fn, make_optimizer
+    from perceiver_io_tpu.training.loop import make_train_step
+
+    config = flagship_config(args.seq_len, args.latents)
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    model = CausalLanguageModel(config, dtype=dtype)
+
+    b, n = args.batch_size, args.seq_len
+    rng = np.random.default_rng(0)
+    t = rng.integers(0, config.vocab_size, size=(b, n + 1))
+    # next-token contract: inputs/labels shifted by one (reference: c4.py:161-162)
+    batch = {
+        "labels": jnp.asarray(t[:, 1:]),
+        "input_ids": jnp.asarray(t[:, :-1]),
+        "pad_mask": jnp.zeros((b, n), bool),
+    }
+
+    prefix_len = n - args.latents
+    params = model.init(jax.random.PRNGKey(0), x[:, : args.latents + 1], prefix_len=1)
+    n_params = sum(p.size for p in jax.tree.leaves(params))
+
+    tx = make_optimizer(1e-3, gradient_clip=1.0)
+    state = TrainState.create(model.apply, params, tx, jax.random.PRNGKey(1))
+    step = make_train_step(clm_loss_fn(model.apply, max_latents=args.latents))
+
+    # compile + warmup. NOTE: through the axon tunnel block_until_ready is a
+    # no-op and every host fetch costs a fixed ~70ms round trip, so we time
+    # two different chain lengths and take the slope — the fixed latency and
+    # dispatch overhead cancel.
+    state, metrics = step(state, batch)
+    float(metrics["loss"])
+
+    def run_chain(k):
+        nonlocal state
+        t0 = time.perf_counter()
+        for _ in range(k):
+            state, metrics = step(state, batch)
+        float(metrics["loss"])  # forces completion of the whole chain
+        return time.perf_counter() - t0
+
+    run_chain(1)  # extra warmup
+    n_short, n_long = 2, 2 + args.steps
+    t_short = min(run_chain(n_short) for _ in range(2))
+    t_long = min(run_chain(n_long) for _ in range(2))
+    step_time = max((t_long - t_short) / (n_long - n_short), 1e-9)
+    tokens_per_sec = b * n / step_time
+
+    # analytic A100 reference: same step at 312 TFLOPS bf16, 40% MFU
+    flops = train_step_flops(config, b, prefix_dropout_keep=0.5)
+    a100_step_time = flops / (312e12 * 0.40)
+    vs_baseline = a100_step_time / step_time
+
+    result = {
+        "metric": f"perceiver-ar-clm train tokens/sec/chip @{args.seq_len} ctx "
+        f"({n_params/1e6:.1f}M params, {args.dtype}, prefix_len={prefix_len})",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(vs_baseline, 3),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,184 @@
+"""Autoregressive generation with KV caches and a sliding window.
+
+Behavioral parity with the reference's HF generation integration
+(reference: perceiver/model/core/huggingface.py:89-230):
+
+- A prompt of length S with ``num_latents`` initial latents sets
+  ``prefix_len = S - num_latents``; the first forward populates the caches.
+- Each new token appends to the caches; the number of latents grows until
+  ``max_latents``, then the prefix grows until ``max_prefix_len``.
+- When the self-attention caches are full they are truncated to
+  ``max_latents - 1`` (huggingface.py:152-156); when the total window reaches
+  ``max_seq_len`` the cross-attention cache is truncated to
+  ``max_seq_len - 1`` (huggingface.py:146-150), emulating unbounded
+  generation.
+
+TPU-first: caches are fixed-capacity buffers, so "truncate the oldest" is a
+conditional roll-left (`lax.cond` + `jnp.roll`) and the whole decode loop is
+ONE compiled `lax.scan` — no per-step retracing at any fill level. Sampling
+covers greedy, temperature, top-k and top-p (the reference's exercised
+strategies, SURVEY §7.3).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from perceiver_io_tpu.core.attention import KVCache
+
+
+@dataclass
+class GenerationConfig:
+    max_new_tokens: int = 64
+    do_sample: bool = False
+    temperature: float = 1.0
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    eos_token_id: Optional[int] = None
+    pad_token_id: int = 0
+
+
+def _shift_left_if_full(cache: KVCache) -> KVCache:
+    """Drop the oldest slot when the cache is full (the fixed-capacity analog
+    of the reference's ``[:, -max_len+1:]`` truncation)."""
+
+    def shift(c):
+        return KVCache(
+            k=jnp.roll(c.k, -1, axis=1), v=jnp.roll(c.v, -1, axis=1), length=c.length - 1
+        )
+
+    full = cache.length >= cache.capacity
+    return lax.cond(full, shift, lambda c: c, cache)
+
+
+def _sample(logits: jnp.ndarray, rng: jax.Array, config: GenerationConfig) -> jnp.ndarray:
+    """Sample next-token ids from (B, V) logits."""
+    if not config.do_sample:
+        return jnp.argmax(logits, axis=-1)
+
+    logits = logits.astype(jnp.float32) / jnp.maximum(config.temperature, 1e-6)
+
+    if config.top_k is not None:
+        top_k = min(config.top_k, logits.shape[-1])
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+
+    if config.top_p is not None:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # number of tokens needed to reach top_p mass (at least 1)
+        cutoff_idx = jnp.sum(cum < config.top_p, axis=-1, keepdims=True)
+        cutoff_logit = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+        logits = jnp.where(logits < cutoff_logit, -jnp.inf, logits)
+
+    return jax.random.categorical(rng, logits, axis=-1)
+
+
+def generate(
+    model,
+    params,
+    input_ids: jnp.ndarray,
+    num_latents: int = 1,
+    pad_mask: Optional[jnp.ndarray] = None,
+    config: Optional[GenerationConfig] = None,
+    rng: Optional[jax.Array] = None,
+    cache_dtype=jnp.float32,
+) -> jnp.ndarray:
+    """Generate ``config.max_new_tokens`` continuation tokens.
+
+    :param model: a ``CausalSequenceModel`` (or subclass).
+    :param input_ids: left-padded prompt (B, S).
+    :param num_latents: initial number of latent positions at the end of the
+        prompt (reference: huggingface.py:187-230).
+    :param pad_mask: boolean (B, S), True at (left) padding.
+    :return: (B, S + max_new_tokens) sequence including the prompt.
+    """
+    config = config or GenerationConfig()
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    mcfg = model.config
+    b, seq_len = input_ids.shape
+
+    if config.max_new_tokens <= 0:
+        return input_ids
+
+    if not 0 < seq_len <= mcfg.max_seq_len:
+        raise ValueError(f"Input sequence length out of valid range [1..{mcfg.max_seq_len}]")
+    if not 0 < num_latents <= mcfg.max_latents:
+        raise ValueError(f"num_latents={num_latents} out of valid range [1..{mcfg.max_latents}]")
+    num_latents = min(seq_len, num_latents)
+    prefix_len = seq_len - num_latents
+    max_prefix_len = mcfg.max_seq_len - mcfg.max_latents
+    if prefix_len > max_prefix_len:
+        num_latents_min = num_latents + prefix_len - max_prefix_len
+        raise ValueError(
+            f"For given sequence of length={seq_len}, num_latents must "
+            f"be in range [{num_latents_min}..{mcfg.max_latents}]"
+        )
+
+    from perceiver_io_tpu.core.modules import CausalSequenceModel
+
+    cache = CausalSequenceModel.init_cache(mcfg, b, dtype=cache_dtype)
+    ca_capacity = cache[0].capacity
+
+    if pad_mask is None:
+        pad_mask = jnp.zeros((b, seq_len), bool)
+
+    # slot-aligned pad mask over the cross-attention window
+    pad_slots = jnp.zeros((b, ca_capacity), bool).at[:, :seq_len].set(pad_mask)
+
+    # prompt pass (populates caches)
+    out = model.apply(params, input_ids, prefix_len=prefix_len, pad_mask=pad_mask, kv_cache=cache)
+    rng, first_rng = jax.random.split(rng)
+    next_token = _sample(out.logits[:, -1], first_rng, config)
+    cache = out.kv_cache
+
+    def step(carry, _):
+        cache, pad_slots, token, rng, done = carry
+        ca_cache, sa_caches = cache[0], cache[1:]
+
+        # slide: drop the oldest latent when the SA window is full, the oldest
+        # window position (incl. its pad-mask slot) when the CA window is full
+        ca_was_full = ca_cache.length >= ca_cache.capacity
+        pad_slots = lax.cond(
+            ca_was_full,
+            lambda p: jnp.roll(p, -1, axis=1).at[:, -1].set(False),
+            lambda p: p,
+            pad_slots,
+        )
+        ca_cache = _shift_left_if_full(ca_cache)
+        sa_caches = tuple(_shift_left_if_full(c) for c in sa_caches)
+        cache = (ca_cache,) + sa_caches
+
+        out = model.apply(
+            params,
+            token[:, None],
+            prefix_len=0,
+            pad_mask=pad_slots,
+            kv_cache=cache,
+            decode=True,
+        )
+        rng, step_rng = jax.random.split(rng)
+        sampled = _sample(out.logits[:, -1], step_rng, config)
+        if config.eos_token_id is not None:
+            sampled = jnp.where(done, config.pad_token_id, sampled)
+            done = done | (sampled == config.eos_token_id)
+        return (out.kv_cache, pad_slots, sampled, rng, done), sampled
+
+    done0 = jnp.zeros((b,), bool)
+    if config.eos_token_id is not None:
+        done0 = next_token == config.eos_token_id
+
+    if config.max_new_tokens > 1:
+        carry = (cache, pad_slots, next_token, rng, done0)
+        _, tokens = lax.scan(step, carry, None, length=config.max_new_tokens - 1)
+        tokens = jnp.concatenate([next_token[:, None], tokens.T], axis=1)
+    else:
+        tokens = next_token[:, None]
+
+    return jnp.concatenate([input_ids, tokens], axis=1)

@@ -1,0 +1,104 @@
+"""Device mesh and sharding rules — the TPU-native replacement for the
+reference's DDP/FSDP/NCCL strategies (SURVEY §2.7).
+
+One SPMD program over a named `jax.sharding.Mesh`; XLA GSPMD inserts the
+collectives over ICI:
+
+- **Data parallel** (reference: Lightning DDPStrategy,
+  perceiver/scripts/cli.py:32-33, trainer.yaml:14): batch sharded over the
+  ``data`` (and ``fsdp``) axes; gradient all-reduce is implicit.
+- **FSDP / ZeRO-3** (reference: FSDPStrategy + transformer_auto_wrap_policy,
+  perceiver/scripts/text/clm_fsdp.py:24-36): parameters and optimizer state
+  sharded along ``fsdp`` via NamedSharding; XLA all-gathers weights per layer
+  and reduce-scatters gradients.
+- ``tensor``/``seq`` axes are reserved for tensor and sequence/context
+  parallelism (beyond reference parity; the reference has neither — SURVEY
+  §2.7 P8).
+
+Multi-host: initialize with ``jax.distributed.initialize()``; every host runs
+the same program and feeds its per-process batch shard
+(`jax.make_array_from_process_local_data`), replacing the reference's
+``split_dataset_by_node`` (perceiver/data/text/c4.py:76-79).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS_DATA = "data"
+AXIS_FSDP = "fsdp"
+AXIS_TENSOR = "tensor"
+AXIS_SEQ = "seq"
+
+MESH_AXES = (AXIS_DATA, AXIS_FSDP, AXIS_TENSOR, AXIS_SEQ)
+
+
+def make_mesh(
+    data: Optional[int] = None,
+    fsdp: int = 1,
+    tensor: int = 1,
+    seq: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a 4-axis mesh (data, fsdp, tensor, seq). ``data=None`` absorbs
+    all remaining devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    fixed = fsdp * tensor * seq
+    if data is None:
+        if n % fixed != 0:
+            raise ValueError(f"{n} devices not divisible by fsdp*tensor*seq={fixed}")
+        data = n // fixed
+    if data * fixed != n:
+        raise ValueError(f"mesh {data}x{fsdp}x{tensor}x{seq} != {n} devices")
+    dev_array = np.asarray(devices).reshape(data, fsdp, tensor, seq)
+    return Mesh(dev_array, MESH_AXES)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, ndim: int = 2) -> NamedSharding:
+    """Shard the leading (batch) dim over data and fsdp axes — the standard
+    JAX zero-style layout where fsdp also contributes data parallelism."""
+    return NamedSharding(mesh, P((AXIS_DATA, AXIS_FSDP), *([None] * (ndim - 1))))
+
+
+def shard_batch(batch, mesh: Mesh):
+    """Device-put a host batch pytree with leading-dim sharding."""
+    return jax.tree.map(
+        lambda x: jax.device_put(x, batch_sharding(mesh, ndim=np.ndim(x))), batch
+    )
+
+
+def _fsdp_spec(shape, fsdp_size: int, min_weight_size: int) -> P:
+    """Choose the largest axis divisible by the fsdp size; replicate small
+    parameters (the per-layer wrap-policy analog of the reference's
+    transformer_auto_wrap_policy over attention layers, clm_fsdp.py:29-36)."""
+    if fsdp_size <= 1 or math.prod(shape) < min_weight_size:
+        return P()
+    # prefer the last axis, then earlier ones, by size
+    order = sorted(range(len(shape)), key=lambda i: (shape[i], i), reverse=True)
+    for i in order:
+        if shape[i] % fsdp_size == 0:
+            spec = [None] * len(shape)
+            spec[i] = AXIS_FSDP
+            return P(*spec)
+    return P()
+
+
+def fsdp_param_shardings(params, mesh: Mesh, min_weight_size: int = 2**14):
+    """NamedSharding pytree for parameters (and, by shape, optimizer state):
+    each large-enough tensor is sharded along its largest fsdp-divisible axis."""
+    fsdp_size = mesh.shape[AXIS_FSDP]
+
+    def spec_for(x):
+        return NamedSharding(mesh, _fsdp_spec(np.shape(x), fsdp_size, min_weight_size))
+
+    return jax.tree.map(spec_for, params)

@@ -1,0 +1,12 @@
+from perceiver_io_tpu.training.optim import (
+    constant_with_warmup,
+    cosine_with_warmup,
+    make_optimizer,
+)
+from perceiver_io_tpu.training.state import TrainState
+from perceiver_io_tpu.training.losses import (
+    classification_loss_fn,
+    clm_loss_fn,
+    masked_lm_loss_fn,
+    mse_loss_fn,
+)

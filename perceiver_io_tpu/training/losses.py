@@ -1,0 +1,103 @@
+"""Task loss functions, mirroring the reference Lightning steps.
+
+Each loss_fn has signature ``(apply_fn) -> (params, batch, rng) ->
+(loss, metrics)`` so the generic train step can differentiate it.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+IGNORE_INDEX = -100  # torch CrossEntropyLoss ignore_index parity
+
+
+def _cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Mean CE over labels != IGNORE_INDEX. Returns (loss, num_valid)."""
+    valid = labels != IGNORE_INDEX
+    safe_labels = jnp.where(valid, labels, 0)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+    num_valid = valid.sum()
+    loss = jnp.where(valid, nll, 0.0).sum() / jnp.maximum(num_valid, 1)
+    return loss, num_valid
+
+
+def classification_loss_fn(apply_fn) -> Callable:
+    """CE + accuracy over ``{"x" | "image", "label"}`` batches
+    (reference: perceiver/model/core/lightning.py:47-77)."""
+
+    def loss_fn(params, batch: Dict, rng) -> Tuple[jnp.ndarray, Dict]:
+        x = batch.get("x", batch.get("image"))
+        y = batch["label"]
+        pad_mask = batch.get("pad_mask")
+        kwargs = {} if pad_mask is None else {"pad_mask": pad_mask}
+        logits = apply_fn(params, x, deterministic=False, rngs={"dropout": rng}, **kwargs)
+        loss, _ = _cross_entropy(logits, y)
+        acc = jnp.mean((jnp.argmax(logits, axis=-1) == y).astype(jnp.float32))
+        return loss, {"loss": loss, "acc": acc}
+
+    return loss_fn
+
+
+def masked_lm_loss_fn(apply_fn) -> Callable:
+    """CE over masked positions only: labels are IGNORE_INDEX except where a
+    token was masked (reference: perceiver/model/text/mlm/lightning.py:45-60)."""
+
+    def loss_fn(params, batch: Dict, rng) -> Tuple[jnp.ndarray, Dict]:
+        logits = apply_fn(
+            params,
+            batch["input_ids"],
+            pad_mask=batch.get("pad_mask"),
+            deterministic=False,
+            rngs={"dropout": rng},
+        )
+        loss, num_masked = _cross_entropy(logits, batch["labels"])
+        return loss, {"loss": loss, "num_masked": num_masked}
+
+    return loss_fn
+
+
+def clm_loss_fn(apply_fn, max_latents: int) -> Callable:
+    """Causal LM loss: pads are ignored, prefix_len = seq_len - max_latents,
+    CE over the last ``max_latents`` logits
+    (reference: perceiver/model/core/lightning.py:117-133).
+
+    Contract: the data pipeline pre-shifts targets — ``input_ids = t[:, :-1]``
+    and ``labels = t[:, 1:]`` for a raw token window ``t``
+    (reference: perceiver/data/text/c4.py:161-162); this function does NOT
+    shift."""
+
+    def loss_fn(params, batch, rng) -> Tuple[jnp.ndarray, Dict]:
+        labels, x, pad_mask = batch["labels"], batch["input_ids"], batch["pad_mask"]
+        seq_len = x.shape[1]
+        if seq_len < max_latents:
+            raise ValueError(f"Training sequence length must be at least {max_latents} (= max_latents)")
+        labels = jnp.where(pad_mask, IGNORE_INDEX, labels)
+        out = apply_fn(
+            params,
+            x,
+            prefix_len=seq_len - max_latents,
+            pad_mask=pad_mask,
+            deterministic=False,
+            rngs={"dropout": rng},
+        )
+        logits = out.logits
+        labels = labels[:, -logits.shape[1] :]
+        loss, _ = _cross_entropy(logits, labels)
+        return loss, {"loss": loss}
+
+    return loss_fn
+
+
+def mse_loss_fn(apply_fn) -> Callable:
+    """MSE for regression tasks (time-series app, reference: model.py:16-114)."""
+
+    def loss_fn(params, batch: Dict, rng) -> Tuple[jnp.ndarray, Dict]:
+        pred = apply_fn(params, batch["x"], deterministic=False, rngs={"dropout": rng})
+        loss = jnp.mean((pred - batch["y"]) ** 2)
+        return loss, {"loss": loss}
+
+    return loss_fn

@@ -1,0 +1,82 @@
+"""Optimizers and LR schedules.
+
+Schedule semantics match the reference's LambdaLR schedulers
+(reference: perceiver/scripts/lrs.py:7-38); optimizers cover the reference's
+AdamW + torch_optimizer extras (Lamb) via optax; gradient clipping and
+accumulation replace ``--trainer.gradient_clip_val`` /
+``--trainer.accumulate_grad_batches`` (SURVEY §2.7 P6).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Union
+
+import optax
+
+
+def cosine_with_warmup(
+    base_lr: float,
+    training_steps: int,
+    warmup_steps: int = 0,
+    num_cycles: float = 0.5,
+    min_fraction: float = 0.0,
+) -> optax.Schedule:
+    """Linear warmup then cosine decay to ``min_fraction * base_lr``
+    (reference: lrs.py:7-29)."""
+
+    def schedule(step):
+        import jax.numpy as jnp
+
+        step = jnp.asarray(step, jnp.float32)
+        warmup = step / max(1, warmup_steps)
+        progress = (step - warmup_steps) / max(1, training_steps - warmup_steps)
+        cosine = min_fraction + jnp.maximum(
+            0.0, 0.5 * (1.0 - min_fraction) * (1.0 + jnp.cos(math.pi * num_cycles * 2.0 * progress))
+        )
+        return base_lr * jnp.where(step < warmup_steps, warmup, cosine)
+
+    return schedule
+
+
+def constant_with_warmup(base_lr: float, warmup_steps: int = 0) -> optax.Schedule:
+    """Linear warmup then constant (reference: lrs.py:32-38)."""
+
+    def schedule(step):
+        import jax.numpy as jnp
+
+        step = jnp.asarray(step, jnp.float32)
+        return base_lr * jnp.minimum(1.0, step / max(1, warmup_steps))
+
+    return schedule
+
+
+def make_optimizer(
+    learning_rate: Union[float, optax.Schedule],
+    optimizer: str = "adamw",
+    weight_decay: float = 0.01,
+    beta1: float = 0.9,
+    beta2: float = 0.999,
+    gradient_clip: Optional[float] = None,
+    accumulate_grad_batches: int = 1,
+) -> optax.GradientTransformation:
+    if optimizer == "adamw":
+        tx = optax.adamw(learning_rate, b1=beta1, b2=beta2, weight_decay=weight_decay)
+    elif optimizer == "adam":
+        tx = optax.adam(learning_rate, b1=beta1, b2=beta2)
+    elif optimizer == "lamb":
+        tx = optax.lamb(learning_rate, b1=beta1, b2=beta2, weight_decay=weight_decay)
+    elif optimizer == "sgd":
+        tx = optax.sgd(learning_rate)
+    else:
+        raise ValueError(f"unknown optimizer: {optimizer}")
+
+    parts = []
+    if gradient_clip is not None:
+        parts.append(optax.clip_by_global_norm(gradient_clip))
+    parts.append(tx)
+    tx = optax.chain(*parts) if len(parts) > 1 else tx
+
+    if accumulate_grad_batches > 1:
+        tx = optax.MultiSteps(tx, every_k_schedule=accumulate_grad_batches)
+    return tx

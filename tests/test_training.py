@@ -1,0 +1,161 @@
+"""Training loop: loss decreases on a learnable toy task; FSDP/data-parallel
+sharding compiles and runs on a virtual 8-device mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from perceiver_io_tpu.core.config import ClassificationDecoderConfig
+from perceiver_io_tpu.models.text import CausalLanguageModel, CausalLanguageModelConfig
+from perceiver_io_tpu.models.vision import ImageClassifier, ImageClassifierConfig, ImageEncoderConfig
+from perceiver_io_tpu.parallel import fsdp_param_shardings, make_mesh, shard_batch
+from perceiver_io_tpu.training import (
+    TrainState,
+    classification_loss_fn,
+    clm_loss_fn,
+    constant_with_warmup,
+    cosine_with_warmup,
+    make_optimizer,
+)
+from perceiver_io_tpu.training.loop import make_train_step, shard_train_state
+
+
+def small_classifier():
+    config = ImageClassifierConfig(
+        encoder=ImageEncoderConfig(
+            image_shape=(8, 8, 1),
+            num_frequency_bands=4,
+            num_cross_attention_heads=1,
+            num_self_attention_heads=2,
+            num_self_attention_layers_per_block=1,
+        ),
+        decoder=ClassificationDecoderConfig(
+            num_classes=2, num_output_query_channels=16, num_cross_attention_heads=1
+        ),
+        num_latents=4,
+        num_latent_channels=16,
+    )
+    return ImageClassifier(config)
+
+
+def toy_batch(n=32):
+    """Learnable task: label = whether the mean pixel is positive."""
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, 8, 8, 1)).astype(np.float32)
+    x += rng.choice([-1.0, 1.0], size=(n, 1, 1, 1))
+    y = (x.mean(axis=(1, 2, 3)) > 0).astype(np.int32)
+    return {"image": jnp.asarray(x), "label": jnp.asarray(y)}
+
+
+def test_schedules():
+    cos = cosine_with_warmup(1.0, training_steps=100, warmup_steps=10, min_fraction=0.1)
+    assert float(cos(0)) == 0.0
+    assert float(cos(5)) == pytest.approx(0.5)
+    assert float(cos(10)) == pytest.approx(1.0)
+    assert float(cos(100)) == pytest.approx(0.1, abs=1e-6)
+    const = constant_with_warmup(2.0, warmup_steps=4)
+    assert float(const(2)) == pytest.approx(1.0)
+    assert float(const(50)) == pytest.approx(2.0)
+
+
+def test_classifier_learns():
+    model = small_classifier()
+    batch = toy_batch()
+    params = model.init(jax.random.PRNGKey(0), batch["image"])
+    tx = make_optimizer(3e-3, gradient_clip=1.0)
+    state = TrainState.create(model.apply, params, tx, jax.random.PRNGKey(1))
+    step = make_train_step(classification_loss_fn(model.apply))
+
+    first_loss = None
+    for _ in range(40):
+        state, metrics = step(state, batch)
+        if first_loss is None:
+            first_loss = float(metrics["loss"])
+    assert float(metrics["loss"]) < first_loss * 0.1
+    assert float(metrics["acc"]) > 0.9
+    assert int(state.step) == 40
+
+
+def test_clm_train_step_runs():
+    config = CausalLanguageModelConfig(
+        vocab_size=50, max_seq_len=24, max_latents=8, num_channels=32,
+        num_heads=4, num_self_attention_layers=2, cross_attention_dropout=0.5,
+    )
+    model = CausalLanguageModel(config)
+    rng = np.random.default_rng(0)
+    t = rng.integers(0, 50, size=(4, 25))
+    x = jnp.asarray(t[:, :-1])
+    pad = jnp.zeros((4, 24), bool)
+    batch = {"labels": jnp.asarray(t[:, 1:]), "input_ids": x, "pad_mask": pad}
+    params = model.init(jax.random.PRNGKey(0), x, prefix_len=16)
+    tx = make_optimizer(1e-3)
+    state = TrainState.create(model.apply, params, tx, jax.random.PRNGKey(1))
+    step = make_train_step(clm_loss_fn(model.apply, max_latents=8))
+    state, metrics = step(state, batch)
+    loss0 = float(metrics["loss"])
+    state, metrics = step(state, batch)
+    assert np.isfinite(loss0) and np.isfinite(float(metrics["loss"]))
+    # near-uniform init: loss ~ log(vocab)
+    assert loss0 == pytest.approx(np.log(50), rel=0.3)
+
+
+def test_clm_rejects_short_sequences():
+    config = CausalLanguageModelConfig(
+        vocab_size=50, max_seq_len=24, max_latents=16, num_channels=32,
+        num_heads=4, num_self_attention_layers=1,
+    )
+    model = CausalLanguageModel(config)
+    loss = clm_loss_fn(model.apply, max_latents=16)
+    batch = {
+        "labels": jnp.zeros((1, 8), jnp.int32),
+        "input_ids": jnp.zeros((1, 8), jnp.int32),
+        "pad_mask": jnp.zeros((1, 8), bool),
+    }
+    with pytest.raises(ValueError, match="at least 16"):
+        loss(None, batch, jax.random.PRNGKey(0))
+
+
+@pytest.mark.parametrize("mesh_shape", [{"data": 8}, {"data": 2, "fsdp": 4}, {"fsdp": 8}])
+def test_sharded_training(mesh_shape):
+    """DDP / FSDP / hybrid parity: one SPMD program over an 8-device mesh
+    (replaces reference DDPStrategy + FSDPStrategy, SURVEY §2.7 P1-P2)."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    mesh = make_mesh(**mesh_shape)
+
+    model = small_classifier()
+    batch = toy_batch(n=16)
+    params = model.init(jax.random.PRNGKey(0), batch["image"])
+    tx = make_optimizer(1e-3, gradient_clip=1.0)
+    state = TrainState.create(model.apply, params, tx, jax.random.PRNGKey(1))
+    state = shard_train_state(state, mesh, min_weight_size=0)
+    batch = shard_batch(batch, mesh)
+
+    step = make_train_step(classification_loss_fn(model.apply))
+    state, metrics = step(state, batch)
+    state, metrics = step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+
+    if mesh.shape["fsdp"] > 1:
+        # at least one parameter is actually sharded over fsdp
+        shardings = jax.tree.leaves(fsdp_param_shardings(state.params, mesh, min_weight_size=0))
+        assert any("fsdp" in str(s.spec) for s in shardings)
+        placed = [p.sharding for p in jax.tree.leaves(state.params)]
+        assert any("fsdp" in str(s.spec) for s in placed if hasattr(s, "spec"))
+
+
+def test_gradient_accumulation():
+    model = small_classifier()
+    batch = toy_batch(n=8)
+    params = model.init(jax.random.PRNGKey(0), batch["image"])
+    tx = make_optimizer(1e-3, accumulate_grad_batches=4)
+    state = TrainState.create(model.apply, params, tx, jax.random.PRNGKey(1))
+    step = make_train_step(classification_loss_fn(model.apply))
+    p0 = jax.tree.leaves(state.params)[0].copy()
+    for i in range(3):
+        state, _ = step(state, batch)
+    # parameters unchanged until the 4th micro-step
+    np.testing.assert_array_equal(np.asarray(jax.tree.leaves(state.params)[0]), np.asarray(p0))
+    state, _ = step(state, batch)
+    assert not np.array_equal(np.asarray(jax.tree.leaves(state.params)[0]), np.asarray(p0))
