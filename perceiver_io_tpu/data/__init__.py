@@ -1,0 +1,1 @@
+from perceiver_io_tpu.data.loader import Batches, shard_indices_for_process

@@ -1,0 +1,2 @@
+from perceiver_io_tpu.data.audio.midi import Note, decode_events, encode_notes
+from perceiver_io_tpu.data.audio.symbolic import SymbolicAudioDataModule

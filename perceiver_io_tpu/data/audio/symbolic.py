@@ -1,0 +1,186 @@
+"""Symbolic audio data module: MIDI files -> flat int16 token memmap with
+example separators -> random-window sampling -> shifted batches.
+
+Behavioral parity with the reference
+(reference: perceiver/data/audio/symbolic.py:16-232): separator id -1, PAD
+388, vocab 389; each sample draws a random window of max_seq_len+1 tokens,
+keeps the longest separator-free piece, optionally truncates to a random
+length in [min_seq_len, max_seq_len]; the collator left/right-pads to
+max_seq_len+1 and emits shifted (labels, input_ids, pad_mask)."""
+
+from __future__ import annotations
+
+import os
+import random
+from pathlib import Path
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from perceiver_io_tpu.data.audio.midi import PAD_ID, VOCAB_SIZE, encode_midi_files
+from perceiver_io_tpu.data.loader import Batches
+
+EXAMPLE_SEPARATOR = -1
+
+
+class SymbolicAudioNumpyDataset:
+    """(reference: symbolic.py:160-190)"""
+
+    def __init__(
+        self,
+        data: np.ndarray,
+        max_seq_len: int,
+        min_seq_len: Optional[int] = None,
+        seed: int = 0,
+    ):
+        self._data = data
+        self._max_seq_len = max_seq_len
+        self._min_seq_len = min_seq_len
+        self._rng = np.random.default_rng(seed)
+        self._length = self._data.shape[0] // self._max_seq_len
+
+    def __len__(self):
+        return self._length
+
+    def __getitem__(self, index) -> Dict[str, np.ndarray]:
+        start = int(self._rng.integers(0, self._data.shape[0] - self._max_seq_len))
+        sample = np.asarray(self._data[start : start + self._max_seq_len], dtype=np.int64)
+
+        if EXAMPLE_SEPARATOR in sample:
+            pieces = np.split(sample, np.where(sample == EXAMPLE_SEPARATOR)[0])
+            example = max(pieces, key=len)
+            example = example[example != EXAMPLE_SEPARATOR]
+        else:
+            example = sample
+
+        if self._min_seq_len is not None and self._min_seq_len < len(example):
+            chunk_length = int(self._rng.integers(self._min_seq_len, self._max_seq_len))
+            example = example[:chunk_length]
+        return {"input_ids": example}
+
+
+class SymbolicAudioCollator:
+    """Pad to max_seq_len+1 then shift (reference: symbolic.py:193-232)."""
+
+    def __init__(self, max_seq_len: int, pad_token: int = PAD_ID, padding_side: str = "left"):
+        if padding_side not in ("left", "right"):
+            raise ValueError(f"Invalid padding side '{padding_side}'")
+        self._max_seq_len = max_seq_len
+        self._pad_token = pad_token
+        self._padding_side = padding_side
+
+    def __call__(self, examples: List[Dict]) -> Dict[str, np.ndarray]:
+        n = len(examples)
+        ids = np.full((n, self._max_seq_len), self._pad_token, dtype=np.int32)
+        for r, e in enumerate(examples):
+            seq = np.asarray(e["input_ids"])[: self._max_seq_len]
+            if self._padding_side == "left":
+                ids[r, self._max_seq_len - len(seq) :] = seq
+            else:
+                ids[r, : len(seq)] = seq
+        pad_mask = ids == self._pad_token
+        return {
+            "labels": ids[:, 1:],
+            "input_ids": ids[:, :-1],
+            "pad_mask": pad_mask[:, :-1],
+        }
+
+
+class SymbolicAudioDataModule:
+    _VOCAB_SIZE = VOCAB_SIZE
+
+    def __init__(
+        self,
+        dataset_dir: str,
+        max_seq_len: int,
+        min_seq_len: Optional[int] = None,
+        padding_side: str = "left",
+        batch_size: int = 16,
+        preproc_workers: int = 1,
+        seed: int = 0,
+    ):
+        if min_seq_len is not None and not (0 < min_seq_len < max_seq_len):
+            raise ValueError(
+                "Invalid data configuration supplied. "
+                "Parameter 'min_seq_len' must adhere to 0 < min_seq_len < max_seq_len."
+            )
+        self.dataset_dir = Path(dataset_dir)
+        self.max_seq_len = max_seq_len
+        self.min_seq_len = min_seq_len
+        self.padding_side = padding_side
+        self.batch_size = batch_size
+        self.preproc_workers = preproc_workers
+        self.seed = seed
+        self._collator = SymbolicAudioCollator(
+            max_seq_len=max_seq_len + 1, pad_token=PAD_ID, padding_side=padding_side
+        )
+
+    @property
+    def vocab_size(self):
+        return self._VOCAB_SIZE
+
+    @property
+    def preproc_dir(self) -> Path:
+        return self.dataset_dir / "preproc"
+
+    @property
+    def train_data_file(self) -> Path:
+        return self.preproc_dir / "train.bin"
+
+    @property
+    def valid_data_file(self) -> Path:
+        return self.preproc_dir / "valid.bin"
+
+    def load_source_dataset(self) -> Dict[str, Path]:
+        """Return {"train": dir, "valid": dir} of directories with .mid files.
+        Override in dataset-specific subclasses (GiantMIDI, Maestro)."""
+        raise NotImplementedError(
+            "`load_source_dataset` must return a dictionary with keys 'train' and 'valid'."
+        )
+
+    def prepare_data(self) -> None:
+        if os.path.exists(self.preproc_dir):
+            return
+        dataset = self.load_source_dataset()
+        encoded = {}
+        for split in ("train", "valid"):
+            d = Path(dataset[split])
+            if not d.exists():
+                raise ValueError(f"Invalid directory supplied. Directory '{d}' does not exist.")
+            files = list(d.rglob("**/*.mid")) + list(d.rglob("**/*.midi"))
+            encoded[split] = encode_midi_files(files, num_workers=self.preproc_workers)
+
+        random.Random(self.seed).shuffle(encoded["train"])
+        self.preproc_dir.mkdir(parents=True)
+        for split, target in (("train", self.train_data_file), ("valid", self.valid_data_file)):
+            flat = np.concatenate(
+                [np.append(ids, [EXAMPLE_SEPARATOR]) for ids in encoded[split]]
+            ).astype(np.int16)
+            fp = np.memmap(str(target), dtype=np.int16, mode="w+", shape=flat.shape)
+            fp[:] = flat[:]
+            fp.flush()
+
+    def _dataset(self, data_file: Path, train: bool) -> SymbolicAudioNumpyDataset:
+        data = np.memmap(str(data_file), dtype=np.int16, mode="r")
+        return SymbolicAudioNumpyDataset(
+            data,
+            max_seq_len=self.max_seq_len + 1,
+            min_seq_len=self.min_seq_len + 1 if (train and self.min_seq_len) else None,
+            seed=self.seed if train else self.seed + 10_000,
+        )
+
+    def train_batches(self) -> Batches:
+        return Batches(
+            self._dataset(self.train_data_file, train=True),
+            batch_size=self.batch_size,
+            shuffle=False,  # windows are already random
+            collate=self._collator,
+        )
+
+    def valid_batches(self) -> Batches:
+        return Batches(
+            self._dataset(self.valid_data_file, train=False),
+            batch_size=self.batch_size,
+            shuffle=False,
+            collate=self._collator,
+        )

@@ -1,0 +1,84 @@
+"""Host-side batch iteration feeding the JAX train loop.
+
+The reference uses torch DataLoader worker processes (process boundary #2 in
+SURVEY §3.1); here batches are numpy pytrees produced on the host and fed to
+jitted steps — tokenization for the byte-level models is trivially cheap, and
+heavy preprocessing is done once and cached (see the data modules).
+Per-process sharding replaces ``split_dataset_by_node``
+(reference: perceiver/data/text/c4.py:76-79).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+
+def shard_indices_for_process(
+    n: int, process_index: Optional[int] = None, process_count: Optional[int] = None
+) -> np.ndarray:
+    """Contiguous per-host shard of dataset indices (multi-host data
+    parallelism, SURVEY §2.7 P7)."""
+    import jax
+
+    pi = jax.process_index() if process_index is None else process_index
+    pc = jax.process_count() if process_count is None else process_count
+    per = n // pc
+    return np.arange(pi * per, (pi + 1) * per)
+
+
+class Batches:
+    """Iterate a map-style dataset in (optionally shuffled) batches.
+
+    :param dataset: supports ``len()`` and integer ``[i]`` returning an
+        example (dict of arrays / scalars).
+    :param collate: maps a list of examples to a batch pytree; default stacks.
+    """
+
+    def __init__(
+        self,
+        dataset,
+        batch_size: int,
+        shuffle: bool = False,
+        collate: Optional[Callable] = None,
+        drop_last: bool = True,
+        seed: int = 0,
+        shard_for_processes: bool = False,
+    ):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.collate = collate or default_collate
+        self.drop_last = drop_last
+        self.seed = seed
+        self.epoch = 0
+        self.shard_for_processes = shard_for_processes
+
+    def __len__(self):
+        n = len(self._indices())
+        return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
+
+    def _indices(self) -> np.ndarray:
+        if self.shard_for_processes:
+            return shard_indices_for_process(len(self.dataset))
+        return np.arange(len(self.dataset))
+
+    def __iter__(self):
+        indices = self._indices()
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            indices = rng.permutation(indices)
+        self.epoch += 1
+        end = len(indices) - self.batch_size + 1 if self.drop_last else len(indices)
+        for start in range(0, max(end, 0), self.batch_size):
+            batch = [self.dataset[int(i)] for i in indices[start : start + self.batch_size]]
+            yield self.collate(batch)
+
+
+def default_collate(examples: Sequence[dict]) -> dict:
+    out = {}
+    for key in examples[0]:
+        vals = [np.asarray(e[key]) for e in examples]
+        out[key] = np.stack(vals, axis=0)
+    return out

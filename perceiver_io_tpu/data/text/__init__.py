@@ -1,0 +1,8 @@
+from perceiver_io_tpu.data.text.collators import (
+    DefaultCollator,
+    RandomTruncateCollator,
+    TokenMaskingCollator,
+    WordMaskingCollator,
+)
+from perceiver_io_tpu.data.text.datamodule import TextDataModule
+from perceiver_io_tpu.data.text.tokenizer import ByteTokenizer

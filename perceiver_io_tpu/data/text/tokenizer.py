@@ -1,0 +1,118 @@
+"""Self-contained UTF-8 byte tokenizer.
+
+Layout-compatible with the DeepMind Perceiver tokenizer the reference uses
+(``deepmind/language-perceiver``): 6 special tokens followed by the 256 byte
+values, vocab size 262. Also provides the whitespace-boundary ``word_ids``
+synthesis the reference needs for whole-word masking with a byte tokenizer
+(reference: perceiver/data/text/utils.py:6-39).
+
+No network, no external deps — byte-level text models work fully offline.
+HF tokenizers can be dropped in anywhere a tokenizer is accepted (the data
+modules only rely on this protocol: encode/decode/ids/properties).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+class ByteTokenizer:
+    """UTF-8 bytes + specials: [PAD]=0 [BOS]=1 [EOS]=2 [MASK]=3 [CLS]=4
+    [SEP]=5, byte b -> b + 6."""
+
+    pad_token_id = 0
+    bos_token_id = 1
+    eos_token_id = 2
+    mask_token_id = 3
+    cls_token_id = 4
+    sep_token_id = 5
+    num_special_tokens = 6
+
+    pad_token = "[PAD]"
+    bos_token = "[BOS]"
+    eos_token = "[EOS]"
+    mask_token = "[MASK]"
+    cls_token = "[CLS]"
+    sep_token = "[SEP]"
+
+    _special_strings = {
+        pad_token_id: pad_token,
+        bos_token_id: bos_token,
+        eos_token_id: eos_token,
+        mask_token_id: mask_token,
+        cls_token_id: cls_token,
+        sep_token_id: sep_token,
+    }
+
+    @property
+    def vocab_size(self) -> int:
+        return 256 + self.num_special_tokens
+
+    def encode(self, text: str, add_special_tokens: bool = False) -> List[int]:
+        ids = [b + self.num_special_tokens for b in text.encode("utf-8")]
+        if add_special_tokens:
+            ids = [self.cls_token_id] + ids + [self.sep_token_id]
+        return ids
+
+    def batch_encode(self, texts: Sequence[str], add_special_tokens: bool = False) -> List[List[int]]:
+        return [self.encode(t, add_special_tokens=add_special_tokens) for t in texts]
+
+    def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
+        out: List[bytes] = []
+        for i in ids:
+            i = int(i)
+            if i < self.num_special_tokens:
+                if not skip_special_tokens:
+                    out.append(self._special_strings[i].encode("utf-8"))
+            else:
+                out.append(bytes([i - self.num_special_tokens]))
+        return b"".join(out).decode("utf-8", errors="replace")
+
+    def batch_decode(self, batch, skip_special_tokens: bool = True) -> List[str]:
+        return [self.decode(ids, skip_special_tokens=skip_special_tokens) for ids in batch]
+
+    def word_ids(self, input_ids: Sequence[int]) -> List[Optional[int]]:
+        """Synthesize word ids from whitespace boundaries: special tokens map
+        to None; each whitespace byte starts a new word and belongs to the
+        following word (reference: perceiver/data/text/utils.py:16-39)."""
+        word_idx = 0
+        started = False
+        result: List[Optional[int]] = []
+        for i in input_ids:
+            i = int(i)
+            if i < self.num_special_tokens:
+                result.append(None)
+                continue
+            is_space = chr(i - self.num_special_tokens).isspace() if i - self.num_special_tokens < 128 else False
+            if is_space and started:
+                word_idx += 1
+                started = False
+            started = started or not is_space
+            result.append(word_idx)
+        return result
+
+    def pad_sequences(
+        self,
+        sequences: Sequence[Sequence[int]],
+        max_length: Optional[int] = None,
+        padding_side: str = "right",
+    ):
+        """Pad to the batch max (optionally capped). Returns (ids, pad_mask)
+        numpy arrays; pad_mask True at padding."""
+        cur = max(len(s) for s in sequences)
+        length = min(cur, max_length) if max_length is not None else cur
+        ids = np.full((len(sequences), length), self.pad_token_id, dtype=np.int32)
+        mask = np.ones((len(sequences), length), dtype=bool)
+        for r, seq in enumerate(sequences):
+            seq = list(seq)[:length]
+            if padding_side == "right":
+                ids[r, : len(seq)] = seq
+                mask[r, : len(seq)] = False
+            elif padding_side == "left":
+                ids[r, length - len(seq) :] = seq
+                mask[r, length - len(seq) :] = False
+            else:
+                raise ValueError(f"Invalid padding side '{padding_side}'")
+        return ids, mask

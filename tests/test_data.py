@@ -1,0 +1,295 @@
+"""Data pipeline tests, modeled on the reference's data-module tests
+(reference: tests/text_data_module_test.py:15-271, symbolic audio + optical
+flow processors)."""
+
+import numpy as np
+import pytest
+
+from perceiver_io_tpu.data.audio.midi import PAD_ID, VOCAB_SIZE, Note, decode_events, encode_notes
+from perceiver_io_tpu.data.audio.symbolic import (
+    EXAMPLE_SEPARATOR,
+    SymbolicAudioCollator,
+    SymbolicAudioNumpyDataset,
+)
+from perceiver_io_tpu.data.loader import Batches, shard_indices_for_process
+from perceiver_io_tpu.data.text.collators import RandomTruncateCollator, TokenMaskingCollator, WordMaskingCollator
+from perceiver_io_tpu.data.text.datamodule import TextDataModule
+from perceiver_io_tpu.data.text.streaming import StreamingTextDataModule, shard_stream, shuffle_window
+from perceiver_io_tpu.data.text.tokenizer import ByteTokenizer
+from perceiver_io_tpu.data.vision.mnist import MNISTDataModule
+from perceiver_io_tpu.data.vision.optical_flow import OpticalFlowProcessor
+from perceiver_io_tpu.training.losses import IGNORE_INDEX
+
+CORPUS = [
+    "The quick brown fox jumps over the lazy dog. " * 20,
+    "Perceiver IO is a general-purpose architecture. " * 20,
+    "TPUs multiply matrices very quickly indeed. " * 20,
+]
+
+
+def test_byte_tokenizer_roundtrip():
+    tok = ByteTokenizer()
+    assert tok.vocab_size == 262
+    text = "Hello, TPU! ünïcödé"
+    ids = tok.encode(text)
+    assert tok.decode(ids) == text
+    ids_special = tok.encode(text, add_special_tokens=True)
+    assert ids_special[0] == tok.cls_token_id and ids_special[-1] == tok.sep_token_id
+    assert tok.decode(ids_special) == text
+    assert tok.decode(ids_special, skip_special_tokens=False).startswith("[CLS]")
+
+
+def test_byte_tokenizer_word_ids():
+    tok = ByteTokenizer()
+    ids = tok.encode("ab cd")
+    # "ab" -> word 0, " " starts word 1, "cd" -> word 1
+    assert tok.word_ids(ids) == [0, 0, 1, 1, 1]
+    ids = [tok.cls_token_id] + tok.encode("x y") + [tok.sep_token_id]
+    wids = tok.word_ids(ids)
+    assert wids[0] is None and wids[-1] is None
+
+
+def test_pad_sequences_sides():
+    tok = ByteTokenizer()
+    seqs = [[10, 11, 12], [20]]
+    ids, mask = tok.pad_sequences(seqs, padding_side="left")
+    np.testing.assert_array_equal(ids[1], [0, 0, 20])
+    np.testing.assert_array_equal(mask[1], [True, True, False])
+    ids, mask = tok.pad_sequences(seqs, padding_side="right", max_length=2)
+    np.testing.assert_array_equal(ids[0], [10, 11])
+
+
+def test_word_masking_collator():
+    tok = ByteTokenizer()
+    text = "the quick brown fox jumps over the lazy dog " * 30
+    ids = tok.encode(text)
+    examples = [{"input_ids": ids, "word_ids": tok.word_ids(ids)}]
+    collator = WordMaskingCollator(tok, mask_prob=0.3, seed=0)
+    batch = collator(examples)
+    masked_frac = (batch["labels"] != IGNORE_INDEX).mean()
+    assert 0.1 < masked_frac < 0.6
+    # masked positions carry original ids as labels
+    sel = batch["labels"] != IGNORE_INDEX
+    orig = np.asarray(ids)
+    assert (batch["labels"][0][sel[0]] == orig[sel[0]]).all()
+
+
+def test_token_masking_collator():
+    tok = ByteTokenizer()
+    ids = tok.encode("abcdefgh " * 100)
+    batch = TokenMaskingCollator(tok, mask_prob=0.15, seed=0)([{"input_ids": ids}])
+    frac = (batch["labels"] != IGNORE_INDEX).mean()
+    assert 0.08 < frac < 0.25
+    assert (batch["input_ids"] == tok.mask_token_id).sum() > 0
+
+
+def test_clm_datamodule_shift():
+    dm = TextDataModule(task="clm", max_seq_len=64, batch_size=2, train_texts=CORPUS, valid_texts=CORPUS[:1])
+    batches = list(dm.valid_batches())
+    assert len(batches) >= 1
+    b = batches[0]
+    assert b["input_ids"].shape == (2, 64)
+    # next-token contract
+    np.testing.assert_array_equal(b["labels"][:, :-1], b["input_ids"][:, 1:])
+    assert not b["pad_mask"].any()  # stream windows are full
+
+
+def test_clm_random_truncate():
+    dm = TextDataModule(
+        task="clm", max_seq_len=64, batch_size=2, random_min_seq_len=32,
+        train_texts=CORPUS, valid_texts=CORPUS[:1],
+    )
+    lens = {next(iter(dm.train_batches()))["input_ids"].shape[1] for _ in range(5)}
+    assert all(32 <= n <= 64 for n in lens)
+
+
+def test_mlm_datamodule():
+    dm = TextDataModule(task="mlm", max_seq_len=64, batch_size=2, train_texts=CORPUS, valid_texts=CORPUS[:1])
+    b = next(iter(dm.train_batches()))
+    assert b["input_ids"].shape[1] <= 64
+    assert (b["labels"] != IGNORE_INDEX).sum() > 0
+
+
+def test_clf_datamodule():
+    labeled = [(t, i % 2) for i, t in enumerate(CORPUS)]
+    dm = TextDataModule(task="clf", max_seq_len=128, batch_size=3, train_texts=labeled, valid_texts=labeled)
+    b = next(iter(dm.valid_batches()))
+    assert b["input_ids"].shape == (3, 128)
+    assert b["label"].shape == (3,)
+
+
+def test_datamodule_cache(tmp_path):
+    dm = TextDataModule(
+        task="clm", max_seq_len=32, batch_size=1, train_texts=CORPUS, valid_texts=CORPUS[:1],
+        cache_dir=str(tmp_path),
+    )
+    dm.prepare()
+    files = list(tmp_path.glob("preproc-*.npz"))
+    assert len(files) == 1
+    # same source -> cache hit, identical stream, native int dtype
+    dm2 = TextDataModule(
+        task="clm", max_seq_len=32, batch_size=1, train_texts=CORPUS, valid_texts=CORPUS[:1],
+        cache_dir=str(tmp_path),
+    )
+    dm2.prepare()
+    np.testing.assert_array_equal(dm._prepared["train_stream"], dm2._prepared["train_stream"])
+    assert np.asarray(dm2._prepared["train_stream"]).dtype != object
+
+    # different source -> different cache entry, no silent collision
+    dm3 = TextDataModule(
+        task="clm", max_seq_len=32, batch_size=1,
+        train_texts=["completely different corpus " * 30], valid_texts=CORPUS[:1],
+        cache_dir=str(tmp_path),
+    )
+    dm3.prepare()
+    assert len(list(tmp_path.glob("preproc-*.npz"))) == 2
+    assert len(dm3._prepared["train_stream"]) != len(dm._prepared["train_stream"])
+
+
+def test_static_masking():
+    dm = TextDataModule(
+        task="mlm", max_seq_len=64, batch_size=2, static_masking=True,
+        train_texts=CORPUS, valid_texts=CORPUS[:1],
+    )
+    b1 = next(iter(dm.train_batches()))
+    b2 = next(iter(dm.train_batches()))
+    assert (b1["labels"] != IGNORE_INDEX).sum() > 0
+    # static: identical masking across epochs
+    np.testing.assert_array_equal(b1["input_ids"], b2["input_ids"])
+    np.testing.assert_array_equal(b1["labels"], b2["labels"])
+
+
+def test_clm_rejects_right_padding():
+    with pytest.raises(ValueError, match="padding_side='left'"):
+        TextDataModule(task="clm", padding_side="right", train_texts=CORPUS)
+
+
+def test_clf_rejects_mixed_labels():
+    dm = TextDataModule(
+        task="clf", max_seq_len=32, batch_size=1,
+        train_texts=["unlabeled", ("labeled", 1)], valid_texts=[("a", 0)],
+    )
+    with pytest.raises(ValueError, match="every item to be a"):
+        dm.prepare()
+
+
+def test_streaming_module():
+    dm = StreamingTextDataModule(
+        lambda: iter(CORPUS * 5), max_seq_len=64, min_seq_len=32, batch_size=2,
+        shuffle_window_size=4, shard_for_processes=False,
+    )
+    batches = list(dm.batches(train=True))
+    assert len(batches) > 3
+    for b in batches:
+        assert 32 <= b["input_ids"].shape[1] <= 64
+        np.testing.assert_array_equal(b["labels"][:, :-1], b["input_ids"][:, 1:])
+
+
+def test_stream_sharding():
+    items = list(range(10))
+    assert list(shard_stream(iter(items), 0, 2)) == [0, 2, 4, 6, 8]
+    assert list(shard_stream(iter(items), 1, 2)) == [1, 3, 5, 7, 9]
+    shuffled = list(shuffle_window(iter(items), window_size=4, seed=0))
+    assert sorted(shuffled) == items
+    np.testing.assert_array_equal(shard_indices_for_process(10, 1, 2), [5, 6, 7, 8, 9])
+
+
+def test_midi_codec_roundtrip():
+    notes = [
+        Note(velocity=64, pitch=60, start=0.0, end=0.5),
+        Note(velocity=80, pitch=64, start=0.25, end=1.0),
+        Note(velocity=80, pitch=67, start=1.5, end=2.5),
+    ]
+    ids = encode_notes(notes)
+    assert all(0 <= i < VOCAB_SIZE - 1 for i in ids)
+    decoded = decode_events(ids)
+    assert len(decoded) == 3
+    for orig, dec in zip(sorted(notes, key=lambda n: n.start), decoded):
+        assert dec.pitch == orig.pitch
+        assert dec.start == pytest.approx(orig.start, abs=0.011)
+        assert dec.end == pytest.approx(orig.end, abs=0.011)
+        assert abs(dec.velocity - orig.velocity) < 4
+
+
+def test_symbolic_audio_dataset_and_collator():
+    rng = np.random.default_rng(0)
+    pieces = [rng.integers(0, 388, size=n).astype(np.int16) for n in (50, 200, 120)]
+    flat = np.concatenate([np.append(p, [EXAMPLE_SEPARATOR]) for p in pieces])
+    ds = SymbolicAudioNumpyDataset(flat, max_seq_len=65, seed=0)
+    for i in range(5):
+        ex = ds[i]["input_ids"]
+        assert EXAMPLE_SEPARATOR not in ex
+        assert len(ex) <= 65
+
+    collator = SymbolicAudioCollator(max_seq_len=65, padding_side="left")
+    batch = collator([ds[0], ds[1]])
+    assert batch["input_ids"].shape == (2, 64)
+    np.testing.assert_array_equal(batch["labels"][:, :-1], batch["input_ids"][:, 1:])
+    # left padding -> pads at the start
+    row_pad = batch["pad_mask"][0]
+    if row_pad.any():
+        first_real = np.argmin(row_pad)
+        assert not row_pad[first_real:].any()
+
+
+def test_optical_flow_processor():
+    proc = OpticalFlowProcessor(patch_size=(16, 24), patch_min_overlap=4)
+    grid = proc.compute_patch_grid_indices((20, 30))
+    assert grid[-1] == (4, 6)  # right-aligned last patch
+
+    rng = np.random.default_rng(0)
+    img1 = rng.integers(0, 255, size=(20, 30, 3), dtype=np.uint8)
+    img2 = rng.integers(0, 255, size=(20, 30, 3), dtype=np.uint8)
+    feats = proc.preprocess((img1, img2))
+    assert feats.shape == (len(grid), 2, 16, 24, 27)
+    assert -1.0 <= feats.min() and feats.max() <= 1.0
+    # center 9 channels (ky=1,kx=1) reproduce the normalized pixel values
+    np.testing.assert_allclose(
+        feats[0, 0, 1:-1, 1:-1, 12:15],
+        (img1.astype(np.float32) / 255 * 2 - 1)[1:15, 1:23],
+        atol=1e-6,
+    )
+
+    # constant patch predictions blend back to the constant
+    preds = np.full((len(grid), 16, 24, 2), 0.05, np.float32)
+    flow = proc.postprocess(preds, (20, 30))
+    np.testing.assert_allclose(flow, 0.05 * proc.flow_scale_factor, rtol=1e-5)
+
+
+def test_optical_flow_processor_validation():
+    proc = OpticalFlowProcessor(patch_size=(16, 24), patch_min_overlap=4)
+    with pytest.raises(ValueError, match="must be at least"):
+        proc.preprocess((np.zeros((8, 30, 3)), np.zeros((8, 30, 3))))
+    with pytest.raises(ValueError, match="Shapes of images must match"):
+        proc.preprocess((np.zeros((20, 30, 3)), np.zeros((20, 32, 3))))
+    with pytest.raises(ValueError, match="Overlap should be smaller"):
+        OpticalFlowProcessor(patch_size=(16, 24), patch_min_overlap=16)
+
+
+def test_mnist_synthetic():
+    dm = MNISTDataModule(synthetic=True, batch_size=16, random_crop=24)
+    assert dm.image_shape == (24, 24, 1)
+    b = next(iter(dm.train_batches()))
+    assert b["image"].shape == (16, 24, 24, 1)
+    assert -1.0 <= b["image"].min() and b["image"].max() <= 1.0
+    bv = next(iter(dm.valid_batches()))
+    assert bv["image"].shape == (16, 24, 24, 1)
+
+
+def test_batches_drop_last_and_shuffle():
+    data = [{"x": np.asarray([i])} for i in range(10)]
+
+    class DS:
+        def __len__(self):
+            return len(data)
+
+        def __getitem__(self, i):
+            return data[i]
+
+    b = Batches(DS(), batch_size=3, shuffle=True, seed=1)
+    batches = list(b)
+    assert len(batches) == 3
+    seen_first = {tuple(x["x"].ravel()) for x in batches}
+    batches2 = list(b)  # epoch advances -> different order
+    seen_second = {tuple(x["x"].ravel()) for x in batches2}
+    assert seen_first != seen_second or True  # order may coincide; just smoke
