@@ -10,3 +10,16 @@ from perceiver_io_tpu.training.losses import (
     masked_lm_loss_fn,
     mse_loss_fn,
 )
+from perceiver_io_tpu.training.optim import freeze_mask
+from perceiver_io_tpu.training.checkpoint import (
+    CheckpointManager,
+    config_from_dict,
+    config_to_dict,
+    load_config,
+    load_params_into,
+    load_pretrained,
+    save_config,
+    save_pretrained,
+)
+from perceiver_io_tpu.training.metrics import MetricsLogger
+from perceiver_io_tpu.training.trainer import Trainer, TrainerConfig

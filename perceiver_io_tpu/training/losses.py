@@ -25,16 +25,20 @@ def _cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> Tuple[jnp.ndarra
     return loss, num_valid
 
 
-def classification_loss_fn(apply_fn) -> Callable:
+def classification_loss_fn(apply_fn, deterministic: bool = False) -> Callable:  # noqa: D401
     """CE + accuracy over ``{"x" | "image", "label"}`` batches
-    (reference: perceiver/model/core/lightning.py:47-77)."""
+    (reference: perceiver/model/core/lightning.py:47-77). ``deterministic``
+    builds the eval variant (dropout off, the Lightning ``model.eval()``
+    analog)."""
 
-    def loss_fn(params, batch: Dict, rng) -> Tuple[jnp.ndarray, Dict]:
+    def loss_fn(params, batch: Dict, rng, deterministic: bool = deterministic) -> Tuple[jnp.ndarray, Dict]:
         x = batch.get("x", batch.get("image"))
         y = batch["label"]
         pad_mask = batch.get("pad_mask")
         kwargs = {} if pad_mask is None else {"pad_mask": pad_mask}
-        logits = apply_fn(params, x, deterministic=False, rngs={"dropout": rng}, **kwargs)
+        if not deterministic:
+            kwargs["rngs"] = {"dropout": rng}
+        logits = apply_fn(params, x, deterministic=deterministic, **kwargs)
         loss, _ = _cross_entropy(logits, y)
         acc = jnp.mean((jnp.argmax(logits, axis=-1) == y).astype(jnp.float32))
         return loss, {"loss": loss, "acc": acc}
@@ -42,17 +46,18 @@ def classification_loss_fn(apply_fn) -> Callable:
     return loss_fn
 
 
-def masked_lm_loss_fn(apply_fn) -> Callable:
+def masked_lm_loss_fn(apply_fn, deterministic: bool = False) -> Callable:
     """CE over masked positions only: labels are IGNORE_INDEX except where a
     token was masked (reference: perceiver/model/text/mlm/lightning.py:45-60)."""
 
-    def loss_fn(params, batch: Dict, rng) -> Tuple[jnp.ndarray, Dict]:
+    def loss_fn(params, batch: Dict, rng, deterministic: bool = deterministic) -> Tuple[jnp.ndarray, Dict]:
+        kwargs = {} if deterministic else {"rngs": {"dropout": rng}}
         logits = apply_fn(
             params,
             batch["input_ids"],
             pad_mask=batch.get("pad_mask"),
-            deterministic=False,
-            rngs={"dropout": rng},
+            deterministic=deterministic,
+            **kwargs,
         )
         loss, num_masked = _cross_entropy(logits, batch["labels"])
         return loss, {"loss": loss, "num_masked": num_masked}
@@ -60,7 +65,7 @@ def masked_lm_loss_fn(apply_fn) -> Callable:
     return loss_fn
 
 
-def clm_loss_fn(apply_fn, max_latents: int) -> Callable:
+def clm_loss_fn(apply_fn, max_latents: int, deterministic: bool = False) -> Callable:
     """Causal LM loss: pads are ignored, prefix_len = seq_len - max_latents,
     CE over the last ``max_latents`` logits
     (reference: perceiver/model/core/lightning.py:117-133).
@@ -70,19 +75,20 @@ def clm_loss_fn(apply_fn, max_latents: int) -> Callable:
     (reference: perceiver/data/text/c4.py:161-162); this function does NOT
     shift."""
 
-    def loss_fn(params, batch, rng) -> Tuple[jnp.ndarray, Dict]:
+    def loss_fn(params, batch, rng, deterministic: bool = deterministic) -> Tuple[jnp.ndarray, Dict]:
         labels, x, pad_mask = batch["labels"], batch["input_ids"], batch["pad_mask"]
         seq_len = x.shape[1]
         if seq_len < max_latents:
             raise ValueError(f"Training sequence length must be at least {max_latents} (= max_latents)")
         labels = jnp.where(pad_mask, IGNORE_INDEX, labels)
+        kwargs = {} if deterministic else {"rngs": {"dropout": rng}}
         out = apply_fn(
             params,
             x,
             prefix_len=seq_len - max_latents,
             pad_mask=pad_mask,
-            deterministic=False,
-            rngs={"dropout": rng},
+            deterministic=deterministic,
+            **kwargs,
         )
         logits = out.logits
         labels = labels[:, -logits.shape[1] :]
@@ -92,11 +98,12 @@ def clm_loss_fn(apply_fn, max_latents: int) -> Callable:
     return loss_fn
 
 
-def mse_loss_fn(apply_fn) -> Callable:
+def mse_loss_fn(apply_fn, deterministic: bool = False) -> Callable:
     """MSE for regression tasks (time-series app, reference: model.py:16-114)."""
 
-    def loss_fn(params, batch: Dict, rng) -> Tuple[jnp.ndarray, Dict]:
-        pred = apply_fn(params, batch["x"], deterministic=False, rngs={"dropout": rng})
+    def loss_fn(params, batch: Dict, rng, deterministic: bool = deterministic) -> Tuple[jnp.ndarray, Dict]:
+        kwargs = {} if deterministic else {"rngs": {"dropout": rng}}
+        pred = apply_fn(params, batch["x"], deterministic=deterministic, **kwargs)
         loss = jnp.mean((pred - batch["y"]) ** 2)
         return loss, {"loss": loss}
 

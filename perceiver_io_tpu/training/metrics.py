@@ -1,0 +1,81 @@
+"""Metrics logging — CSV always, TensorBoard when available.
+
+Reference parity (SURVEY §5.5): scalar train/val loss + accuracy logging,
+per-step learning-rate monitoring, and qualitative text panels (generated
+samples, mask fills) at validation end
+(reference: perceiver/model/core/lightning.py:63-77, trainer.yaml:3-6,
+text/clm/lightning.py:55-104).
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import os
+import time
+from typing import Dict
+
+
+class MetricsLogger:
+    """Appends scalars to ``metrics.csv`` (one row per log call; the header is
+    the union of keys seen, and the file is rewritten only on the rare event a
+    new key widens it) and mirrors them to TensorBoard if importable. Text
+    logs go to TensorBoard text panels and ``samples.txt``."""
+
+    def __init__(self, log_dir: str, use_tensorboard: bool = True):
+        self.log_dir = os.path.abspath(log_dir)
+        os.makedirs(self.log_dir, exist_ok=True)
+        self._csv_path = os.path.join(self.log_dir, "metrics.csv")
+        self._keys = ["step", "time"]
+        self._header_written = False
+        self._tb = None
+        if use_tensorboard:
+            try:  # torch's tensorboard writer; optional
+                from torch.utils.tensorboard import SummaryWriter
+
+                self._tb = SummaryWriter(self.log_dir)
+            except Exception:
+                self._tb = None
+
+    def log(self, step: int, metrics: Dict[str, float]) -> None:
+        row = {"step": int(step), "time": time.time()}
+        for k, v in metrics.items():
+            row[k] = float(v)
+        new_keys = [k for k in row if k not in self._keys]
+        if new_keys:
+            self._keys.extend(new_keys)
+            self._rewrite_with_widened_header()
+        with open(self._csv_path, "a", newline="") as f:
+            writer = csv.DictWriter(f, fieldnames=self._keys, restval="")
+            if not self._header_written:
+                writer.writeheader()
+                self._header_written = True
+            writer.writerow(row)
+        if self._tb is not None:
+            for k, v in metrics.items():
+                self._tb.add_scalar(k, float(v), global_step=int(step))
+
+    def _rewrite_with_widened_header(self) -> None:
+        if not self._header_written or not os.path.exists(self._csv_path):
+            return
+        with open(self._csv_path, newline="") as f:
+            rows = list(csv.DictReader(f))
+        with open(self._csv_path, "w", newline="") as f:
+            writer = csv.DictWriter(f, fieldnames=self._keys, restval="")
+            writer.writeheader()
+            writer.writerows(rows)
+
+    def log_text(self, step: int, tag: str, text: str) -> None:
+        with open(os.path.join(self.log_dir, "samples.txt"), "a") as f:
+            f.write(f"--- step {int(step)} [{tag}] ---\n{text}\n")
+        if self._tb is not None:
+            self._tb.add_text(tag, text, global_step=int(step))
+
+    def log_hparams(self, hparams: Dict) -> None:
+        with open(os.path.join(self.log_dir, "hparams.json"), "w") as f:
+            json.dump(hparams, f, indent=2, default=str)
+
+    def close(self) -> None:
+        if self._tb is not None:
+            self._tb.flush()
+            self._tb.close()

@@ -51,6 +51,28 @@ def constant_with_warmup(base_lr: float, warmup_steps: int = 0) -> optax.Schedul
     return schedule
 
 
+def freeze_mask(params, frozen_paths) -> "object":
+    """Pytree of bools marking leaves whose key path contains one of the
+    ``frozen_paths`` as a contiguous run of whole path segments (so
+    ``"encoder"`` freezes ``params/encoder/...`` but not
+    ``params/image_encoder/...``) — the parity mechanism for the reference's
+    ``encoder.freeze`` (requires_grad=False) option
+    (reference: perceiver/model/core/utils.py:46-48, text/common/backend.py:39-40)."""
+    import jax
+
+    patterns = [p.split("/") for p in frozen_paths]
+
+    def is_frozen(path) -> bool:
+        segments = [str(getattr(k, "key", getattr(k, "idx", k))) for k in path]
+        for pat in patterns:
+            n = len(pat)
+            if any(segments[i : i + n] == pat for i in range(len(segments) - n + 1)):
+                return True
+        return False
+
+    return jax.tree_util.tree_map_with_path(lambda path, _: is_frozen(path), params)
+
+
 def make_optimizer(
     learning_rate: Union[float, optax.Schedule],
     optimizer: str = "adamw",
@@ -59,6 +81,7 @@ def make_optimizer(
     beta2: float = 0.999,
     gradient_clip: Optional[float] = None,
     accumulate_grad_batches: int = 1,
+    frozen_mask=None,
 ) -> optax.GradientTransformation:
     if optimizer == "adamw":
         tx = optax.adamw(learning_rate, b1=beta1, b2=beta2, weight_decay=weight_decay)
@@ -72,9 +95,17 @@ def make_optimizer(
         raise ValueError(f"unknown optimizer: {optimizer}")
 
     parts = []
+    if frozen_mask is not None:
+        # zero frozen grads FIRST so they neither enter the global clip norm
+        # nor advance optimizer moments (requires_grad=False parity)
+        parts.append(optax.masked(optax.set_to_zero(), frozen_mask))
     if gradient_clip is not None:
         parts.append(optax.clip_by_global_norm(gradient_clip))
     parts.append(tx)
+    if frozen_mask is not None:
+        # and zero frozen UPDATES last: adamw weight decay would otherwise
+        # still shrink frozen parameters despite zero gradients
+        parts.append(optax.masked(optax.set_to_zero(), frozen_mask))
     tx = optax.chain(*parts) if len(parts) > 1 else tx
 
     if accumulate_grad_batches > 1:

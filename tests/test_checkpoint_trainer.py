@@ -1,0 +1,271 @@
+"""Checkpoint/resume + Trainer loop contracts.
+
+Mirrors the reference's checkpoint semantics (SURVEY §5.4): best-k retention
+monitored on val_loss, hyperparameters-in-checkpoint (config round-trip),
+warm-start of an encoder subtree, and exact resume of a training run.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from perceiver_io_tpu.core.config import ClassificationDecoderConfig, EncoderConfig
+from perceiver_io_tpu.models.text import (
+    CausalLanguageModelConfig,
+    TextClassifier,
+    TextClassifierConfig,
+    TextEncoderConfig,
+)
+from perceiver_io_tpu.training import (
+    CheckpointManager,
+    MetricsLogger,
+    TrainState,
+    Trainer,
+    TrainerConfig,
+    classification_loss_fn,
+    config_from_dict,
+    config_to_dict,
+    freeze_mask,
+    load_params_into,
+    load_pretrained,
+    make_optimizer,
+    save_pretrained,
+)
+
+
+def tiny_classifier():
+    config = TextClassifierConfig(
+        encoder=TextEncoderConfig(
+            vocab_size=32,
+            max_seq_len=16,
+            num_input_channels=16,
+            num_cross_attention_heads=1,
+            num_self_attention_heads=2,
+            num_self_attention_layers_per_block=1,
+        ),
+        decoder=ClassificationDecoderConfig(
+            num_classes=2, num_output_query_channels=16, num_cross_attention_heads=1
+        ),
+        num_latents=4,
+        num_latent_channels=16,
+    )
+    return TextClassifier(config), config
+
+
+def toy_text_batch(n=16, seq=16, vocab=32, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.integers(0, vocab, size=(n, seq))
+    y = (x.mean(axis=1) > vocab / 2).astype(np.int32)
+    return {"x": jnp.asarray(x), "label": jnp.asarray(y), "pad_mask": jnp.zeros((n, seq), bool)}
+
+
+def make_state(model, config, seed=0):
+    batch = toy_text_batch()
+    params = model.init(jax.random.PRNGKey(seed), batch["x"])
+    tx = make_optimizer(1e-3)
+    return TrainState.create(model.apply, params, tx, jax.random.PRNGKey(1)), batch
+
+
+def test_config_roundtrip():
+    _, config = tiny_classifier()
+    d = config_to_dict(config)
+    restored = config_from_dict(d)
+    assert restored == config
+    assert isinstance(restored.decoder, ClassificationDecoderConfig)
+    assert isinstance(restored.encoder, TextEncoderConfig)
+    clm = CausalLanguageModelConfig(vocab_size=100, max_seq_len=64, max_latents=16)
+    assert config_from_dict(config_to_dict(clm)) == clm
+
+
+def test_checkpoint_save_restore(tmp_path):
+    model, config = tiny_classifier()
+    state, batch = make_state(model, config)
+    mngr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=2, monitor="val_loss")
+    state = state.replace(step=state.step + 1)
+    mngr.save(state, metrics={"val_loss": 1.5}, config=config)
+    state2 = state.replace(step=state.step + 1)
+    mngr.save(state2, metrics={"val_loss": 0.5})
+    state3 = state2.replace(step=state2.step + 1)
+    mngr.save(state3, metrics={"val_loss": 0.9})
+
+    assert mngr.best_step() == 2
+    fresh, _ = make_state(model, config, seed=3)
+    restored = mngr.restore(fresh, step=mngr.best_step())
+    chex_all = jax.tree_util.tree_all(
+        jax.tree.map(lambda a, b: jnp.allclose(a, b), restored.params, state2.params)
+    )
+    assert chex_all
+    assert int(restored.step) == 2
+    # hyperparameters-in-checkpoint: config restorable without external info
+    assert mngr.load_config() == config
+    mngr.close()
+
+
+def test_pretrained_roundtrip(tmp_path):
+    model, config = tiny_classifier()
+    state, batch = make_state(model, config)
+    save_pretrained(str(tmp_path / "pre"), state.params, config)
+    params, config2 = load_pretrained(str(tmp_path / "pre"), template_params=state.params)
+    assert config2 == config
+    out1 = model.apply(state.params, batch["x"])
+    out2 = model.apply(params, batch["x"])
+    assert jnp.allclose(out1, out2)
+
+
+def test_encoder_warm_start_and_freeze():
+    """Classifier encoder warm start from a donor model + freeze parity
+    (reference: perceiver/model/text/classifier/lightning.py:28-36)."""
+    model, config = tiny_classifier()
+    state, batch = make_state(model, config, seed=0)
+    donor, _ = make_state(model, config, seed=7)
+
+    warm = load_params_into(state.params, donor.params, subtree="encoder")
+    # encoder subtree now equals donor's, decoder untouched
+    assert jax.tree_util.tree_all(
+        jax.tree.map(lambda a, b: jnp.allclose(a, b), warm["params"]["encoder"], donor.params["params"]["encoder"])
+    )
+    assert jax.tree_util.tree_all(
+        jax.tree.map(lambda a, b: jnp.allclose(a, b), warm["params"]["decoder"], state.params["params"]["decoder"])
+    )
+
+    # frozen encoder: gradients through tx become zero updates for encoder
+    mask = freeze_mask(warm, ["encoder"])
+    tx = make_optimizer(1e-2, frozen_mask=mask)
+    fstate = TrainState.create(model.apply, warm, tx, jax.random.PRNGKey(1))
+    from perceiver_io_tpu.training.loop import make_train_step
+
+    step = make_train_step(classification_loss_fn(model.apply), donate=False)
+    new_state, _ = step(fstate, batch)
+    assert jax.tree_util.tree_all(
+        jax.tree.map(
+            lambda a, b: jnp.allclose(a, b),
+            new_state.params["params"]["encoder"],
+            warm["params"]["encoder"],
+        )
+    )
+    assert not jax.tree_util.tree_all(
+        jax.tree.map(
+            lambda a, b: jnp.allclose(a, b),
+            new_state.params["params"]["decoder"],
+            warm["params"]["decoder"],
+        )
+    )
+
+
+def _repeat(batch):
+    while True:
+        yield batch
+
+
+def test_trainer_fit_and_resume(tmp_path):
+    model, config = tiny_classifier()
+    state, batch = make_state(model, config)
+    val_batches = [toy_text_batch(seed=1), toy_text_batch(seed=2)]
+
+    def build_trainer():
+        return Trainer(
+            classification_loss_fn(model.apply),
+            eval_loss_fn=classification_loss_fn(model.apply, deterministic=True),
+            config=TrainerConfig(
+                max_steps=20,
+                log_interval=5,
+                val_interval=10,
+                checkpoint_dir=str(tmp_path / "run"),
+                max_checkpoints=2,
+            ),
+            logger=MetricsLogger(str(tmp_path / "logs"), use_tensorboard=False),
+            lr_schedule=lambda step: 1e-3,
+        )
+
+    trainer = build_trainer()
+    out_state = trainer.fit(state, _repeat(batch), val_loader=val_batches, model_config=config)
+    assert int(out_state.step) == 20
+    assert trainer.checkpoints.latest_step() == 20
+    assert os.path.exists(tmp_path / "logs" / "metrics.csv")
+    val = trainer.validate(out_state, val_batches)
+    assert "val_loss" in val and np.isfinite(val["val_loss"])
+
+    # resume: a fresh trainer continues from the checkpoint
+    trainer2 = build_trainer()
+    trainer2.config.max_steps = 30
+    state2, _ = make_state(model, config, seed=9)
+    out2 = trainer2.fit(state2, _repeat(batch), val_loader=val_batches, resume=True)
+    assert int(out2.step) == 30
+
+
+def test_trainer_callback_runs(tmp_path):
+    model, config = tiny_classifier()
+    state, batch = make_state(model, config)
+    calls = []
+    trainer = Trainer(
+        classification_loss_fn(model.apply),
+        config=TrainerConfig(max_steps=4, log_interval=2, val_interval=2),
+        callbacks=[lambda tr, st, step: calls.append(step)],
+    )
+    trainer.fit(state, _repeat(batch), val_loader=[batch])
+    assert calls == [2, 4]
+
+
+def test_config_tuple_roundtrip():
+    """JSON round-trip restores tuple fields (e.g. image_shape) as tuples."""
+    from perceiver_io_tpu.models.vision import ImageClassifierConfig, ImageEncoderConfig
+
+    config = ImageClassifierConfig(
+        encoder=ImageEncoderConfig(image_shape=(8, 8, 1), num_frequency_bands=4),
+        decoder=ClassificationDecoderConfig(num_classes=2),
+        num_latents=4,
+        num_latent_channels=16,
+    )
+    import json
+
+    restored = config_from_dict(json.loads(json.dumps(config_to_dict(config))))
+    assert restored == config
+    assert isinstance(restored.encoder.image_shape, tuple)
+
+
+def test_freeze_mask_segment_matching():
+    params = {
+        "params": {
+            "encoder": {"w": np.zeros(2)},
+            "image_encoder": {"w": np.zeros(2)},
+            "layers_1": {"w": np.zeros(2)},
+            "layers_12": {"w": np.zeros(2)},
+        }
+    }
+    mask = freeze_mask(params, ["encoder"])
+    assert mask["params"]["encoder"]["w"] is True
+    assert mask["params"]["image_encoder"]["w"] is False
+    mask = freeze_mask(params, ["layers_1"])
+    assert mask["params"]["layers_1"]["w"] is True
+    assert mask["params"]["layers_12"]["w"] is False
+
+
+def test_trainer_default_eval_is_deterministic():
+    """Without an explicit eval_loss_fn, validation disables dropout."""
+    model, config = tiny_classifier()
+    state, batch = make_state(model, config)
+    trainer = Trainer(
+        classification_loss_fn(model.apply),
+        config=TrainerConfig(max_steps=1),
+    )
+    a = trainer.validate(state, [batch])
+    b = trainer.validate(state, [batch])
+    assert a == b
+
+
+def test_trainer_final_save_without_validation(tmp_path):
+    model, config = tiny_classifier()
+    state, batch = make_state(model, config)
+    trainer = Trainer(
+        classification_loss_fn(model.apply),
+        config=TrainerConfig(max_steps=3, log_interval=10, checkpoint_dir=str(tmp_path / "nv")),
+    )
+    out = trainer.fit(state, _repeat(batch), val_loader=None, model_config=config)
+    mngr = CheckpointManager(str(tmp_path / "nv"), monitor=None)
+    assert mngr.latest_step() == 3
+    restored = mngr.restore(make_state(model, config, seed=5)[0])
+    assert int(restored.step) == 3
+    mngr.close()
