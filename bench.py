@@ -86,7 +86,9 @@ def main():
     }
 
     prefix_len = n - args.latents
-    params = model.init(jax.random.PRNGKey(0), x[:, : args.latents + 1], prefix_len=1)
+    params = model.init(
+        jax.random.PRNGKey(0), batch["input_ids"][:, : args.latents + 1], prefix_len=1
+    )
     n_params = sum(p.size for p in jax.tree.leaves(params))
 
     tx = make_optimizer(1e-3, gradient_clip=1.0)

@@ -35,6 +35,7 @@ from flax import struct
 from jax import lax
 
 from perceiver_io_tpu.core.position import apply_rotary_pos_emb
+from perceiver_io_tpu.ops.flash_attention import flash_attention, flash_enabled, flash_supported
 
 
 @struct.dataclass
@@ -102,6 +103,7 @@ class MultiHeadAttention(nn.Module):
     out_bias: bool = True
     init_scale: float = 0.02
     dtype: jnp.dtype = jnp.float32
+    use_flash: Optional[bool] = None  # None = auto (fused Pallas path on TPU)
 
     @property
     def qk_channels(self) -> int:
@@ -187,6 +189,23 @@ class MultiHeadAttention(nn.Module):
             q = apply_rotary_pos_emb(q, rope_q[:, None, :, :])
         if rope_k is not None:
             k_h = apply_rotary_pos_emb(k_h, rope_k[:, None, :, :])
+
+        # Fused blockwise path (Pallas flash attention): no cache, no active
+        # attention-prob dropout. The kernel's right-aligned causal mask is
+        # identical to the mask construction below when the cache is absent.
+        dropout_active = self.dropout > 0.0 and not deterministic
+        if (
+            kv_cache is None
+            and flash_enabled(self.use_flash)
+            and flash_supported(
+                n_q, n_kv, self.qk_channels // h, self.v_channels // h, dropout_active
+            )
+        ):
+            o = flash_attention(
+                q, k_h, v_h, pad_mask=pad_mask, causal=self.causal_attention, sm_scale=1.0
+            )
+            o = o.transpose(0, 2, 1, 3).reshape(b, n_q, self.v_channels)
+            return AttentionOutput(last_hidden_state=self.o_proj(o), kv_cache=None)
 
         # Combined boolean mask (True = masked), shape broadcastable to (B, 1, N, M).
         kv_idx = jnp.arange(n_kv, dtype=jnp.int32)

@@ -71,6 +71,7 @@ class CrossAttention(nn.Module):
     out_bias: bool = True
     init_scale: float = 0.02
     dtype: jnp.dtype = jnp.float32
+    use_flash: Optional[bool] = None
 
     def setup(self):
         self.q_norm = nn.LayerNorm(epsilon=LAYER_NORM_EPSILON, dtype=self.dtype)
@@ -88,6 +89,7 @@ class CrossAttention(nn.Module):
             out_bias=self.out_bias,
             init_scale=self.init_scale,
             dtype=self.dtype,
+            use_flash=self.use_flash,
         )
 
     def __call__(
@@ -132,6 +134,7 @@ class SelfAttention(nn.Module):
     out_bias: bool = True
     init_scale: float = 0.02
     dtype: jnp.dtype = jnp.float32
+    use_flash: Optional[bool] = None
 
     def setup(self):
         self.norm = nn.LayerNorm(epsilon=LAYER_NORM_EPSILON, dtype=self.dtype)
@@ -148,6 +151,7 @@ class SelfAttention(nn.Module):
             out_bias=self.out_bias,
             init_scale=self.init_scale,
             dtype=self.dtype,
+            use_flash=self.use_flash,
         )
 
     def __call__(
@@ -216,6 +220,7 @@ class CrossAttentionLayer(nn.Module):
     mlp_bias: bool = True
     init_scale: float = 0.02
     dtype: jnp.dtype = jnp.float32
+    use_flash: Optional[bool] = None
 
     def setup(self):
         self.cross_attn = CrossAttention(
@@ -231,6 +236,7 @@ class CrossAttentionLayer(nn.Module):
             out_bias=self.out_bias,
             init_scale=self.init_scale,
             dtype=self.dtype,
+            use_flash=self.use_flash,
         )
         self.mlp = MLP(
             num_channels=self.num_q_input_channels,
@@ -287,6 +293,7 @@ class SelfAttentionLayer(nn.Module):
     mlp_bias: bool = True
     init_scale: float = 0.02
     dtype: jnp.dtype = jnp.float32
+    use_flash: Optional[bool] = None
 
     def setup(self):
         self.self_attn = SelfAttention(
@@ -301,6 +308,7 @@ class SelfAttentionLayer(nn.Module):
             out_bias=self.out_bias,
             init_scale=self.init_scale,
             dtype=self.dtype,
+            use_flash=self.use_flash,
         )
         self.mlp = MLP(
             num_channels=self.num_channels,

@@ -1,0 +1,5 @@
+"""TPU kernels (Pallas) and fused ops."""
+
+from perceiver_io_tpu.ops.flash_attention import flash_attention, flash_supported
+
+__all__ = ["flash_attention", "flash_supported"]

@@ -1,0 +1,496 @@
+"""Fused blockwise (flash) attention Pallas kernels for TPU.
+
+This is the HBM-bandwidth fix for the 16k-context Perceiver AR north star
+(SURVEY §5.7): the reference materializes the full (latents x sequence)
+score matrix per layer (reference: perceiver/model/core/modules.py:151-163,
+bounded only by the `max_heads_parallel` chunk loop); here scores never leave
+VMEM. One mask form covers every attention in the framework:
+
+``right-aligned causal``
+    query *i* may attend kv slot *j* iff ``j <= i + offset`` with
+    ``offset = kv_len - q_len``.  For square self-attention this is the
+    standard causal mask; for Perceiver AR's cross-attention over
+    ``[prefix; latents]`` it is exactly the reference's right-aligned mask
+    (reference: modules.py:135-140) because every (possibly
+    dropout-subsampled) prefix position precedes every latent query.
+    ``causal=False`` disables the mask (Perceiver IO encoder/decoder).
+
+Key padding is an additive f32 bias row per batch (0 or ``MASK_VALUE``),
+streamed in kv blocks — O(B·Nkv) traffic, not O(Nq·Nkv).
+
+Training support is a ``jax.custom_vjp`` with three kernels (forward, dKV,
+dQ) using the standard flash recomputation scheme: forward saves the row
+logsumexp; backward recomputes probabilities blockwise from (q, k, lse) and
+accumulates dk/dv over query blocks and dq over kv blocks.
+
+All shapes are static; inputs are padded to block multiples by the wrapper
+(padded kv slots are masked via the bias row, padded q rows are sliced off).
+On CPU the kernels run in Pallas interpret mode (used by the test suite);
+the numerics contract vs the einsum path is ``tests/test_flash_attention.py``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+LANES = 128
+
+
+def _right_aligned_mask(bq: int, bkv: int, iq, ikv, block_q: int, block_kv: int, offset: int):
+    """Boolean keep-mask for a (bq, bkv) score tile at block coords (iq, ikv)."""
+    rows = lax.broadcasted_iota(jnp.int32, (bq, bkv), 0) + iq * block_q
+    cols = lax.broadcasted_iota(jnp.int32, (bq, bkv), 1) + ikv * block_kv
+    return cols <= rows + offset
+
+
+def _block_visible(iq, ikv, block_q: int, block_kv: int, offset: int):
+    """True iff any entry of score tile (iq, ikv) is unmasked."""
+    return ikv * block_kv <= (iq + 1) * block_q - 1 + offset
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(
+    bias_ref,  # (1, block_kv) f32
+    q_ref,  # (1, block_q, d_qk)
+    k_ref,  # (1, block_kv, d_qk)
+    v_ref,  # (1, block_kv, d_v)
+    o_ref,  # (1, block_q, d_v)
+    lse_ref,  # (1, block_q, LANES) f32
+    m_scr,  # (block_q, LANES) f32
+    l_scr,  # (block_q, LANES) f32
+    acc_scr,  # (block_q, d_v) f32
+    *,
+    causal: bool,
+    offset: int,
+    sm_scale: float,
+    num_kv_blocks: int,
+):
+    iq, ikv = pl.program_id(1), pl.program_id(2)
+    block_q, d_v = acc_scr.shape
+    block_kv = k_ref.shape[1]
+
+    @pl.when(ikv == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    def _body():
+        q = q_ref[0]
+        k = k_ref[0]
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (block_q, block_kv)
+        s = s * sm_scale + bias_ref[...]
+        if causal:
+            keep = _right_aligned_mask(block_q, block_kv, iq, ikv, block_q, block_kv, offset)
+            s = jnp.where(keep, s, MASK_VALUE)
+
+        m_prev = m_scr[...]  # (block_q, LANES), lanes identical
+        l_prev = l_scr[...]
+        m_curr = jnp.max(s, axis=1)[:, None]  # (block_q, 1)
+        m_next = jnp.maximum(m_prev, m_curr)  # (block_q, LANES)
+        p = jnp.exp(s - jnp.tile(m_next[:, :1], (1, block_kv)))
+        alpha = jnp.exp(m_prev - m_next)
+        l_corr = alpha * l_prev
+        l_next = jnp.sum(p, axis=1)[:, None] + l_corr
+
+        m_scr[...] = m_next
+        l_scr[...] = l_next
+
+        v = v_ref[0]
+        o_curr = lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if d_v >= LANES:
+            bcast = lambda x: jnp.tile(x[:, :1], (1, d_v))  # noqa: E731
+        else:
+            bcast = lambda x: x[:, :d_v]  # noqa: E731
+        l_inv = jnp.where(l_next == 0.0, 1.0, 1.0 / l_next)
+        acc_scr[...] = acc_scr[...] * bcast(l_corr * l_inv) + o_curr * bcast(l_inv)
+
+    if causal:
+        pl.when(_block_visible(iq, ikv, block_q, block_kv, offset))(_body)
+    else:
+        _body()
+
+    @pl.when(ikv == num_kv_blocks - 1)
+    def _store():
+        o_ref[0] = acc_scr[...].astype(o_ref.dtype)
+        m, l = m_scr[...], l_scr[...]
+        # lse = m + log(l). Rows with l == 0 only occur when every kv block
+        # was causally invisible for the whole q block; the backward pass
+        # skips exactly those blocks, so their lse is never read.
+        lse_ref[0] = m + jnp.log(jnp.where(l == 0.0, 1.0, l))
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _recompute_p(q, k, bias_row, lse_col, iq, ikv, block_q, block_kv, offset, sm_scale, causal):
+    """Recompute the probability tile p = exp(s_masked - lse)."""
+    s = lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+    s = s * sm_scale + bias_row
+    if causal:
+        keep = _right_aligned_mask(s.shape[0], s.shape[1], iq, ikv, block_q, block_kv, offset)
+        s = jnp.where(keep, s, MASK_VALUE)
+    return jnp.exp(s - lse_col)
+
+
+def _dkv_kernel(
+    bias_ref,  # (1, block_kv)
+    q_ref,  # (1, block_q, d_qk)
+    k_ref,  # (1, block_kv, d_qk)
+    v_ref,  # (1, block_kv, d_v)
+    do_ref,  # (1, block_q, d_v)
+    lse_ref,  # (1, block_q, LANES)
+    delta_ref,  # (1, block_q, LANES)
+    dk_ref,  # (1, block_kv, d_qk)
+    dv_ref,  # (1, block_kv, d_v)
+    dk_scr,  # (block_kv, d_qk) f32
+    dv_scr,  # (block_kv, d_v) f32
+    *,
+    causal: bool,
+    offset: int,
+    sm_scale: float,
+    num_q_blocks: int,
+):
+    ikv, iq = pl.program_id(1), pl.program_id(2)
+    block_kv, _ = dk_scr.shape
+    block_q = q_ref.shape[1]
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    def _body():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0][:, :1]  # (block_q, 1)
+        delta = delta_ref[0][:, :1]
+
+        p = _recompute_p(q, k, bias_ref[...], lse, iq, ikv, block_q, block_kv, offset, sm_scale, causal)
+        # dv += p^T do
+        dv_scr[...] += lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        # dp = do v^T ; ds = p * (dp - delta) * sm_scale
+        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        # dk += ds^T q
+        dk_scr[...] += lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    if causal:
+        pl.when(_block_visible(iq, ikv, block_q, block_kv, offset))(_body)
+    else:
+        _body()
+
+    @pl.when(iq == num_q_blocks - 1)
+    def _store():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _dq_kernel(
+    bias_ref,  # (1, block_kv)
+    q_ref,  # (1, block_q, d_qk)
+    k_ref,  # (1, block_kv, d_qk)
+    v_ref,  # (1, block_kv, d_v)
+    do_ref,  # (1, block_q, d_v)
+    lse_ref,  # (1, block_q, LANES)
+    delta_ref,  # (1, block_q, LANES)
+    dq_ref,  # (1, block_q, d_qk)
+    dq_scr,  # (block_q, d_qk) f32
+    *,
+    causal: bool,
+    offset: int,
+    sm_scale: float,
+    num_kv_blocks: int,
+):
+    iq, ikv = pl.program_id(1), pl.program_id(2)
+    block_q, _ = dq_scr.shape
+    block_kv = k_ref.shape[1]
+
+    @pl.when(ikv == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    def _body():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0][:, :1]
+        delta = delta_ref[0][:, :1]
+
+        p = _recompute_p(q, k, bias_ref[...], lse, iq, ikv, block_q, block_kv, offset, sm_scale, causal)
+        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta) * sm_scale).astype(k.dtype)
+        dq_scr[...] += lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    if causal:
+        pl.when(_block_visible(iq, ikv, block_q, block_kv, offset))(_body)
+    else:
+        _body()
+
+    @pl.when(ikv == num_kv_blocks - 1)
+    def _store():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# host-side wrappers
+# ---------------------------------------------------------------------------
+
+
+def _pad_to(x: jnp.ndarray, axis: int, multiple: int) -> jnp.ndarray:
+    n = x.shape[axis]
+    pad = (-n) % multiple
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9)
+)
+def _flash(q, k, v, bias, causal, offset, sm_scale, block_q, block_kv, num_heads):
+    out, _ = _flash_fwd_impl(q, k, v, bias, causal, offset, sm_scale, block_q, block_kv, num_heads)
+    return out
+
+
+def _flash_fwd_impl(q, k, v, bias, causal, offset, sm_scale, block_q, block_kv, num_heads):
+    bh, nq, d_qk = q.shape
+    nkv = k.shape[1]
+    d_v = v.shape[2]
+    h = num_heads
+    grid = (bh, nq // block_q, nkv // block_kv)
+
+    out, lse = pl.pallas_call(
+        functools.partial(
+            _fwd_kernel,
+            causal=causal,
+            offset=offset,
+            sm_scale=sm_scale,
+            num_kv_blocks=grid[2],
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_kv), lambda b, i, j: (b // h, j)),
+            pl.BlockSpec((1, block_q, d_qk), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_kv, d_qk), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_kv, d_v), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d_v), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, nq, d_v), q.dtype),
+            jax.ShapeDtypeStruct((bh, nq, LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, d_v), jnp.float32),
+        ],
+        interpret=_interpret_default(),
+    )(bias, q, k, v)
+    return out, lse
+
+
+def _flash_fwd(q, k, v, bias, causal, offset, sm_scale, block_q, block_kv, num_heads):
+    out, lse = _flash_fwd_impl(q, k, v, bias, causal, offset, sm_scale, block_q, block_kv, num_heads)
+    return out, (q, k, v, bias, out, lse)
+
+
+def _flash_bwd(causal, offset, sm_scale, block_q, block_kv, num_heads, residuals, g):
+    q, k, v, bias, out, lse = residuals
+    bh, nq, d_qk = q.shape
+    nkv = k.shape[1]
+    d_v = v.shape[2]
+    h = num_heads
+
+    # delta_i = sum_c dO_ic * O_ic, broadcast over lanes for tiled loads
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (bh, nq, LANES))
+
+    nqb, nkvb = nq // block_q, nkv // block_kv
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel,
+            causal=causal,
+            offset=offset,
+            sm_scale=sm_scale,
+            num_q_blocks=nqb,
+        ),
+        grid=(bh, nkvb, nqb),
+        in_specs=[
+            pl.BlockSpec((1, block_kv), lambda b, j, i: (b // h, j)),
+            pl.BlockSpec((1, block_q, d_qk), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_kv, d_qk), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_kv, d_v), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d_v), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda b, j, i: (b, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_kv, d_qk), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_kv, d_v), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, nkv, d_qk), k.dtype),
+            jax.ShapeDtypeStruct((bh, nkv, d_v), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_kv, d_qk), jnp.float32),
+            pltpu.VMEM((block_kv, d_v), jnp.float32),
+        ],
+        interpret=_interpret_default(),
+    )(bias, q, k, v, g, lse, delta)
+
+    (dq,) = pl.pallas_call(
+        functools.partial(
+            _dq_kernel,
+            causal=causal,
+            offset=offset,
+            sm_scale=sm_scale,
+            num_kv_blocks=nkvb,
+        ),
+        grid=(bh, nqb, nkvb),
+        in_specs=[
+            pl.BlockSpec((1, block_kv), lambda b, i, j: (b // h, j)),
+            pl.BlockSpec((1, block_q, d_qk), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_kv, d_qk), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_kv, d_v), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d_v), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d_qk), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((bh, nq, d_qk), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_q, d_qk), jnp.float32)],
+        interpret=_interpret_default(),
+    )(bias, q, k, v, g, lse, delta)
+
+    return dq, dk, dv, jnp.zeros_like(bias)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    pad_mask: Optional[jnp.ndarray] = None,
+    causal: bool = False,
+    sm_scale: float = 1.0,
+    block_q: int = 512,
+    block_kv: int = 512,
+) -> jnp.ndarray:
+    """Blockwise fused attention.
+
+    :param q: queries (B, H, Nq, Dqk); assumed already scaled/rotated.
+    :param k: keys (B, H, Nkv, Dqk).
+    :param v: values (B, H, Nkv, Dv).
+    :param pad_mask: optional (B, Nkv) boolean mask, True = padding slot.
+    :param causal: apply the right-aligned causal mask
+        ``kv_j <= q_i + (Nkv - Nq)`` (reference: modules.py:135-140).
+    :param sm_scale: score scale applied inside the kernel.
+    :returns: attention output (B, H, Nq, Dv) in q's dtype.
+    """
+    b, h, nq, d_qk = q.shape
+    nkv = k.shape[2]
+    d_v = v.shape[3]
+    offset = nkv - nq  # from the *unpadded* lengths
+
+    block_q = min(block_q, _round_pow2_cap(nq))
+    block_kv = min(block_kv, _round_pow2_cap(nkv))
+
+    qf = _pad_to(q.reshape(b * h, nq, d_qk), 1, block_q)
+    kf = _pad_to(k.reshape(b * h, nkv, d_qk), 1, block_kv)
+    vf = _pad_to(v.reshape(b * h, nkv, d_v), 1, block_kv)
+
+    # additive kv bias per (batch*head) row: padded slots + user pad mask
+    nkv_p = kf.shape[1]
+    bias = jnp.zeros((b, nkv_p), jnp.float32)
+    if pad_mask is not None:
+        bias = bias.at[:, :nkv].set(jnp.where(pad_mask, MASK_VALUE, 0.0))
+    if nkv_p != nkv:
+        bias = bias.at[:, nkv:].set(MASK_VALUE)
+    # bias stays (B, Nkv_p): kernels index it with (bh // num_heads, j)
+
+    out = _flash(qf, kf, vf, bias, causal, offset, sm_scale, block_q, block_kv, h)
+    return out[:, :nq].reshape(b, h, nq, d_v)
+
+
+def _round_pow2_cap(n: int) -> int:
+    """Largest power of two <= n (min 128) — keeps blocks tile-aligned for
+    short sequences."""
+    p = 128
+    while p * 2 <= n:
+        p *= 2
+    return p
+
+
+def flash_supported(
+    nq: int, nkv: int, d_qk: int, d_v: int, has_dropout: bool
+) -> bool:
+    """Whether the fused path applies: no attention-prob dropout (the einsum
+    path keeps that reference feature), head dims tile-compatible, and
+    sequences long enough to be worth a kernel launch."""
+    if has_dropout:
+        return False
+    if d_qk % 8 != 0 or d_v % 8 != 0 or d_qk > 512 or d_v > 512:
+        return False
+    return nq >= 128 and nkv >= 128
+
+
+_FLASH_DEFAULT: Optional[bool] = None  # None = auto (TPU backend only)
+
+
+def set_default_flash(mode: Optional[bool]) -> None:
+    """Override the auto policy: True forces the fused path everywhere it is
+    supported (interpret mode off-TPU — slow, for tests), False disables it,
+    None restores auto (fused on TPU only)."""
+    global _FLASH_DEFAULT
+    _FLASH_DEFAULT = mode
+
+
+def flash_enabled(explicit: Optional[bool] = None) -> bool:
+    if explicit is not None:
+        return explicit
+    if _FLASH_DEFAULT is not None:
+        return _FLASH_DEFAULT
+    return jax.default_backend() == "tpu"
