@@ -483,7 +483,11 @@ _FLASH_DEFAULT: Optional[bool] = None  # None = auto (TPU backend only)
 def set_default_flash(mode: Optional[bool]) -> None:
     """Override the auto policy: True forces the fused path everywhere it is
     supported (interpret mode off-TPU — slow, for tests), False disables it,
-    None restores auto (fused on TPU only)."""
+    None restores auto (fused on TPU only).
+
+    The flag is read at **trace time**: functions already jit-compiled keep
+    whatever path they were traced with. Set it before building/jitting the
+    model (or clear jit caches) for the toggle to take effect."""
     global _FLASH_DEFAULT
     _FLASH_DEFAULT = mode
 

@@ -5,3 +5,9 @@ from perceiver_io_tpu.parallel.mesh import (
     replicated,
     shard_batch,
 )
+from perceiver_io_tpu.parallel.ring_attention import (
+    make_ring_cross_attention,
+    make_ring_self_attention,
+    ring_self_attention,
+    seq_sharded_cross_attention,
+)

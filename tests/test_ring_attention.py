@@ -1,0 +1,107 @@
+"""Sequence-parallel attention (ring + seq-sharded cross) must reproduce
+dense softmax attention exactly, on an 8-virtual-device CPU mesh — masks,
+right-aligned causality, and fully-masked rows included."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from perceiver_io_tpu.parallel import make_mesh
+from perceiver_io_tpu.parallel.ring_attention import (
+    make_ring_cross_attention,
+    make_ring_self_attention,
+)
+
+B, H, DK, DV = 2, 3, 8, 16
+
+
+def dense_attention(q, k, v, pad_mask=None, causal=False):
+    """Straight-line reference: full scores, right-aligned causal mask."""
+    n_q, n_kv = q.shape[2], k.shape[2]
+    s = jnp.einsum("bhnd,bhmd->bhnm", q, k).astype(jnp.float32)
+    masked = jnp.zeros((1, 1, 1, n_kv), bool)
+    if pad_mask is not None:
+        masked = masked | pad_mask[:, None, None, :]
+    if causal:
+        q_abs = n_kv - n_q + jnp.arange(n_q)
+        masked = masked | (jnp.arange(n_kv)[None, None, None, :] > q_abs[None, None, :, None])
+    s = jnp.where(masked, -jnp.inf, s)
+    a = jax.nn.softmax(s, axis=-1)
+    a = jnp.where(jnp.isnan(a), 0.0, a)  # fully-masked rows
+    return jnp.einsum("bhnm,bhmd->bhnd", a, v)
+
+
+def make_qkv(rng, n_q, n_kv):
+    q = jnp.asarray(rng.standard_normal((B, H, n_q, DK)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, H, n_kv, DK)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, H, n_kv, DV)), jnp.float32)
+    return q, k, v
+
+
+@pytest.fixture(scope="module")
+def seq_mesh():
+    return make_mesh(data=1, seq=4, devices=jax.devices()[:4])
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("with_pad", [False, True])
+def test_seq_sharded_cross_attention(rng, seq_mesh, causal, with_pad):
+    n_q, n_kv = 6, 32
+    q, k, v = make_qkv(rng, n_q, n_kv)
+    pad = jnp.asarray(rng.random((B, n_kv)) < 0.3) if with_pad else jnp.zeros((B, n_kv), bool)
+
+    attn = make_ring_cross_attention(seq_mesh, causal=causal)
+    out = attn(q, k, v, pad)
+    ref = dense_attention(q, k, v, pad_mask=pad, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("with_pad", [False, True])
+def test_ring_self_attention(rng, seq_mesh, causal, with_pad):
+    n = 32  # both q and kv sharded: 8 per device
+    q, k, v = make_qkv(rng, n, n)
+    pad = jnp.asarray(rng.random((B, n)) < 0.25) if with_pad else jnp.zeros((B, n), bool)
+
+    attn = make_ring_self_attention(seq_mesh, causal=causal)
+    out = attn(q, k, v, pad)
+    ref = dense_attention(q, k, v, pad_mask=pad, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_cross_attention_fully_masked_row_is_zero(rng, seq_mesh):
+    n_q, n_kv = 4, 16
+    q, k, v = make_qkv(rng, n_q, n_kv)
+    pad = jnp.ones((B, n_kv), bool)  # everything masked
+    attn = make_ring_cross_attention(seq_mesh)
+    out = attn(q, k, v, pad)
+    np.testing.assert_allclose(np.asarray(out), 0.0, atol=1e-6)
+
+
+def test_ring_self_attention_right_aligned_causal(rng, seq_mesh):
+    """Global q shorter than global kv: query i sits at slot kv_total - q_total + i
+    (the core attention right-alignment contract)."""
+    n_q, n_kv = 16, 32
+    q, k, v = make_qkv(rng, n_q, n_kv)
+    out = make_ring_self_attention(seq_mesh, causal=True)(q, k, v)
+    ref = dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_wrappers_accept_missing_pad_mask(rng, seq_mesh):
+    n = 16
+    q, k, v = make_qkv(rng, n, n)
+    out = make_ring_cross_attention(seq_mesh)(q, k, v)
+    ref = dense_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_ring_matches_on_eight_devices(rng):
+    mesh = make_mesh(data=1, seq=8)
+    n = 64
+    q, k, v = make_qkv(rng, n, n)
+    pad = jnp.zeros((B, n), bool)
+    out = make_ring_self_attention(mesh, causal=True)(q, k, v, pad)
+    ref = dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
