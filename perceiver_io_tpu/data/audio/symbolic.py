@@ -184,3 +184,105 @@ class SymbolicAudioDataModule:
             shuffle=False,
             collate=self._collator,
         )
+
+
+# ---------------------------------------------------------- dataset modules
+
+
+class DirectorySymbolicAudioDataModule(SymbolicAudioDataModule):
+    """Local-directory source: ``<dataset_dir>/{train,valid}`` of .mid files.
+    The fully-offline module (this environment has no network egress)."""
+
+    def load_source_dataset(self) -> Dict[str, Path]:
+        return {"train": self.dataset_dir / "train", "valid": self.dataset_dir / "valid"}
+
+
+class _ArchiveSymbolicAudioDataModule(SymbolicAudioDataModule):
+    """Base for archive-backed datasets (reference:
+    perceiver/data/audio/{giantmidi_piano,maestro_v3}.py — zip download +
+    extract). Download is network-gated: the archive (or its extracted tree)
+    must already exist under ``dataset_dir``; ``prepare_data`` then splits
+    deterministically."""
+
+    archive_name: str = ""
+    extracted_subdir: str = ""
+    valid_fraction: float = 0.05
+
+    @property
+    def extracted_dir(self) -> Path:
+        return self.dataset_dir / self.extracted_subdir
+
+    def _extract(self) -> None:
+        if self.extracted_dir.exists():
+            return
+        archive = self.dataset_dir / self.archive_name
+        if not archive.exists():
+            raise FileNotFoundError(
+                f"{archive} not found; download it first (no network egress here). "
+                f"Alternatively use DirectorySymbolicAudioDataModule over local .mid dirs."
+            )
+        import zipfile
+
+        with zipfile.ZipFile(archive) as zf:
+            zf.extractall(self.dataset_dir)
+
+    def _split_files(self) -> Dict[str, List[Path]]:
+        files = sorted(self.extracted_dir.rglob("*.mid")) + sorted(self.extracted_dir.rglob("*.midi"))
+        random.Random(self.seed).shuffle(files)
+        n_valid = max(1, int(len(files) * self.valid_fraction))
+        return {"train": files[n_valid:], "valid": files[:n_valid]}
+
+    def load_source_dataset(self) -> Dict[str, Path]:
+        self._extract()
+        # materialize split directories of symlinks so the base preproc
+        # (directory-driven) applies unchanged
+        import hashlib
+        import shutil
+
+        split_root = self.dataset_dir / "splits"
+        splits = self._split_files()
+        for split, files in splits.items():
+            d = split_root / split
+            if d.exists():  # stale links from a previous (possibly different) split
+                shutil.rmtree(d)
+            d.mkdir(parents=True)
+            for f in files:
+                digest = hashlib.md5(str(f).encode()).hexdigest()[:12]
+                link = d / f"{digest}-{f.name}"
+                try:
+                    link.symlink_to(f.resolve())
+                except OSError:
+                    shutil.copy(f, link)
+        return {"train": split_root / "train", "valid": split_root / "valid"}
+
+
+class GiantMidiPianoDataModule(_ArchiveSymbolicAudioDataModule):
+    """GiantMIDI-Piano (reference: perceiver/data/audio/giantmidi_piano.py)."""
+
+    archive_name = "midis_v1.2.zip"
+    extracted_subdir = "midis"
+
+
+class MaestroV3DataModule(_ArchiveSymbolicAudioDataModule):
+    """Maestro V3 (reference: perceiver/data/audio/maestro_v3.py — split by
+    the metadata json when present, else deterministic fraction split)."""
+
+    archive_name = "maestro-v3.0.0-midi.zip"
+    extracted_subdir = "maestro-v3.0.0"
+
+    def _split_files(self) -> Dict[str, List[Path]]:
+        meta = self.extracted_dir / "maestro-v3.0.0.json"
+        if not meta.exists():
+            return super()._split_files()
+        import json
+
+        with open(meta) as f:
+            m = json.load(f)
+        # column-oriented json: {"split": {idx: name}, "midi_filename": {idx: path}}
+        splits: Dict[str, List[Path]] = {"train": [], "valid": []}
+        for idx, split in m["split"].items():
+            path = self.extracted_dir / m["midi_filename"][idx]
+            key = "valid" if split == "validation" else ("train" if split == "train" else None)
+            if key and path.exists():
+                splits[key].append(path)
+        return splits

@@ -1,0 +1,105 @@
+"""Symbolic-audio (MIDI) Perceiver AR training CLI
+(reference: perceiver/scripts/audio/symbolic.py:8-30).
+
+Links: ``data.max_seq_len → model.max_seq_len``; vocab is the fixed MIDI
+event vocabulary (389).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import numpy as np
+
+from perceiver_io_tpu.models.audio.symbolic import SymbolicAudioModel, SymbolicAudioModelConfig
+from perceiver_io_tpu.scripts import cli
+from perceiver_io_tpu.training.losses import clm_loss_fn
+
+
+@dataclass
+class AudioDataArgs:
+    dataset: str = "directory"  # directory | giantmidi | maestro
+    dataset_dir: str = ".cache/audio"
+    max_seq_len: int = 4096
+    min_seq_len: Optional[int] = None
+    batch_size: int = 16
+    preproc_workers: int = 1
+    seed: int = 0
+
+
+def build_audio_datamodule(args: AudioDataArgs):
+    from perceiver_io_tpu.data.audio.symbolic import (
+        DirectorySymbolicAudioDataModule,
+        GiantMidiPianoDataModule,
+        MaestroV3DataModule,
+    )
+
+    classes = {
+        "directory": DirectorySymbolicAudioDataModule,
+        "giantmidi": GiantMidiPianoDataModule,
+        "maestro": MaestroV3DataModule,
+    }
+    if args.dataset not in classes:
+        raise ValueError(f"unknown dataset {args.dataset!r}; choose from {sorted(classes)}")
+    return classes[args.dataset](
+        dataset_dir=args.dataset_dir,
+        max_seq_len=args.max_seq_len,
+        min_seq_len=args.min_seq_len,
+        batch_size=args.batch_size,
+        preproc_workers=args.preproc_workers,
+        seed=args.seed,
+    )
+
+
+def main(argv: Optional[Sequence[str]] = None):
+    parser = cli.make_parser(
+        "Perceiver AR symbolic audio model",
+        optimizer_defaults={"lr": 2e-4, "warmup_steps": 200},
+    )
+    # paper presets (reference: scripts/audio/symbolic.py:14-28)
+    cli.add_dataclass_args(
+        parser,
+        SymbolicAudioModelConfig,
+        "model",
+        {"max_latents": 1024, "num_channels": 512, "num_self_attention_layers": 8},
+    )
+    cli.add_dataclass_args(parser, AudioDataArgs, "data")
+    args = cli.parse_args(parser, argv)
+
+    trainer_args = cli.build_dataclass(cli.TrainerArgs, args, "trainer")
+    opt_args = cli.build_dataclass(cli.OptimizerArgs, args, "optimizer")
+    data_args = cli.build_dataclass(AudioDataArgs, args, "data")
+
+    data = build_audio_datamodule(data_args)
+    data.prepare_data()
+    model_config = cli.build_dataclass(
+        SymbolicAudioModelConfig,
+        args,
+        "model",
+        vocab_size=data.vocab_size,
+        max_seq_len=data_args.max_seq_len,
+    )
+    model = SymbolicAudioModel(model_config, dtype=cli.activation_dtype(trainer_args))
+
+    seq_len = data_args.max_seq_len
+    init_batch = {
+        "x": np.zeros((1, seq_len), np.int32),
+        "prefix_len": seq_len - model_config.max_latents,
+        "pad_mask": np.zeros((1, seq_len), bool),
+    }
+    return cli.run_training(
+        model,
+        model_config,
+        lambda apply_fn: clm_loss_fn(apply_fn, model_config.max_latents),
+        init_batch,
+        cli.cycle(data.train_batches()),
+        data.valid_batches(),
+        trainer_args,
+        opt_args,
+        command=args.command,
+    )
+
+
+if __name__ == "__main__":
+    main()

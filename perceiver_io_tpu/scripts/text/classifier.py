@@ -1,0 +1,115 @@
+"""Text-classifier training CLI with two-stage training support
+(reference: perceiver/scripts/text/classifier.py:8-38,
+perceiver/model/text/classifier/lightning.py:14-43):
+
+- ``--model.params=<dir>`` — warm-start the full model from a saved artifact.
+- ``--model.encoder.params=<dir>`` — warm-start encoder (+token adapter)
+  only, e.g. from an MLM run; ``--model.encoder.freeze=true`` freezes it.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from perceiver_io_tpu.core.config import ClassificationDecoderConfig, PerceiverIOConfig
+from perceiver_io_tpu.models.text import TextClassifier, TextEncoderConfig
+from perceiver_io_tpu.scripts import cli
+from perceiver_io_tpu.scripts.text.common import TextDataArgs, build_text_datamodule
+from perceiver_io_tpu.training.losses import classification_loss_fn
+
+ENCODER_SUBTREES = ("input_adapter", "encoder")
+
+
+def make_warm_start(model_params_dir: Optional[str], encoder_params_dir: Optional[str]):
+    if model_params_dir is None and encoder_params_dir is None:
+        return None
+
+    from perceiver_io_tpu.training.checkpoint import load_params_into, load_pretrained
+
+    def warm_start(params):
+        if model_params_dir is not None:
+            loaded, _ = load_pretrained(model_params_dir, template_params=params)
+            return loaded
+        source, _ = load_pretrained(encoder_params_dir)
+        for subtree in ENCODER_SUBTREES:
+            params = load_params_into(params, source, subtree=subtree)
+        return params
+
+    return warm_start
+
+
+def main(argv: Optional[Sequence[str]] = None):
+    parser = cli.make_parser(
+        "Perceiver IO text classifier",
+        optimizer_defaults={"lr": 1e-4, "warmup_steps": 100},
+    )
+    cli.add_dataclass_args(parser, TextEncoderConfig, "model.encoder")
+    cli.add_dataclass_args(
+        parser,
+        ClassificationDecoderConfig,
+        "model.decoder",
+        {"num_output_query_channels": 64, "num_classes": 2},
+    )
+    parser.add_argument("--model.params", dest="model.params", type=str, default=None)
+    parser.add_argument("--model.num_latents", dest="model.num_latents", type=int, default=64)
+    parser.add_argument(
+        "--model.num_latent_channels", dest="model.num_latent_channels", type=int, default=64
+    )
+    parser.add_argument(
+        "--model.activation_checkpointing",
+        dest="model.activation_checkpointing",
+        type=cli._str2bool,
+        default=False,
+    )
+    cli.add_dataclass_args(parser, TextDataArgs, "data", {"dataset": "imdb", "max_seq_len": 256, "batch_size": 64})
+    args = cli.parse_args(parser, argv)
+
+    trainer_args = cli.build_dataclass(cli.TrainerArgs, args, "trainer")
+    opt_args = cli.build_dataclass(cli.OptimizerArgs, args, "optimizer")
+    data_args = cli.build_dataclass(TextDataArgs, args, "data")
+
+    data = build_text_datamodule(data_args, task="clf")
+    num_classes = getattr(data, "num_classes", getattr(args, "model.decoder.num_classes"))
+    encoder = cli.build_dataclass(
+        TextEncoderConfig,
+        args,
+        "model.encoder",
+        vocab_size=data.vocab_size,
+        max_seq_len=data_args.max_seq_len,
+    )
+    decoder = cli.build_dataclass(
+        ClassificationDecoderConfig, args, "model.decoder", num_classes=num_classes
+    )
+    model_config = PerceiverIOConfig(
+        encoder=encoder,
+        decoder=decoder,
+        num_latents=getattr(args, "model.num_latents"),
+        num_latent_channels=getattr(args, "model.num_latent_channels"),
+        activation_checkpointing=getattr(args, "model.activation_checkpointing"),
+    )
+    model = TextClassifier(model_config, dtype=cli.activation_dtype(trainer_args))
+
+    init_batch = {
+        "x": np.zeros((1, data_args.max_seq_len), np.int32),
+        "pad_mask": np.zeros((1, data_args.max_seq_len), bool),
+    }
+    frozen_paths = ENCODER_SUBTREES if encoder.freeze else ()
+    return cli.run_training(
+        model,
+        model_config,
+        lambda apply_fn: classification_loss_fn(apply_fn),
+        init_batch,
+        cli.cycle(data.train_batches()),
+        data.valid_batches(),
+        trainer_args,
+        opt_args,
+        command=args.command,
+        frozen_paths=frozen_paths,
+        warm_start=make_warm_start(getattr(args, "model.params"), encoder.params),
+    )
+
+
+if __name__ == "__main__":
+    main()

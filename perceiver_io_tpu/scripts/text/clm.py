@@ -1,0 +1,115 @@
+"""Causal LM training CLI (reference: perceiver/scripts/text/clm.py:8-27).
+
+Link rules applied (reference ``link_arguments``): ``data.vocab_size →
+model.vocab_size`` (tokenizer-derived), ``data.max_seq_len →
+model.max_seq_len``, ``trainer.max_steps → optimizer.training_steps``.
+At each validation end a text sample is generated and logged
+(reference: perceiver/model/text/clm/lightning.py:55-92).
+
+Run: ``python -m perceiver_io_tpu.scripts.text.clm fit --data.dataset=wikitext
+--trainer.max_steps=1000 ...``
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import numpy as np
+
+from perceiver_io_tpu.models.text import CausalLanguageModel, CausalLanguageModelConfig
+from perceiver_io_tpu.scripts import cli
+from perceiver_io_tpu.scripts.text.common import TextDataArgs, build_text_datamodule
+from perceiver_io_tpu.training.losses import clm_loss_fn
+
+
+@dataclass
+class CLMTaskArgs:
+    sample_prompt: Optional[str] = None
+    num_sample_tokens: int = 512
+    sample_top_k: int = 10
+
+
+def make_sample_callback(model, tokenizer, task_args: CLMTaskArgs):
+    """Validation-end sample generation logged as text
+    (reference: clm/lightning.py:55-92, @rank_zero_only)."""
+    import jax
+
+    from perceiver_io_tpu.generation import GenerationConfig, generate
+
+    def callback(trainer, state, step):
+        if task_args.sample_prompt is None:
+            return
+        prompt = np.asarray([tokenizer.encode(task_args.sample_prompt)], dtype=np.int32)
+        num_latents = min(model.config.max_latents, prompt.shape[1])
+        out = generate(
+            model,
+            state.params,
+            prompt,
+            num_latents=num_latents,
+            config=GenerationConfig(
+                max_new_tokens=task_args.num_sample_tokens, top_k=task_args.sample_top_k
+            ),
+            rng=jax.random.PRNGKey(step),
+        )
+        text = tokenizer.decode(np.asarray(out[0]).tolist())
+        if trainer.logger is not None:
+            trainer.logger.log_text(step, "generated_text", text)
+
+    return callback
+
+
+def main(argv: Optional[Sequence[str]] = None):
+    parser = cli.make_parser(
+        "Perceiver AR causal language model",
+        optimizer_defaults={"lr": 2e-4, "warmup_steps": 200},
+    )
+    cli.add_dataclass_args(
+        parser,
+        CausalLanguageModelConfig,
+        "model",
+        # paper-preset defaults (reference: scripts/text/clm.py:16-24)
+        {"max_latents": 512, "num_channels": 512, "num_self_attention_layers": 8, "cross_attention_dropout": 0.5},
+    )
+    cli.add_dataclass_args(parser, TextDataArgs, "data", {"max_seq_len": 4096, "batch_size": 8})
+    cli.add_dataclass_args(parser, CLMTaskArgs, "task")
+    args = cli.parse_args(parser, argv)
+
+    trainer_args = cli.build_dataclass(cli.TrainerArgs, args, "trainer")
+    opt_args = cli.build_dataclass(cli.OptimizerArgs, args, "optimizer")
+    data_args = cli.build_dataclass(TextDataArgs, args, "data")
+    task_args = cli.build_dataclass(CLMTaskArgs, args, "task")
+
+    data = build_text_datamodule(data_args, task="clm")
+    # data→model links (reference: clm.py:13-14)
+    model_config = cli.build_dataclass(
+        CausalLanguageModelConfig,
+        args,
+        "model",
+        vocab_size=data.vocab_size,
+        max_seq_len=data_args.max_seq_len,
+    )
+    model = CausalLanguageModel(model_config, dtype=cli.activation_dtype(trainer_args))
+
+    seq_len = data_args.max_seq_len
+    init_batch = {
+        "x": np.zeros((1, seq_len), np.int32),
+        "prefix_len": seq_len - model_config.max_latents,
+        "pad_mask": np.zeros((1, seq_len), bool),
+    }
+    return cli.run_training(
+        model,
+        model_config,
+        lambda apply_fn: clm_loss_fn(apply_fn, model_config.max_latents),
+        init_batch,
+        cli.cycle(data.train_batches()),
+        data.valid_batches(),
+        trainer_args,
+        opt_args,
+        command=args.command,
+        callbacks=[make_sample_callback(model, data.tokenizer, task_args)],
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -219,12 +219,29 @@ class CheckpointManager:
 
     def restore(self, state, step: Optional[int] = None):
         """Restore into (a copy of) ``state``; returns the updated state.
-        ``step=None`` restores the latest checkpoint."""
+        ``step=None`` restores the latest checkpoint. Restores whatever the
+        checkpoint actually contains: resuming from a weights-only checkpoint
+        restores params/step/rng and leaves the optimizer state fresh
+        (Lightning ``save_weights_only`` resume semantics)."""
         step = self._mngr.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(f"no checkpoint found under {self.directory}")
-        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, _state_payload(state, self.save_weights_only))
-        restored = self._mngr.restore(step, args=ocp.args.StandardRestore(abstract))
+        def attempt(weights_only: bool):
+            payload = _state_payload(state, weights_only)
+            abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, payload)
+            return self._mngr.restore(step, args=ocp.args.StandardRestore(abstract))
+
+        # try the layout this manager would have written first; fall back to
+        # the other layout (e.g. resuming full-state training from a
+        # weights-only checkpoint). Re-raise the ORIGINAL error when both
+        # fail so genuine mismatches (shape/optimizer changes) stay visible.
+        try:
+            restored = attempt(self.save_weights_only)
+        except ValueError as primary_err:
+            try:
+                restored = attempt(not self.save_weights_only)
+            except ValueError:
+                raise primary_err
         return state.replace(**restored)
 
     def load_config(self):

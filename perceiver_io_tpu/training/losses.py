@@ -32,7 +32,7 @@ def classification_loss_fn(apply_fn, deterministic: bool = False) -> Callable:  
     analog)."""
 
     def loss_fn(params, batch: Dict, rng, deterministic: bool = deterministic) -> Tuple[jnp.ndarray, Dict]:
-        x = batch.get("x", batch.get("image"))
+        x = batch.get("x", batch.get("image", batch.get("input_ids")))
         y = batch["label"]
         pad_mask = batch.get("pad_mask")
         kwargs = {} if pad_mask is None else {"pad_mask": pad_mask}

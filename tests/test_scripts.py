@@ -1,0 +1,355 @@
+"""L5 CLI layer tests: dataclass auto-flags, YAML defaults, link rules, and
+tiny end-to-end `fit` runs per task (reference test strategy category 2/6,
+SURVEY §4)."""
+
+import argparse
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from perceiver_io_tpu.scripts import cli
+
+
+# ---------------------------------------------------------------- engine
+
+
+def test_dataclass_args_roundtrip():
+    from perceiver_io_tpu.models.text import TextEncoderConfig
+
+    parser = argparse.ArgumentParser()
+    cli.add_dataclass_args(parser, TextEncoderConfig, "model.encoder")
+    ns = parser.parse_args(
+        [
+            "--model.encoder.num_cross_attention_heads=4",
+            "--model.encoder.num_cross_attention_qk_channels=None",
+            "--model.encoder.freeze=true",
+            "--model.encoder.vocab_size=262",
+        ]
+    )
+    config = cli.build_dataclass(TextEncoderConfig, ns, "model.encoder")
+    assert config.num_cross_attention_heads == 4
+    assert config.num_cross_attention_qk_channels is None
+    assert config.freeze is True
+    assert config.vocab_size == 262
+    # untouched fields keep dataclass defaults
+    assert config.num_self_attention_layers_per_block == 8
+
+
+def test_tuple_field_parsing():
+    from perceiver_io_tpu.models.vision.image_classifier import ImageEncoderConfig
+
+    parser = argparse.ArgumentParser()
+    cli.add_dataclass_args(parser, ImageEncoderConfig, "enc")
+    ns = parser.parse_args(["--enc.image_shape=32,32,3"])
+    config = cli.build_dataclass(ImageEncoderConfig, ns, "enc")
+    assert config.image_shape == (32, 32, 3)
+
+
+def test_yaml_defaults_and_override(tmp_path):
+    cfg = tmp_path / "defaults.yaml"
+    cfg.write_text("trainer:\n  max_steps: 7\noptimizer:\n  lr: 0.5\n")
+    parser = cli.make_parser("test")
+    ns = cli.parse_args(parser, ["fit", "--config", str(cfg), "--optimizer.lr=0.25"])
+    trainer = cli.build_dataclass(cli.TrainerArgs, ns, "trainer")
+    opt = cli.build_dataclass(cli.OptimizerArgs, ns, "optimizer")
+    assert trainer.max_steps == 7  # from yaml
+    assert opt.lr == 0.25  # explicit flag wins over yaml
+
+
+def test_yaml_unknown_key_rejected(tmp_path):
+    cfg = tmp_path / "bad.yaml"
+    cfg.write_text("trainer:\n  nonexistent_flag: 1\n")
+    parser = cli.make_parser("test")
+    with pytest.raises(ValueError, match="unknown keys"):
+        cli.parse_args(parser, ["fit", "--config", str(cfg)])
+
+
+def test_lr_schedule_linked_to_max_steps():
+    opt = cli.OptimizerArgs(lr=1.0, lr_scheduler="cosine_with_warmup", warmup_steps=0, training_steps=None)
+    schedule = cli.make_lr_schedule(opt, max_steps=100)
+    assert float(schedule(100)) == pytest.approx(0.0, abs=1e-6)
+
+
+def test_make_mesh_for_strategies():
+    trainer = cli.TrainerArgs(strategy="dp")
+    mesh = cli.make_mesh_for(trainer)
+    assert mesh is not None and mesh.shape["data"] == 8
+    mesh = cli.make_mesh_for(cli.TrainerArgs(strategy="fsdp"))
+    assert mesh.shape["fsdp"] == 8 and mesh.shape["data"] == 1
+    with pytest.raises(ValueError, match="unknown strategy"):
+        cli.make_mesh_for(cli.TrainerArgs(strategy="nope"))
+
+
+# ---------------------------------------------------------- end-to-end fits
+
+
+def _tiny_trainer_flags(tmp_path, steps=3):
+    return [
+        "--trainer.devices=1",
+        f"--trainer.max_steps={steps}",
+        "--trainer.log_interval=1",
+        f"--trainer.default_root_dir={tmp_path}",
+        "--trainer.checkpoint=false",
+        "--optimizer.warmup_steps=1",
+    ]
+
+
+def test_clm_cli_fit(tmp_path):
+    from perceiver_io_tpu.scripts.text.clm import main
+
+    train_file = tmp_path / "train.txt"
+    train_file.write_text("hello world, this is a tiny corpus. " * 40)
+    state, _ = main(
+        [
+            "fit",
+            "--data.dataset=textfile",
+            f"--data.train_file={train_file}",
+            "--data.max_seq_len=32",
+            "--data.batch_size=2",
+            f"--data.cache_dir={tmp_path / 'cache'}",
+            "--model.max_latents=8",
+            "--model.num_channels=32",
+            "--model.num_self_attention_layers=1",
+            "--model.num_heads=2",
+            "--task.sample_prompt=hello",
+            "--task.num_sample_tokens=4",
+            "--trainer.val_interval=3",
+            *_tiny_trainer_flags(tmp_path),
+        ]
+    )
+    assert int(state.step) == 3
+    # metrics were written
+    metrics_files = list(Path(tmp_path).rglob("metrics.csv"))
+    assert metrics_files, "expected a metrics.csv in the run dir"
+
+
+def test_mlm_cli_fit(tmp_path):
+    from perceiver_io_tpu.scripts.text.mlm import main as mlm_main
+    from perceiver_io_tpu.training.checkpoint import save_pretrained
+
+    (tmp_path / "train.txt").write_text("tiny text corpus for masking " * 50)
+    mlm_state, _ = mlm_main(
+        [
+            "fit",
+            "--data.dataset=textfile",
+            f"--data.train_file={tmp_path / 'train.txt'}",
+            "--data.max_seq_len=16",
+            "--data.batch_size=2",
+            f"--data.cache_dir={tmp_path / 'cache'}",
+            "--model.encoder.num_input_channels=16",
+            "--model.encoder.num_self_attention_layers_per_block=1",
+            "--model.num_latents=4",
+            "--model.num_latent_channels=16",
+            *_tiny_trainer_flags(tmp_path, steps=2),
+        ]
+    )
+    assert int(mlm_state.step) == 2
+    save_pretrained(str(tmp_path / "mlm_artifact"), mlm_state.params)
+    assert (tmp_path / "mlm_artifact" / "params.msgpack").exists() or list(
+        (tmp_path / "mlm_artifact").iterdir()
+    )
+
+
+def test_classifier_encoder_warm_start_and_freeze(tmp_path):
+    """Encoder params copied from an MLM artifact stay frozen during training
+    (reference: text/classifier/lightning.py:28-36, requires_grad=False)."""
+    import jax
+
+    from perceiver_io_tpu.core.config import ClassificationDecoderConfig, PerceiverIOConfig
+    from perceiver_io_tpu.data.text.datamodule import TextDataModule
+    from perceiver_io_tpu.models.text import MaskedLanguageModel, TextClassifier, TextEncoderConfig
+    from perceiver_io_tpu.models.text.mlm import TextDecoderConfig
+    from perceiver_io_tpu.scripts import cli as cli_mod
+    from perceiver_io_tpu.scripts.text.classifier import ENCODER_SUBTREES, make_warm_start
+    from perceiver_io_tpu.training.checkpoint import save_pretrained
+    from perceiver_io_tpu.training.losses import classification_loss_fn
+
+    encoder_cfg = TextEncoderConfig(
+        vocab_size=262,
+        max_seq_len=16,
+        num_input_channels=16,
+        num_self_attention_layers_per_block=1,
+        freeze=True,
+    )
+    mlm = MaskedLanguageModel(
+        PerceiverIOConfig(
+            encoder=encoder_cfg,
+            decoder=TextDecoderConfig(vocab_size=262, max_seq_len=16),
+            num_latents=4,
+            num_latent_channels=16,
+        )
+    )
+    mlm_params = mlm.init(jax.random.PRNGKey(0), np.zeros((1, 16), np.int32))
+    save_pretrained(str(tmp_path / "mlm"), mlm_params)
+
+    clf = TextClassifier(
+        PerceiverIOConfig(
+            encoder=encoder_cfg,
+            decoder=ClassificationDecoderConfig(num_output_query_channels=16, num_classes=2),
+            num_latents=4,
+            num_latent_channels=16,
+        )
+    )
+    params = clf.init(jax.random.PRNGKey(1), np.zeros((1, 16), np.int32))
+    warm = make_warm_start(None, str(tmp_path / "mlm"))
+    params = warm(params)
+
+    # encoder subtree equals the MLM artifact's
+    for sub in ENCODER_SUBTREES:
+        a = jax.tree_util.tree_leaves(params["params"][sub])
+        b = jax.tree_util.tree_leaves(mlm_params["params"][sub])
+        for x, y in zip(a, b):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y))
+
+    # short fit with freeze: encoder unchanged, decoder changed
+    data = TextDataModule(
+        task="clf",
+        max_seq_len=16,
+        batch_size=2,
+        train_texts=[("good movie", 1), ("bad movie", 0)] * 4,
+        valid_texts=[("fine film", 1)] * 2,
+    )
+    from perceiver_io_tpu.training.optim import freeze_mask, make_optimizer
+    from perceiver_io_tpu.training.state import TrainState
+    from perceiver_io_tpu.training.trainer import Trainer, TrainerConfig
+
+    mask = freeze_mask(params, ENCODER_SUBTREES)
+    tx = make_optimizer(1e-2, frozen_mask=mask)
+    state = TrainState.create(clf.apply, params, tx, jax.random.PRNGKey(2))
+    trainer = Trainer(classification_loss_fn(clf.apply), config=TrainerConfig(max_steps=3, log_interval=10))
+    before = jax.device_get(params)
+    state = trainer.fit(state, cli_mod.cycle(data.train_batches()))
+    after = jax.device_get(state.params)
+    for sub in ENCODER_SUBTREES:
+        a = jax.tree_util.tree_leaves(before["params"][sub])
+        b = jax.tree_util.tree_leaves(after["params"][sub])
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    dec_before = jax.tree_util.tree_leaves(before["params"]["decoder"])
+    dec_after = jax.tree_util.tree_leaves(after["params"]["decoder"])
+    assert any(not np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(dec_before, dec_after))
+
+
+def test_image_classifier_cli_fit(tmp_path):
+    from perceiver_io_tpu.scripts.vision.image_classifier import main
+
+    state, _ = main(
+        [
+            "fit",
+            "--data.synthetic=true",
+            "--data.batch_size=4",
+            "--model.num_latents=4",
+            "--model.num_latent_channels=16",
+            "--model.encoder.num_self_attention_layers_per_block=1",
+            "--model.encoder.num_frequency_bands=4",
+            "--model.encoder.num_cross_attention_heads=1",
+            "--model.decoder.num_output_query_channels=16",
+            *_tiny_trainer_flags(tmp_path),
+        ]
+    )
+    assert int(state.step) == 3
+
+
+def test_preproc_cli(tmp_path):
+    from perceiver_io_tpu.scripts.text.preproc import main
+
+    train_file = tmp_path / "t.txt"
+    train_file.write_text("some text for preprocessing " * 20)
+    main(
+        [
+            "textfile",
+            "--task=clm",
+            f"--data.train_file={train_file}",
+            f"--data.cache_dir={tmp_path / 'cache'}",
+            "--data.max_seq_len=16",
+        ]
+    )
+    assert list((tmp_path / "cache").glob("preproc-*.npz"))
+
+
+def test_resume_from_weights_only_checkpoint(tmp_path):
+    """Resuming full-state training from a weights-only checkpoint restores
+    params and starts the optimizer fresh (Lightning save_weights_only
+    semantics) instead of erroring."""
+    from perceiver_io_tpu.scripts.text.clm import main
+
+    train_file = tmp_path / "train.txt"
+    train_file.write_text("resume me please. " * 60)
+    common = [
+        "--data.dataset=textfile",
+        f"--data.train_file={train_file}",
+        "--data.max_seq_len=32",
+        "--data.batch_size=2",
+        f"--data.cache_dir={tmp_path / 'cache'}",
+        "--model.max_latents=8",
+        "--model.num_channels=32",
+        "--model.num_self_attention_layers=1",
+        "--model.num_heads=2",
+        "--trainer.devices=1",
+        "--trainer.log_interval=10",
+        f"--trainer.default_root_dir={tmp_path}",
+        "--trainer.name=resume_run",
+        "--optimizer.warmup_steps=1",
+    ]
+    state, _ = main(["fit", "--trainer.max_steps=2", "--trainer.val_interval=2", *common])
+    assert int(state.step) == 2
+    # second run: save_weights_only defaults true in trainer.yaml; resume anyway
+    state2, _ = main(
+        [
+            "fit",
+            "--trainer.max_steps=4",
+            "--trainer.val_interval=4",
+            "--trainer.resume=true",
+            "--trainer.save_weights_only=false",
+            *common,
+        ]
+    )
+    assert int(state2.step) == 4
+
+
+def test_validate_restores_checkpoint(tmp_path):
+    """`validate` evaluates the checkpointed weights, not the fresh init
+    (the Lightning `validate --ckpt_path` analog)."""
+    from perceiver_io_tpu.scripts.vision.image_classifier import main
+
+    common = [
+        "--data.synthetic=true",
+        "--data.batch_size=4",
+        "--model.num_latents=4",
+        "--model.num_latent_channels=16",
+        "--model.encoder.num_self_attention_layers_per_block=1",
+        "--model.encoder.num_frequency_bands=4",
+        "--model.encoder.num_cross_attention_heads=1",
+        "--model.decoder.num_output_query_channels=16",
+        "--trainer.devices=1",
+        "--trainer.log_interval=10",
+        f"--trainer.default_root_dir={tmp_path}",
+        "--trainer.name=valrun",
+        "--optimizer.warmup_steps=1",
+    ]
+    state, _ = main(["fit", "--trainer.max_steps=2", "--trainer.val_interval=2", *common])
+    state2, metrics = main(["validate", *common])
+    assert int(state2.step) == 2  # restored, not fresh
+    assert "val_loss" in metrics
+
+
+def test_validate_command(tmp_path):
+    from perceiver_io_tpu.scripts.vision.image_classifier import main
+
+    state, metrics = main(
+        [
+            "validate",
+            "--data.synthetic=true",
+            "--data.batch_size=4",
+            "--model.num_latents=4",
+            "--model.num_latent_channels=16",
+            "--model.encoder.num_self_attention_layers_per_block=1",
+            "--model.encoder.num_frequency_bands=4",
+            "--model.encoder.num_cross_attention_heads=1",
+            "--model.decoder.num_output_query_channels=16",
+            *_tiny_trainer_flags(tmp_path),
+        ]
+    )
+    assert "val_loss" in metrics and "val_acc" in metrics
