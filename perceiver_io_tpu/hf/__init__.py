@@ -1,0 +1,8 @@
+from perceiver_io_tpu.hf.convert import (  # noqa: F401
+    convert_image_classifier,
+    convert_image_classifier_config,
+    convert_masked_language_model,
+    convert_mlm_config,
+    convert_optical_flow,
+    convert_optical_flow_config,
+)
