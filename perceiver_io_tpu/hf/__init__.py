@@ -1,3 +1,4 @@
+from perceiver_io_tpu.hf.auto import auto_model_for_config, from_pretrained  # noqa: F401
 from perceiver_io_tpu.hf.convert import (  # noqa: F401
     convert_image_classifier,
     convert_image_classifier_config,
@@ -5,4 +6,14 @@ from perceiver_io_tpu.hf.convert import (  # noqa: F401
     convert_mlm_config,
     convert_optical_flow,
     convert_optical_flow_config,
+)
+from perceiver_io_tpu.hf.mask_filler import MaskFiller  # noqa: F401
+from perceiver_io_tpu.hf.pipelines import (  # noqa: F401
+    FillMaskPipeline,
+    ImageClassificationPipeline,
+    OpticalFlowPipeline,
+    SymbolicAudioGenerationPipeline,
+    TextClassificationPipeline,
+    TextGenerationPipeline,
+    pipeline,
 )

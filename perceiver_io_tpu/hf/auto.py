@@ -1,0 +1,72 @@
+"""Auto-model resolution: config dataclass -> model class -> loaded model.
+
+The torch-free analog of the reference's HF auto-class registration
+(reference: perceiver/model/*/huggingface.py ``AutoModelFor*.register``):
+a ``save_pretrained`` directory (params + config.json) is enough to rebuild
+the right model without naming its class.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+from perceiver_io_tpu.core.config import (
+    CausalSequenceModelConfig,
+    ClassificationDecoderConfig,
+    PerceiverIOConfig,
+)
+
+
+def auto_model_for_config(config: Any):
+    """Return the (uninitialized) model for a config dataclass.
+
+    Perceiver IO configs dispatch on their encoder/decoder dataclass types,
+    causal sequence configs on the config class itself."""
+    from perceiver_io_tpu.models.audio.symbolic import SymbolicAudioModel, SymbolicAudioModelConfig
+    from perceiver_io_tpu.models.text.clm import CausalLanguageModel, CausalLanguageModelConfig
+    from perceiver_io_tpu.models.text.common import TextEncoderConfig
+    from perceiver_io_tpu.models.text.mlm import MaskedLanguageModel
+    from perceiver_io_tpu.models.vision.image_classifier import ImageClassifier, ImageEncoderConfig
+    from perceiver_io_tpu.models.vision.optical_flow import OpticalFlow, OpticalFlowEncoderConfig
+
+    if isinstance(config, SymbolicAudioModelConfig):
+        return SymbolicAudioModel(config)
+    if isinstance(config, CausalLanguageModelConfig):
+        return CausalLanguageModel(config)
+    if isinstance(config, CausalSequenceModelConfig):
+        from perceiver_io_tpu.core.modules import CausalSequenceModel
+
+        return CausalSequenceModel(config)
+
+    if isinstance(config, PerceiverIOConfig):
+        enc, dec = config.encoder, config.decoder
+        if isinstance(enc, OpticalFlowEncoderConfig):
+            return OpticalFlow(config)
+        if isinstance(enc, ImageEncoderConfig):
+            return ImageClassifier(config)
+        if isinstance(enc, TextEncoderConfig):
+            from perceiver_io_tpu.models.text.classifier import TextClassifier
+
+            if isinstance(dec, ClassificationDecoderConfig):
+                return TextClassifier(config)
+            return MaskedLanguageModel(config)
+        try:
+            from perceiver_io_tpu.models.timeseries import TimeSeriesEncoderConfig, TimeSeriesPerceiver
+        except ImportError:
+            TimeSeriesEncoderConfig = None
+        if TimeSeriesEncoderConfig is not None and isinstance(enc, TimeSeriesEncoderConfig):
+            return TimeSeriesPerceiver(config)
+
+    raise ValueError(f"No model registered for config type {type(config).__name__}")
+
+
+def from_pretrained(directory: str) -> Tuple[Any, Any]:
+    """Load a ``save_pretrained`` directory -> (model, variables)."""
+    from perceiver_io_tpu.training.checkpoint import load_pretrained
+
+    params, config = load_pretrained(directory)
+    if config is None:
+        raise ValueError(f"{directory} has no config.json — cannot auto-resolve the model")
+    model = auto_model_for_config(config)
+    variables = params if "params" in params else {"params": params}
+    return model, variables

@@ -1,0 +1,58 @@
+"""Top-k mask filling for masked language models
+(reference: perceiver/model/text/mlm/utils.py:4-27).
+
+Masked samples are strings containing the tokenizer's mask token (e.g.
+``"I have watched this [MASK] and it was awesome"``); segments between mask
+tokens are tokenized, predictions are read off the logits at the mask
+positions, and each of the top-k fills is decoded back to text.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class MaskFiller:
+    def __init__(self, model, params, tokenizer):
+        self.model = model
+        self.params = params
+        self.tokenizer = tokenizer
+
+    def _encode_masked(self, text: str) -> List[int]:
+        tok = self.tokenizer
+        segments = text.split(tok.mask_token)
+        ids: List[int] = []
+        for i, seg in enumerate(segments):
+            if i > 0:
+                ids.append(tok.mask_token_id)
+            ids.extend(tok.encode(seg))
+        return ids
+
+    def fill(self, masked_samples: Sequence[str], num_predictions: int = 5) -> List[List[str]]:
+        """:return: per sample, ``num_predictions`` decoded texts with every
+        mask position replaced by the k-th most likely token."""
+        tok = self.tokenizer
+        seqs = [self._encode_masked(t) for t in masked_samples]
+        max_len = getattr(getattr(self.model.config, "encoder", None), "max_seq_len", None)
+        ids, pad_mask = tok.pad_sequences(seqs, max_length=max_len, padding_side="right")
+
+        logits = np.asarray(
+            self.model.apply(self.params, jnp.asarray(ids), pad_mask=jnp.asarray(pad_mask))
+        )
+        # top-k predictions at each position, (B, N, k) most-likely-first
+        top = np.argsort(-logits, axis=-1)[..., :num_predictions]
+
+        results: List[List[str]] = []
+        for row in range(ids.shape[0]):
+            row_ids = ids[row][~pad_mask[row]]  # window-truncated, pad-free
+            mask_pos = np.nonzero(row_ids == tok.mask_token_id)[0]
+            fills = []
+            for k in range(num_predictions):
+                filled = row_ids.copy()
+                filled[mask_pos] = top[row, mask_pos, k]
+                fills.append(tok.decode(filled.tolist()))
+            results.append(fills)
+        return results

@@ -1,0 +1,345 @@
+"""Torch-free inference pipelines — the analog of the reference's HF
+pipeline registrations (reference: perceiver/model/*/huggingface.py):
+
+- ``fill-mask``            (reference: mlm/huggingface.py + MaskFiller)
+- ``text-generation``      (reference: clm/huggingface.py:11-65)
+- ``sentiment-analysis``   (reference: classifier/huggingface.py:23-121)
+- ``image-classification`` (reference: vision/image_classifier/huggingface.py)
+- ``optical-flow``         (reference: vision/optical_flow/huggingface.py:71-124)
+- ``symbolic-audio-generation`` (reference: audio/symbolic/huggingface.py:63-190)
+
+Each pipeline holds (model, params) plus its host-side processor and exposes
+``__call__``. ``pipeline(task, model_dir)`` builds one from a
+``save_pretrained`` directory via the auto-model registry.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from perceiver_io_tpu.generation import GenerationConfig, generate
+from perceiver_io_tpu.hf.auto import from_pretrained
+from perceiver_io_tpu.hf.mask_filler import MaskFiller
+
+
+class FillMaskPipeline:
+    """Top-k fill-ins for mask positions in text."""
+
+    def __init__(self, model, params, tokenizer=None):
+        from perceiver_io_tpu.data.text.tokenizer import ByteTokenizer
+
+        self.tokenizer = tokenizer or ByteTokenizer()
+        self.filler = MaskFiller(model, params, self.tokenizer)
+
+    def __call__(self, text: Union[str, Sequence[str]], top_k: int = 5):
+        single = isinstance(text, str)
+        texts = [text] if single else list(text)
+        out = self.filler.fill(texts, num_predictions=top_k)
+        return out[0] if single else out
+
+
+class TextGenerationPipeline:
+    """Prompted generation with the Perceiver AR sliding-window KV cache
+    (reference: clm/huggingface.py text-generation registration +
+    core/huggingface.py:187-230 generate(num_latents=...))."""
+
+    def __init__(self, model, params, tokenizer=None):
+        from perceiver_io_tpu.data.text.tokenizer import ByteTokenizer
+
+        self.model = model
+        self.params = params
+        self.tokenizer = tokenizer or ByteTokenizer()
+
+    def __call__(
+        self,
+        prompt: Union[str, Sequence[str]],
+        max_new_tokens: int = 64,
+        num_latents: int = 1,
+        do_sample: bool = True,
+        temperature: float = 1.0,
+        top_k: Optional[int] = 10,
+        top_p: Optional[float] = None,
+        seed: int = 0,
+    ):
+        single = isinstance(prompt, str)
+        prompts = [prompt] if single else list(prompt)
+        seqs = self.tokenizer.batch_encode(prompts)
+        ids, pad_mask = self.tokenizer.pad_sequences(seqs, padding_side="left")
+        ids, pad_mask, num_latents = _fit_prompt_window(self.model.config, ids, pad_mask, num_latents)
+
+        out = generate(
+            self.model,
+            self.params,
+            jnp.asarray(ids),
+            num_latents=num_latents,
+            pad_mask=jnp.asarray(pad_mask),
+            config=GenerationConfig(
+                max_new_tokens=max_new_tokens,
+                do_sample=do_sample,
+                temperature=temperature,
+                top_k=top_k,
+                top_p=top_p,
+            ),
+            rng=jax.random.PRNGKey(seed),
+        )
+        texts = self.tokenizer.batch_decode(np.asarray(out).tolist())
+        return texts[0] if single else texts
+
+
+def _fit_prompt_window(config, ids: np.ndarray, pad_mask: Optional[np.ndarray], num_latents: int):
+    """Fit a prompt into the model window the way the reference's generation
+    integration does (reference: core/huggingface.py:110-130): truncate to the
+    last ``max_seq_len`` tokens and raise ``num_latents`` to the minimum that
+    keeps the prefix within ``max_prefix_len``."""
+    if ids.shape[1] > config.max_seq_len:
+        ids = ids[:, -config.max_seq_len :]
+        if pad_mask is not None:
+            pad_mask = pad_mask[:, -config.max_seq_len :]
+    max_prefix_len = config.max_seq_len - config.max_latents
+    min_latents = ids.shape[1] - max_prefix_len
+    num_latents = max(num_latents, min_latents)
+    num_latents = min(num_latents, config.max_latents, ids.shape[1])
+    return ids, pad_mask, num_latents
+
+
+class TextClassificationPipeline:
+    """Sentiment analysis / sequence classification
+    (reference: text/classifier/huggingface.py sentiment-analysis)."""
+
+    def __init__(self, model, params, tokenizer=None, id2label: Optional[Dict[int, Any]] = None):
+        from perceiver_io_tpu.data.text.tokenizer import ByteTokenizer
+
+        self.model = model
+        self.params = params
+        self.tokenizer = tokenizer or ByteTokenizer()
+        self.id2label = id2label
+
+    def __call__(self, text: Union[str, Sequence[str]], top_k: int = 1):
+        single = isinstance(text, str)
+        texts = [text] if single else list(text)
+        seqs = self.tokenizer.batch_encode(texts)
+        max_len = getattr(self.model.config.encoder, "max_seq_len", None)
+        ids, pad_mask = self.tokenizer.pad_sequences(seqs, max_length=max_len, padding_side="right")
+
+        logits = self.model.apply(self.params, jnp.asarray(ids), pad_mask=jnp.asarray(pad_mask))
+        probs = np.asarray(jax.nn.softmax(logits, axis=-1))
+        order = np.argsort(-probs, axis=-1)[:, :top_k]
+
+        results = []
+        for row in range(probs.shape[0]):
+            entries = [
+                {
+                    "label": self.id2label[int(i)] if self.id2label else int(i),
+                    "score": float(probs[row, i]),
+                }
+                for i in order[row]
+            ]
+            results.append(entries[0] if top_k == 1 else entries)
+        return results[0] if single else results
+
+
+class ImageClassificationPipeline:
+    """Image classification over channels-last images
+    (reference: vision/image_classifier/huggingface.py:37-118 input processor
+    with channels-last + normalization options)."""
+
+    def __init__(
+        self,
+        model,
+        params,
+        id2label: Optional[Dict[int, Any]] = None,
+        image_mean: float = 0.5,
+        image_std: float = 0.5,
+    ):
+        self.model = model
+        self.params = params
+        self.id2label = id2label
+        self.image_mean = image_mean
+        self.image_std = image_std
+
+    def preprocess(self, images) -> np.ndarray:
+        x = np.asarray(images)
+        if x.ndim == 3:
+            x = x[None]
+        if x.ndim == 4 and x.shape[-1] not in (1, 3) and x.shape[1] in (1, 3):
+            x = x.transpose(0, 2, 3, 1)  # channels-first input -> channels-last
+        if x.dtype == np.uint8:
+            x = x.astype(np.float32) / 255.0
+        expected = tuple(self.model.config.encoder.image_shape)
+        if x.shape[-1] != expected[-1] and expected[-1] == 1:
+            x = x.mean(axis=-1, keepdims=True)  # grayscale option
+        x = (x.astype(np.float32) - self.image_mean) / self.image_std
+        return x
+
+    def __call__(self, images, top_k: int = 1):
+        single = np.asarray(images).ndim == 3
+        x = self.preprocess(images)
+        logits = self.model.apply(self.params, jnp.asarray(x))
+        probs = np.asarray(jax.nn.softmax(logits, axis=-1))
+        order = np.argsort(-probs, axis=-1)[:, :top_k]
+        results = []
+        for row in range(probs.shape[0]):
+            entries = [
+                {
+                    "label": self.id2label[int(i)] if self.id2label else int(i),
+                    "score": float(probs[row, i]),
+                }
+                for i in order[row]
+            ]
+            results.append(entries[0] if top_k == 1 else entries)
+        return results[0] if single else results
+
+
+class OpticalFlowPipeline:
+    """Frame pairs -> dense flow: patch-grid preprocess, micro-batched jitted
+    forward, weighted-blend postprocess, optional HSV rendering
+    (reference: vision/optical_flow/huggingface.py:71-115)."""
+
+    def __init__(self, model, params, processor=None, micro_batch_size: int = 1):
+        from perceiver_io_tpu.data.vision.optical_flow import OpticalFlowProcessor
+
+        self.model = model
+        self.params = params
+        self.processor = processor or OpticalFlowProcessor(
+            patch_size=tuple(model.config.encoder.image_shape)
+        )
+        self.micro_batch_size = micro_batch_size
+        self._apply = jax.jit(lambda p, x: model.apply(p, x))
+
+    def _model_fn(self, patches: np.ndarray) -> np.ndarray:
+        n = patches.shape[0]
+        if n < self.micro_batch_size:  # pad to the compiled batch size
+            pad = self.micro_batch_size - n
+            patches = np.concatenate([patches, np.zeros((pad,) + patches.shape[1:], patches.dtype)])
+        return np.asarray(self._apply(self.params, jnp.asarray(patches)))[:n]
+
+    def __call__(self, image_pairs, render: bool = False):
+        """:param image_pairs: one (frame1, frame2) pair or a list of pairs,
+        frames (H, W, 3) uint8.
+        :return: (H, W, 2) flow per pair (or RGB rendering with render=True)."""
+        single = not isinstance(image_pairs[0], (list, tuple))
+        pairs = [image_pairs] if single else list(image_pairs)
+        flows = self.processor.process(self._model_fn, pairs, batch_size=self.micro_batch_size)
+        if render:
+            from perceiver_io_tpu.data.vision.optical_flow import render_optical_flow
+
+            out = [render_optical_flow(f) for f in flows]
+        else:
+            out = list(flows)
+        return out[0] if single else out
+
+
+@dataclass
+class SymbolicAudioOutput:
+    token_ids: np.ndarray
+    notes: List[Any] = field(default_factory=list)
+    midi_path: Optional[str] = None
+    audio_path: Optional[str] = None
+
+
+class SymbolicAudioGenerationPipeline:
+    """MIDI continuation: prompt (token ids or .mid file) -> generate ->
+    decoded notes / MIDI file / optional fluidsynth-rendered audio
+    (reference: audio/symbolic/huggingface.py:63-190)."""
+
+    def __init__(self, model, params):
+        self.model = model
+        self.params = params
+
+    def __call__(
+        self,
+        prompt,
+        max_new_tokens: int = 512,
+        num_latents: int = 1,
+        temperature: float = 1.0,
+        top_k: Optional[int] = 15,
+        top_p: Optional[float] = None,
+        seed: int = 0,
+        output_midi_path: Optional[str] = None,
+        render_audio: bool = False,
+        output_audio_path: Optional[str] = None,
+    ) -> SymbolicAudioOutput:
+        from perceiver_io_tpu.data.audio import midi
+
+        if isinstance(prompt, (str,)) or hasattr(prompt, "__fspath__"):
+            prompt_ids = midi.encode_midi_file(prompt)
+            if prompt_ids is None:
+                raise ValueError(f"Could not encode MIDI prompt {prompt!r}")
+        else:
+            prompt_ids = np.asarray(prompt, dtype=np.int32)
+        prompt_ids = prompt_ids.reshape(1, -1)
+        prompt_ids, _, num_latents = _fit_prompt_window(
+            self.model.config, prompt_ids, None, num_latents
+        )
+
+        out = generate(
+            self.model,
+            self.params,
+            jnp.asarray(prompt_ids),
+            num_latents=num_latents,
+            config=GenerationConfig(
+                max_new_tokens=max_new_tokens,
+                do_sample=True,
+                temperature=temperature,
+                top_k=top_k,
+                top_p=top_p,
+            ),
+            rng=jax.random.PRNGKey(seed),
+        )
+        ids = np.asarray(out[0])
+        ids = ids[ids != midi.PAD_ID]
+        notes = midi.decode_events(ids.tolist())
+
+        midi_path = None
+        if output_midi_path is not None:
+            midi.decode_to_midi_file(ids.tolist(), output_midi_path)
+            midi_path = str(output_midi_path)
+
+        audio_path = None
+        if render_audio:
+            if midi_path is None:
+                raise ValueError("render_audio requires output_midi_path")
+            audio_path = _render_fluidsynth(midi_path, output_audio_path)
+
+        return SymbolicAudioOutput(token_ids=ids, notes=notes, midi_path=midi_path, audio_path=audio_path)
+
+
+def _render_fluidsynth(midi_path: str, audio_path: Optional[str]) -> str:
+    """Render a MIDI file to WAV via the fluidsynth CLI when available
+    (reference: audio/symbolic/huggingface.py fluidsynth subprocess)."""
+    import shutil
+    import subprocess
+
+    if shutil.which("fluidsynth") is None:
+        raise RuntimeError("fluidsynth is not installed — cannot render audio")
+    audio_path = audio_path or midi_path.rsplit(".", 1)[0] + ".wav"
+    subprocess.run(["fluidsynth", "-ni", midi_path, "-F", str(audio_path)], check=True)
+    return str(audio_path)
+
+
+_PIPELINES = {
+    "fill-mask": FillMaskPipeline,
+    "text-generation": TextGenerationPipeline,
+    "sentiment-analysis": TextClassificationPipeline,
+    "text-classification": TextClassificationPipeline,
+    "image-classification": ImageClassificationPipeline,
+    "optical-flow": OpticalFlowPipeline,
+    "symbolic-audio-generation": SymbolicAudioGenerationPipeline,
+}
+
+
+def pipeline(task: str, model_dir: Optional[str] = None, model=None, params=None, **kwargs):
+    """Build a pipeline by task name, either from a ``save_pretrained``
+    directory or from an in-memory (model, params) pair."""
+    if task not in _PIPELINES:
+        raise ValueError(f"Unknown task {task!r}; available: {sorted(_PIPELINES)}")
+    if model_dir is not None:
+        model, params = from_pretrained(model_dir)
+    if model is None or params is None:
+        raise ValueError("Provide either model_dir or both model and params")
+    return _PIPELINES[task](model, params, **kwargs)
