@@ -50,11 +50,9 @@ def auto_model_for_config(config: Any):
             if isinstance(dec, ClassificationDecoderConfig):
                 return TextClassifier(config)
             return MaskedLanguageModel(config)
-        try:
-            from perceiver_io_tpu.models.timeseries import TimeSeriesEncoderConfig, TimeSeriesPerceiver
-        except ImportError:
-            TimeSeriesEncoderConfig = None
-        if TimeSeriesEncoderConfig is not None and isinstance(enc, TimeSeriesEncoderConfig):
+        from perceiver_io_tpu.models.timeseries import TimeSeriesEncoderConfig, TimeSeriesPerceiver
+
+        if isinstance(enc, TimeSeriesEncoderConfig):
             return TimeSeriesPerceiver(config)
 
     raise ValueError(f"No model registered for config type {type(config).__name__}")

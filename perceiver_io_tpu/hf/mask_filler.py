@@ -49,10 +49,17 @@ class MaskFiller:
         for row in range(ids.shape[0]):
             row_ids = ids[row][~pad_mask[row]]  # window-truncated, pad-free
             mask_pos = np.nonzero(row_ids == tok.mask_token_id)[0]
+            if mask_pos.size == 0:
+                raise ValueError(
+                    f"Sample {row} has no {tok.mask_token} within the model's "
+                    f"{ids.shape[1]}-token window"
+                )
             fills = []
             for k in range(num_predictions):
                 filled = row_ids.copy()
                 filled[mask_pos] = top[row, mask_pos, k]
-                fills.append(tok.decode(filled.tolist()))
+                # keep special-token predictions visible (e.g. "[PAD]")
+                # instead of silently deleting the position
+                fills.append(tok.decode(filled.tolist(), skip_special_tokens=False))
             results.append(fills)
         return results

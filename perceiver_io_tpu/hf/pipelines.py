@@ -91,6 +91,20 @@ class TextGenerationPipeline:
         return texts[0] if single else texts
 
 
+def _topk_labels(logits, id2label: Optional[Dict[int, Any]], top_k: int) -> List[Any]:
+    """Per row: top-k {label, score} entries (a single entry when top_k=1)."""
+    probs = np.asarray(jax.nn.softmax(logits, axis=-1))
+    order = np.argsort(-probs, axis=-1)[:, :top_k]
+    results = []
+    for row in range(probs.shape[0]):
+        entries = [
+            {"label": id2label[int(i)] if id2label else int(i), "score": float(probs[row, i])}
+            for i in order[row]
+        ]
+        results.append(entries[0] if top_k == 1 else entries)
+    return results
+
+
 def _fit_prompt_window(config, ids: np.ndarray, pad_mask: Optional[np.ndarray], num_latents: int):
     """Fit a prompt into the model window the way the reference's generation
     integration does (reference: core/huggingface.py:110-130): truncate to the
@@ -127,19 +141,7 @@ class TextClassificationPipeline:
         ids, pad_mask = self.tokenizer.pad_sequences(seqs, max_length=max_len, padding_side="right")
 
         logits = self.model.apply(self.params, jnp.asarray(ids), pad_mask=jnp.asarray(pad_mask))
-        probs = np.asarray(jax.nn.softmax(logits, axis=-1))
-        order = np.argsort(-probs, axis=-1)[:, :top_k]
-
-        results = []
-        for row in range(probs.shape[0]):
-            entries = [
-                {
-                    "label": self.id2label[int(i)] if self.id2label else int(i),
-                    "score": float(probs[row, i]),
-                }
-                for i in order[row]
-            ]
-            results.append(entries[0] if top_k == 1 else entries)
+        results = _topk_labels(logits, self.id2label, top_k)
         return results[0] if single else results
 
 
@@ -180,18 +182,7 @@ class ImageClassificationPipeline:
         single = np.asarray(images).ndim == 3
         x = self.preprocess(images)
         logits = self.model.apply(self.params, jnp.asarray(x))
-        probs = np.asarray(jax.nn.softmax(logits, axis=-1))
-        order = np.argsort(-probs, axis=-1)[:, :top_k]
-        results = []
-        for row in range(probs.shape[0]):
-            entries = [
-                {
-                    "label": self.id2label[int(i)] if self.id2label else int(i),
-                    "score": float(probs[row, i]),
-                }
-                for i in order[row]
-            ]
-            results.append(entries[0] if top_k == 1 else entries)
+        results = _topk_labels(logits, self.id2label, top_k)
         return results[0] if single else results
 
 
@@ -266,6 +257,9 @@ class SymbolicAudioGenerationPipeline:
     ) -> SymbolicAudioOutput:
         from perceiver_io_tpu.data.audio import midi
 
+        if render_audio and output_midi_path is None:
+            raise ValueError("render_audio requires output_midi_path")
+
         if isinstance(prompt, (str,)) or hasattr(prompt, "__fspath__"):
             prompt_ids = midi.encode_midi_file(prompt)
             if prompt_ids is None:
@@ -302,8 +296,6 @@ class SymbolicAudioGenerationPipeline:
 
         audio_path = None
         if render_audio:
-            if midi_path is None:
-                raise ValueError("render_audio requires output_midi_path")
             audio_path = _render_fluidsynth(midi_path, output_audio_path)
 
         return SymbolicAudioOutput(token_ids=ids, notes=notes, midi_path=midi_path, audio_path=audio_path)

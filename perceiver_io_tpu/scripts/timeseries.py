@@ -1,0 +1,134 @@
+"""Multivariate time-series forecasting CLI — the fork-added root app
+(reference: cli.py:1-16 over model.py/datamodule.py).
+
+Links: ``data.usecols → model channels`` (input and output),
+``data.in_len/out_len → model.encoder.in_len / model.decoder.out_len``.
+
+Run: ``python -m perceiver_io_tpu.scripts.timeseries fit
+--data.train_path=series.csv --trainer.max_steps=1000 ...``
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from perceiver_io_tpu.core.config import PerceiverIOConfig
+from perceiver_io_tpu.models.timeseries import (
+    TimeSeriesDecoderConfig,
+    TimeSeriesEncoderConfig,
+    TimeSeriesPerceiver,
+)
+from perceiver_io_tpu.scripts import cli
+from perceiver_io_tpu.training.losses import mse_loss_fn
+
+
+@dataclass
+class TimeSeriesDataArgs:
+    train_path: str = ""
+    val_path: Optional[str] = None
+    test_path: Optional[str] = None
+    in_len: int = 4096
+    out_len: int = 5000
+    stride: int = 1000
+    batch_size: int = 8
+    usecols: List[int] = field(default_factory=lambda: list(range(1, 8)))
+    seed: int = 0
+
+
+def build_timeseries_datamodule(args: TimeSeriesDataArgs):
+    from perceiver_io_tpu.data.timeseries import CSVDataModule
+
+    if not args.train_path:
+        raise ValueError("--data.train_path is required")
+    return CSVDataModule(
+        train_path=args.train_path,
+        val_path=args.val_path or args.train_path,
+        test_path=args.test_path or args.val_path or args.train_path,
+        in_len=args.in_len,
+        out_len=args.out_len,
+        stride=args.stride,
+        batch_size=args.batch_size,
+        usecols=tuple(args.usecols),
+        seed=args.seed,
+    )
+
+
+def main(argv: Optional[Sequence[str]] = None):
+    parser = cli.make_parser(
+        "Multivariate time-series Perceiver",
+        optimizer_defaults={"lr": 1e-4, "warmup_steps": 0},
+    )
+    # reference defaults: 256 latents x 256 channels, 8 single-layer blocks,
+    # single-head attention (reference: model.py:48-78)
+    cli.add_dataclass_args(
+        parser,
+        TimeSeriesEncoderConfig,
+        "model.encoder",
+        {
+            "num_cross_attention_heads": 1,
+            "num_self_attention_heads": 1,
+            "num_self_attention_blocks": 8,
+            "num_self_attention_layers_per_block": 1,
+        },
+    )
+    cli.add_dataclass_args(parser, TimeSeriesDecoderConfig, "model.decoder", {"num_cross_attention_heads": 1})
+    parser.add_argument("--model.num_latents", dest="model.num_latents", type=int, default=256)
+    parser.add_argument(
+        "--model.num_latent_channels", dest="model.num_latent_channels", type=int, default=256
+    )
+    parser.add_argument(
+        "--model.activation_checkpointing",
+        dest="model.activation_checkpointing",
+        type=cli._str2bool,
+        default=False,
+    )
+    cli.add_dataclass_args(parser, TimeSeriesDataArgs, "data")
+    args = cli.parse_args(parser, argv)
+
+    trainer_args = cli.build_dataclass(cli.TrainerArgs, args, "trainer")
+    opt_args = cli.build_dataclass(cli.OptimizerArgs, args, "optimizer")
+    data_args = cli.build_dataclass(TimeSeriesDataArgs, args, "data")
+
+    data = build_timeseries_datamodule(data_args)
+    encoder = cli.build_dataclass(
+        TimeSeriesEncoderConfig,
+        args,
+        "model.encoder",
+        num_input_channels=data.num_channels,
+        in_len=data_args.in_len,
+    )
+    decoder = cli.build_dataclass(
+        TimeSeriesDecoderConfig,
+        args,
+        "model.decoder",
+        out_len=data_args.out_len,
+        num_output_channels=data.num_channels,
+    )
+    model_config = PerceiverIOConfig(
+        encoder=encoder,
+        decoder=decoder,
+        num_latents=getattr(args, "model.num_latents"),
+        num_latent_channels=getattr(args, "model.num_latent_channels"),
+        activation_checkpointing=getattr(args, "model.activation_checkpointing"),
+    )
+    model = TimeSeriesPerceiver(model_config, dtype=cli.activation_dtype(trainer_args))
+
+    init_batch = {"x": np.zeros((1, encoder.in_len, encoder.num_input_channels), np.float32)}
+    return cli.run_training(
+        model,
+        model_config,
+        lambda apply_fn: mse_loss_fn(apply_fn),
+        init_batch,
+        cli.cycle(data.train_batches()),
+        data.valid_batches(),
+        trainer_args,
+        opt_args,
+        command=args.command,
+    )
+
+
+if __name__ == "__main__":
+    main()

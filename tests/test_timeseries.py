@@ -1,0 +1,139 @@
+"""Time-series app tests (reference: root-level model.py/datamodule.py/cli.py,
+SURVEY §2.9) — model shapes, sliding-window data module, CLI fit, and the
+auto-model registry round trip."""
+
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from perceiver_io_tpu.core.config import PerceiverIOConfig
+from perceiver_io_tpu.models.timeseries import (
+    TimeSeriesDecoderConfig,
+    TimeSeriesEncoderConfig,
+    TimeSeriesPerceiver,
+)
+
+
+def tiny_config(in_len=32, out_len=16, channels=3):
+    enc = TimeSeriesEncoderConfig(
+        num_input_channels=channels,
+        in_len=in_len,
+        num_frequency_bands=4,
+        num_cross_attention_heads=1,
+        num_self_attention_heads=1,
+        num_self_attention_blocks=2,
+        num_self_attention_layers_per_block=1,
+    )
+    dec = TimeSeriesDecoderConfig(
+        out_len=out_len, num_output_channels=channels, num_cross_attention_heads=1
+    )
+    return PerceiverIOConfig(encoder=enc, decoder=dec, num_latents=8, num_latent_channels=16)
+
+
+class TestModel:
+    def test_forward_shape(self):
+        config = tiny_config()
+        model = TimeSeriesPerceiver(config)
+        x = jnp.zeros((2, 32, 3))
+        params = model.init(jax.random.PRNGKey(0), x)
+        out = model.apply(params, x)
+        assert out.shape == (2, 16, 3)
+
+    def test_input_shape_validated(self):
+        config = tiny_config()
+        model = TimeSeriesPerceiver(config)
+        with pytest.raises(ValueError, match="incompatible"):
+            model.init(jax.random.PRNGKey(0), jnp.zeros((1, 20, 3)))
+
+    def test_auto_registry_roundtrip(self, tmp_path):
+        from perceiver_io_tpu.hf import from_pretrained
+        from perceiver_io_tpu.training.checkpoint import save_pretrained
+
+        config = tiny_config()
+        model = TimeSeriesPerceiver(config)
+        x = jnp.ones((1, 32, 3))
+        params = model.init(jax.random.PRNGKey(0), x)
+        save_pretrained(str(tmp_path), params, config=config)
+
+        loaded_model, loaded_params = from_pretrained(str(tmp_path))
+        assert isinstance(loaded_model, TimeSeriesPerceiver)
+        np.testing.assert_allclose(
+            np.asarray(loaded_model.apply(loaded_params, x)),
+            np.asarray(model.apply(params, x)),
+            atol=1e-6,
+        )
+
+
+def write_csv(path: Path, rows: int = 200, channels: int = 3, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    data = rng.normal(size=(rows, channels)).astype(np.float32)
+    header = "date," + ",".join(f"c{i}" for i in range(channels))
+    lines = [header] + [f"{i}," + ",".join(f"{v:.6f}" for v in row) for i, row in enumerate(data)]
+    path.write_text("\n".join(lines))
+    return data
+
+
+class TestDataModule:
+    def test_sliding_windows(self, tmp_path):
+        from perceiver_io_tpu.data.timeseries import CSVDataModule
+
+        data = write_csv(tmp_path / "train.csv", rows=100, channels=3)
+        dm = CSVDataModule(
+            train_path=tmp_path / "train.csv",
+            in_len=32,
+            out_len=16,
+            stride=10,
+            batch_size=2,
+            usecols=(1, 2, 3),
+        )
+        ds = dm.dataset("train")
+        # windows at starts 0,10,...,50 -> (100 - 48) // 10 + 1
+        assert len(ds) == (100 - 48) // 10 + 1
+        ex = ds[1]
+        np.testing.assert_allclose(ex["x"], data[10:42], atol=1e-6)
+        np.testing.assert_allclose(ex["y"], data[42:58], atol=1e-6)
+
+        batch = next(iter(dm.train_batches()))
+        assert batch["x"].shape == (2, 32, 3)
+        assert batch["y"].shape == (2, 16, 3)
+
+    def test_too_short_series_rejected(self, tmp_path):
+        from perceiver_io_tpu.data.timeseries import CSVDataModule
+
+        write_csv(tmp_path / "train.csv", rows=30, channels=3)
+        dm = CSVDataModule(
+            train_path=tmp_path / "train.csv", in_len=32, out_len=16, usecols=(1, 2, 3)
+        )
+        with pytest.raises(ValueError, match="too short"):
+            dm.dataset("train")
+
+
+class TestCLI:
+    def test_fit(self, tmp_path):
+        from perceiver_io_tpu.scripts.timeseries import main
+
+        write_csv(tmp_path / "train.csv", rows=120, channels=3)
+        state, _ = main(
+            [
+                "fit",
+                f"--data.train_path={tmp_path / 'train.csv'}",
+                "--data.in_len=32",
+                "--data.out_len=16",
+                "--data.stride=10",
+                "--data.batch_size=2",
+                "--data.usecols=1,2,3",
+                "--model.encoder.num_frequency_bands=4",
+                "--model.num_latents=8",
+                "--model.num_latent_channels=16",
+                "--trainer.devices=1",
+                "--trainer.max_steps=2",
+                "--trainer.log_interval=1",
+                f"--trainer.default_root_dir={tmp_path}",
+                "--trainer.checkpoint=false",
+                "--optimizer.warmup_steps=1",
+            ]
+        )
+        assert int(state.step) == 2
