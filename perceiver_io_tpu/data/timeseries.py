@@ -23,6 +23,11 @@ def read_csv_columns(
     )
     if data.ndim == 1:
         data = data[:, None]
+    if np.isnan(data).any():
+        bad = int(np.isnan(data).any(axis=1).sum())
+        raise ValueError(
+            f"{csv_path}: {bad} rows contain missing/non-numeric values in columns {list(usecols)}"
+        )
     return data
 
 
@@ -113,10 +118,18 @@ class CSVDataModule:
 
     def valid_batches(self) -> Batches:
         return Batches(
-            self.dataset("val"), batch_size=self.batch_size, shuffle=False, collate=_collate
+            self.dataset("val"),
+            batch_size=self.batch_size,
+            shuffle=False,
+            collate=_collate,
+            drop_last=False,
         )
 
     def test_batches(self) -> Batches:
         return Batches(
-            self.dataset("test"), batch_size=self.batch_size, shuffle=False, collate=_collate
+            self.dataset("test"),
+            batch_size=self.batch_size,
+            shuffle=False,
+            collate=_collate,
+            drop_last=False,
         )

@@ -50,10 +50,12 @@ class MaskFiller:
             row_ids = ids[row][~pad_mask[row]]  # window-truncated, pad-free
             mask_pos = np.nonzero(row_ids == tok.mask_token_id)[0]
             if mask_pos.size == 0:
-                raise ValueError(
-                    f"Sample {row} has no {tok.mask_token} within the model's "
-                    f"{ids.shape[1]}-token window"
+                detail = (
+                    f"it was truncated out of the model's {max_len}-token window"
+                    if max_len is not None and len(seqs[row]) > max_len
+                    else "the input contains none"
                 )
+                raise ValueError(f"Sample {row} has no {tok.mask_token} to fill: {detail}")
             fills = []
             for k in range(num_predictions):
                 filled = row_ids.copy()

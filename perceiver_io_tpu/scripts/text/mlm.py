@@ -59,8 +59,11 @@ def make_mask_fill_callback(model, tokenizer, masked_samples: Sequence[str]):
         from perceiver_io_tpu.hf.mask_filler import MaskFiller
 
         filler = MaskFiller(model, state.params, tokenizer)
-        predictions = filler.fill(list(masked_samples), num_predictions=3)
-        text = "\n".join(", ".join(p) for p in predictions)
+        try:
+            predictions = filler.fill(list(masked_samples), num_predictions=3)
+            text = "\n".join(", ".join(p) for p in predictions)
+        except ValueError as e:  # bad sample must not abort training
+            text = f"mask filling failed: {e}"
         if trainer.logger is not None:
             trainer.logger.log_text(step, "masked_samples", text)
 

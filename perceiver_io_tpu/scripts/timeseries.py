@@ -43,6 +43,11 @@ def build_timeseries_datamodule(args: TimeSeriesDataArgs):
 
     if not args.train_path:
         raise ValueError("--data.train_path is required")
+    if args.val_path is None:
+        print(
+            "WARNING: --data.val_path not set; validating on the training CSV "
+            "(val_loss will track training data)"
+        )
     return CSVDataModule(
         train_path=args.train_path,
         val_path=args.val_path or args.train_path,

@@ -1,0 +1,52 @@
+"""Chinchilla-style power-law fitting for the scaling study
+(reference: examples/scaling/clm/scaling/laws.py:7-36): given measured
+(FLOPs, optimal params, optimal tokens) triples and fixed exponents a/b,
+fit the coefficients of N_opt = k_n * C^a and D_opt = k_d * C^b.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Sequence
+
+import numpy as np
+
+
+@dataclass
+class ScalingLaw:
+    a: float
+    b: float
+    k_n: float
+    k_d: float
+
+    def n_opt(self, flops):
+        return self.k_n * flops**self.a
+
+    def d_opt(self, flops):
+        return self.k_d * flops**self.b
+
+    def __str__(self):
+        return f"N_opt = {self.k_n:.4f} * C ** {self.a:.2f}\nD_opt = {self.k_d:.4f} * C ** {self.b:.2f}"
+
+
+def fit_power_law(xs: Sequence[float], ys: Sequence[float], m: float) -> float:
+    """Least-squares coefficient k of y = k * x^m with fixed exponent m —
+    linear in k, so the closed form replaces the reference's curve_fit."""
+    xs_m = np.asarray(xs, np.float64) ** m
+    ys = np.asarray(ys, np.float64)
+    denom = float(np.dot(xs_m, xs_m))
+    if denom == 0.0:
+        raise ValueError("Cannot fit a power law to all-zero inputs")
+    return float(np.dot(xs_m, ys) / denom)
+
+
+def fit_scaling_law(
+    flops_arr: Sequence[float],
+    params_arr: Sequence[float],
+    tokens_arr: Sequence[float],
+    a: float,
+    b: float,
+) -> ScalingLaw:
+    k_n = fit_power_law(flops_arr, params_arr, m=a)
+    k_d = fit_power_law(flops_arr, tokens_arr, m=b)
+    return ScalingLaw(a=a, b=b, k_n=k_n, k_d=k_d)

@@ -1,0 +1,59 @@
+"""Profiling utilities — the observability upgrade over the reference, which
+has no profiler integration at all (SURVEY §5.1): a ``jax.profiler`` trace
+context for xprof/TensorBoard and a step timer for throughput accounting.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import List, Optional
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, create_perfetto_link: bool = False):
+    """Capture a JAX profiler trace (XLA + host) under ``log_dir``; view with
+    TensorBoard's profile plugin or xprof."""
+    import jax
+
+    jax.profiler.start_trace(log_dir, create_perfetto_link=create_perfetto_link)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StepTimer:
+    """Wall-clock step timing with warmup discard and percentile summary.
+
+    Note: through the axon TPU tunnel ``block_until_ready`` is a no-op — the
+    caller must force a host fetch (e.g. ``float(loss)``) before ``tick()``
+    for the timing to mean anything.
+    """
+
+    def __init__(self, warmup: int = 1):
+        self.warmup = warmup
+        self._times: List[float] = []
+        self._last: Optional[float] = None
+
+    def start(self) -> None:
+        self._last = time.perf_counter()
+
+    def tick(self) -> None:
+        now = time.perf_counter()
+        if self._last is not None:
+            self._times.append(now - self._last)
+        self._last = now
+
+    @property
+    def steps(self) -> List[float]:
+        return self._times[self.warmup :]
+
+    def mean(self) -> float:
+        steps = self.steps
+        if not steps:
+            raise ValueError("No timed steps (after warmup discard)")
+        return sum(steps) / len(steps)
+
+    def steps_per_sec(self) -> float:
+        return 1.0 / self.mean()

@@ -1,0 +1,115 @@
+"""Scaling-study utilities tests (reference: examples/scaling/clm/scaling/)
+and profiling helpers."""
+
+import numpy as np
+import pytest
+
+from perceiver_io_tpu.utils import (
+    ComputeEstimator,
+    ModelInfo,
+    StepTimer,
+    fit_power_law,
+    fit_scaling_law,
+    num_model_params,
+    num_training_steps,
+    num_training_tokens,
+    training_flops,
+)
+
+
+class TestComputeEstimator:
+    def test_self_attn_hand_computed(self):
+        est = ComputeEstimator(vocab_size=100, max_seq_len=64, num_latents=16)
+        c, layers = 8, 2
+        per_layer = (6 * c**2 + 2 * c * 16 + 2 * c**2) + 16 * c**2
+        forward = 4 * c + per_layer * layers + 2 * c * 100
+        assert est.self_attn(num_channels=c, num_layers=layers) == forward * 3
+
+    def test_cross_attn_dropout_discount(self):
+        est = ComputeEstimator(vocab_size=100, max_seq_len=64, num_latents=16)
+        full = est.cross_attn(num_channels=8, prefix_dropout=0.0)
+        half = est.cross_attn(num_channels=8, prefix_dropout=0.5)
+        none = est.cross_attn(num_channels=8, prefix_dropout=1.0)
+        assert full > half > none > 0  # embedding part survives full dropout
+
+    def test_param_count_matches_real_init(self):
+        """eval_shape-based count equals an actual initialization's count."""
+        import jax
+        import jax.numpy as jnp
+
+        from perceiver_io_tpu.models.text.clm import CausalLanguageModel, CausalLanguageModelConfig
+
+        kwargs = dict(num_channels=32, num_layers=3, num_latents=8, num_prefix=24, vocab_size=262)
+        n = num_model_params(**kwargs)
+
+        config = CausalLanguageModelConfig(
+            vocab_size=262, max_seq_len=32, max_latents=8, num_channels=32,
+            num_self_attention_layers=2,
+        )
+        model = CausalLanguageModel(config)
+        params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32), jnp.int32), prefix_len=24)
+        n_real = sum(int(np.prod(v.shape)) for v in jax.tree_util.tree_leaves(params))
+        assert n == n_real
+
+    def test_flops_approx_close_to_estimate(self):
+        """C ~= 6N (Chinchilla) should agree with the per-part accounting
+        within a factor ~2 for a realistic config (reference premise)."""
+        est = ComputeEstimator(vocab_size=262, max_seq_len=4096, num_latents=512)
+        info = ModelInfo(num_channels=512, num_layers=9, compute_estimator=est)
+        exact = info.self_attn_flops()
+        approx = info.self_attn_flops_approx()
+        assert 0.5 < exact / approx < 2.0
+
+    def test_training_tokens_roundtrip(self):
+        assert num_training_tokens(num_steps=10, num_latents=512, batch_size=4) == 20480
+        assert num_training_steps(num_tokens=20480, num_latents=512, batch_size=4) == 10
+        est = ComputeEstimator(vocab_size=262, max_seq_len=1024, num_latents=256)
+        info = ModelInfo(num_channels=64, num_layers=2, compute_estimator=est)
+        c, d = training_flops(info, num_steps=10, batch_size=4)
+        assert d == 10240 and c == info.self_attn_flops() * d
+
+
+class TestScalingLaws:
+    def test_power_law_recovers_coefficient(self):
+        xs = np.array([1e18, 1e19, 1e20])
+        ys = 0.75 * xs**0.5
+        assert fit_power_law(xs, ys, m=0.5) == pytest.approx(0.75, rel=1e-6)
+
+    def test_scaling_law_fit(self):
+        flops = np.array([1e18, 1e19, 1e20, 1e21])
+        params = 0.3 * flops**0.5
+        tokens = 2.0 * flops**0.5
+        law = fit_scaling_law(flops, params, tokens, a=0.5, b=0.5)
+        assert law.n_opt(1e22) == pytest.approx(0.3 * 1e11, rel=1e-6)
+        assert law.d_opt(1e22) == pytest.approx(2.0 * 1e11, rel=1e-6)
+        assert "N_opt" in str(law)
+
+    def test_zero_inputs_rejected(self):
+        with pytest.raises(ValueError, match="all-zero"):
+            fit_power_law([0.0, 0.0], [1.0, 2.0], m=0.5)
+
+
+class TestProfiling:
+    def test_step_timer(self):
+        timer = StepTimer(warmup=1)
+        timer.start()
+        for _ in range(3):
+            timer.tick()
+        assert len(timer.steps) == 2
+        assert timer.mean() > 0
+        assert timer.steps_per_sec() == pytest.approx(1.0 / timer.mean())
+
+    def test_step_timer_requires_steps(self):
+        with pytest.raises(ValueError, match="No timed steps"):
+            StepTimer().mean()
+
+    def test_trace_writes_profile(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+
+        from perceiver_io_tpu.utils import trace
+
+        with trace(str(tmp_path)):
+            jnp.dot(jnp.ones((8, 8)), jnp.ones((8, 8))).block_until_ready()
+        produced = list(tmp_path.rglob("*"))
+        assert produced, "expected profiler output files"
