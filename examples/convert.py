@@ -1,0 +1,79 @@
+"""Model conversion CLI (reference: examples/convert.py:14-89): converts the
+official DeepMind Hugging Face Perceiver models into this framework's
+``save_pretrained`` artifacts, usable by ``perceiver_io_tpu.hf.pipeline``.
+
+Downloading the source models needs network access to the HF hub; converting
+an already-downloaded model works offline (pass a local path as the repo id).
+
+    python examples/convert.py language-perceiver --save-dir artifacts/mlm
+    python examples/convert.py vision-perceiver-fourier --save-dir artifacts/img
+    python examples/convert.py optical-flow-perceiver --save-dir artifacts/flow
+    python examples/convert.py all --save-dir artifacts
+"""
+
+from __future__ import annotations
+
+import argparse
+from pathlib import Path
+
+
+def convert_language_perceiver(save_dir: str, repo_id: str = "deepmind/language-perceiver"):
+    import transformers
+
+    from perceiver_io_tpu.hf import convert_masked_language_model
+    from perceiver_io_tpu.training.checkpoint import save_pretrained
+
+    src = transformers.PerceiverForMaskedLM.from_pretrained(repo_id)
+    config, variables = convert_masked_language_model(src)
+    save_pretrained(save_dir, variables, config=config)
+    return config
+
+
+def convert_vision_perceiver_fourier(save_dir: str, repo_id: str = "deepmind/vision-perceiver-fourier"):
+    import transformers
+
+    from perceiver_io_tpu.hf import convert_image_classifier
+    from perceiver_io_tpu.training.checkpoint import save_pretrained
+
+    src = transformers.PerceiverForImageClassificationFourier.from_pretrained(repo_id)
+    config, variables = convert_image_classifier(src)
+    save_pretrained(save_dir, variables, config=config)
+    return config
+
+
+def convert_optical_flow_perceiver(save_dir: str, repo_id: str = "deepmind/optical-flow-perceiver"):
+    import transformers
+
+    from perceiver_io_tpu.hf import convert_optical_flow
+    from perceiver_io_tpu.training.checkpoint import save_pretrained
+
+    src = transformers.PerceiverForOpticalFlow.from_pretrained(repo_id)
+    config, variables = convert_optical_flow(src)
+    save_pretrained(save_dir, variables, config=config)
+    return config
+
+
+CONVERTERS = {
+    "language-perceiver": convert_language_perceiver,
+    "vision-perceiver-fourier": convert_vision_perceiver_fourier,
+    "optical-flow-perceiver": convert_optical_flow_perceiver,
+}
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("model", choices=[*CONVERTERS, "all"])
+    parser.add_argument("--save-dir", required=True)
+    parser.add_argument("--repo-id", default=None, help="override source repo id or local path")
+    args = parser.parse_args(argv)
+
+    names = list(CONVERTERS) if args.model == "all" else [args.model]
+    for name in names:
+        save_dir = Path(args.save_dir) / name if args.model == "all" else Path(args.save_dir)
+        kwargs = {"repo_id": args.repo_id} if args.repo_id else {}
+        config = CONVERTERS[name](str(save_dir), **kwargs)
+        print(f"converted {name} -> {save_dir} ({type(config).__name__})")
+
+
+if __name__ == "__main__":
+    main()

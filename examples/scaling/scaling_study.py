@@ -1,0 +1,92 @@
+"""Scaling-law study driver (reference: examples/scaling/clm/train.md +
+scaling/flops.py + scaling/laws.py): enumerate Perceiver AR CLM model sizes,
+print their analytic compute budgets, and — given measured (FLOPs, optimal N,
+optimal D) triples from completed runs — fit the Chinchilla-style power laws.
+
+    python examples/scaling/scaling_study.py estimate --num-latents 1024 --max-seq-len 3072
+    python examples/scaling/scaling_study.py fit results.csv --a 0.5 --b 0.5
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+
+from perceiver_io_tpu.utils import (
+    ComputeEstimator,
+    ModelInfo,
+    fit_scaling_law,
+    num_training_steps,
+    training_flops,
+)
+
+# the reference study's model grid (reference: examples/scaling/clm/train.md)
+MODEL_GRID = [
+    # (num_channels, num_layers incl. hybrid)
+    (512, 7),
+    (512, 9),
+    (512, 11),
+    (640, 9),
+    (640, 11),
+    (768, 11),
+    (768, 13),
+]
+
+
+def cmd_estimate(args):
+    est = ComputeEstimator(
+        vocab_size=args.vocab_size, max_seq_len=args.max_seq_len, num_latents=args.num_latents
+    )
+    print(
+        f"{'channels':>9} {'layers':>6} {'params(M)':>10} {'flops/tok':>12} "
+        f"{'6N approx':>12} {'steps@1e18':>10}"
+    )
+    for channels, layers in MODEL_GRID:
+        info = ModelInfo(channels, layers, est)
+        n = info.num_self_attn_params()
+        f = info.self_attn_flops()
+        steps = num_training_steps(int(1e18 / f), args.num_latents, args.batch_size)
+        print(
+            f"{channels:>9} {layers:>6} {n / 1e6:>10.1f} {f:>12.3e} "
+            f"{info.self_attn_flops_approx():>12.3e} {steps:>10}"
+        )
+
+
+def cmd_fit(args):
+    rows = list(csv.DictReader(open(args.csv)))
+    flops = [float(r["flops"]) for r in rows]
+    params = [float(r["params"]) for r in rows]
+    tokens = [float(r["tokens"]) for r in rows]
+    law = fit_scaling_law(flops, params, tokens, a=args.a, b=args.b)
+    print(law)
+    for c in (1e19, 1e20, 1e21, 1e22):
+        print(f"C={c:.0e}: N_opt={law.n_opt(c)/1e6:.1f}M  D_opt={law.d_opt(c)/1e9:.2f}B")
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    est = sub.add_parser("estimate")
+    est.add_argument("--vocab-size", type=int, default=262)
+    est.add_argument("--max-seq-len", type=int, default=3072)
+    est.add_argument("--num-latents", type=int, default=1024)
+    est.add_argument("--batch-size", type=int, default=16)
+    est.set_defaults(fn=cmd_estimate)
+
+    fit = sub.add_parser("fit")
+    fit.add_argument("csv", help="columns: flops,params,tokens")
+    fit.add_argument("--a", type=float, default=0.5)
+    fit.add_argument("--b", type=float, default=0.5)
+    fit.set_defaults(fn=cmd_fit)
+
+    args = parser.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()
+
+
+# re-exported for completeness with the reference's module layout
+__all__ = ["MODEL_GRID", "cmd_estimate", "cmd_fit", "training_flops"]

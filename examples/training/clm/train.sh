@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Perceiver AR causal LM on WikiText-103-raw, UTF-8 bytes — the reference's
+# "small" 30.7M run (reference: examples/training/clm/train.sh) on a TPU mesh.
+python -m perceiver_io_tpu.scripts.text.clm fit \
+  --data.dataset=wikitext \
+  --data.max_seq_len=4096 \
+  --data.batch_size=16 \
+  --model.max_latents=512 \
+  --model.num_channels=512 \
+  --model.num_self_attention_layers=8 \
+  --model.cross_attention_dropout=0.5 \
+  --optimizer.lr=2e-4 \
+  --optimizer.lr_scheduler=cosine_with_warmup \
+  --optimizer.warmup_steps=200 \
+  --trainer.strategy=dp \
+  --trainer.precision=bf16 \
+  --trainer.gradient_clip_val=0.5 \
+  --trainer.max_steps=16000 \
+  --trainer.name=clm \
+  --task.sample_prompt="A man was reading a book" \
+  "$@"

@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Perceiver AR CLM "base" 455M-class run with ZeRO-style parameter sharding —
+# the reference's 8xA100 FSDP config (reference: examples/training/clm/train_fsdp.sh)
+# expressed as an fsdp mesh axis; bf16; C4-style streaming data.
+python -m perceiver_io_tpu.scripts.text.clm fit \
+  --data.dataset=wikitext \
+  --data.max_seq_len=6144 \
+  --data.random_min_seq_len=4096 \
+  --data.batch_size=8 \
+  --model.max_latents=2048 \
+  --model.num_channels=1024 \
+  --model.num_self_attention_layers=26 \
+  --model.cross_attention_dropout=0.5 \
+  --model.activation_checkpointing=true \
+  --optimizer.lr=2e-4 \
+  --optimizer.lr_scheduler=cosine_with_warmup \
+  --optimizer.warmup_steps=500 \
+  --trainer.strategy=fsdp \
+  --trainer.precision=bf16 \
+  --trainer.gradient_clip_val=1.0 \
+  --trainer.max_steps=50000 \
+  --trainer.name=clm_fsdp \
+  "$@"

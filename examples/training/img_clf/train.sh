@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# MNIST Perceiver IO image classifier, 907K-param class
+# (reference: examples/training/img_clf/train.sh; val_acc target 0.98).
+python -m perceiver_io_tpu.scripts.vision.image_classifier fit \
+  --data.dataset=mnist \
+  --data.batch_size=128 \
+  --data.random_crop=24 \
+  --model.num_latents=32 \
+  --model.num_latent_channels=128 \
+  --model.encoder.num_frequency_bands=32 \
+  --optimizer.lr=1e-3 \
+  --trainer.max_steps=20000 \
+  --trainer.name=img_clf \
+  "$@"

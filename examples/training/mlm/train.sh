@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Byte-level masked LM on IMDb with whole-word masking
+# (reference: examples/training/mlm/train.sh).
+python -m perceiver_io_tpu.scripts.text.mlm fit \
+  --data.dataset=imdb \
+  --data.max_seq_len=2048 \
+  --data.batch_size=32 \
+  --model.num_latents=64 \
+  --model.num_latent_channels=64 \
+  --model.encoder.num_input_channels=64 \
+  --optimizer.lr=1e-3 \
+  --optimizer.lr_scheduler=constant_with_warmup \
+  --optimizer.warmup_steps=1000 \
+  --trainer.precision=bf16 \
+  --trainer.max_steps=50000 \
+  --trainer.name=mlm \
+  --task.masked_samples="I have watched this [MASK] and it was awesome" \
+  "$@"

@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Symbolic audio Perceiver AR on GiantMIDI-Piano
+# (reference: examples/training/sam/train_giantmidi.sh).
+python -m perceiver_io_tpu.scripts.audio.preproc --data.dataset=giantmidi --data.dataset_dir=.cache/giantmidi
+python -m perceiver_io_tpu.scripts.audio.symbolic fit \
+  --data.dataset=giantmidi \
+  --data.dataset_dir=.cache/giantmidi \
+  --data.max_seq_len=6144 \
+  --data.batch_size=16 \
+  --model.max_latents=2048 \
+  --model.num_channels=768 \
+  --model.num_self_attention_layers=12 \
+  --trainer.precision=bf16 \
+  --trainer.max_steps=100000 \
+  --trainer.name=sam_giantmidi \
+  "$@"

@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Multivariate forecasting (the fork-added root app; reference: cli.py).
+python -m perceiver_io_tpu.scripts.timeseries fit \
+  --data.train_path="${TRAIN_CSV:?set TRAIN_CSV}" \
+  --data.val_path="${VAL_CSV:-$TRAIN_CSV}" \
+  --data.in_len=4096 --data.out_len=5000 \
+  --model.num_latents=256 --model.num_latent_channels=256 \
+  --optimizer.lr=1e-4 \
+  --trainer.max_steps=20000 --trainer.name=timeseries \
+  "$@"

@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Two-stage IMDb sentiment classifier: stage 1 trains the decoder on a frozen
+# MLM-warm-started encoder; stage 2 fine-tunes everything
+# (reference: examples/training/txt_clf/train.sh).
+STAGE="${STAGE:-1}"
+if [ "$STAGE" = "1" ]; then
+  python -m perceiver_io_tpu.scripts.text.classifier fit \
+    --data.dataset=imdb --data.max_seq_len=2048 --data.batch_size=64 \
+    --model.encoder.params="${MLM_ARTIFACT:?set MLM_ARTIFACT to an MLM save_pretrained dir}" \
+    --model.encoder.freeze=true \
+    --optimizer.lr=1e-3 --trainer.max_steps=10000 --trainer.name=txt_clf_dec "$@"
+else
+  python -m perceiver_io_tpu.scripts.text.classifier fit \
+    --data.dataset=imdb --data.max_seq_len=2048 --data.batch_size=16 \
+    --model.params="${CLF_ARTIFACT:?set CLF_ARTIFACT to the stage-1 artifact}" \
+    --optimizer.lr=5e-5 --trainer.max_steps=5000 --trainer.name=txt_clf_all "$@"
+fi

@@ -17,10 +17,14 @@ from perceiver_io_tpu.parallel.mesh import batch_sharding, fsdp_param_shardings
 from perceiver_io_tpu.training.state import TrainState
 
 
-def make_train_step(loss_fn: Callable, donate: bool = True) -> Callable:
+def make_train_step(loss_fn: Callable, donate: bool = True, jit: bool = True) -> Callable:
     """``train_step(state, batch) -> (state, metrics)``, jitted.
 
     ``loss_fn(params, batch, rng) -> (loss, metrics)``.
+
+    ``jit=False`` returns the raw step function — for callers embedding the
+    step in a larger jitted computation (e.g. a multi-step ``lax.scan``),
+    where an inner jit boundary would force per-iteration buffer copies.
     """
 
     def train_step(state: TrainState, batch):
@@ -30,6 +34,8 @@ def make_train_step(loss_fn: Callable, donate: bool = True) -> Callable:
         state = state.apply_gradients(grads).replace(rng=rng)
         return state, metrics
 
+    if not jit:
+        return train_step
     return jax.jit(train_step, donate_argnums=(0,) if donate else ())
 
 

@@ -99,6 +99,26 @@ class TestMaskedLanguageModel:
         np.testing.assert_allclose(out[0, :28], ref[0, :28], atol=ATOL, rtol=RTOL)
 
 
+class TestLanguagePerceiverSize:
+    def test_full_size_parameter_parity(self):
+        """deepmind/language-perceiver has 201,108,230 parameters (reference:
+        tests/masked_language_model_convert_test.py:12). The HF architecture
+        with that model's dimensions (PerceiverConfig defaults + qk=256,
+        v=1280) must convert into our tree with the exact same count —
+        no network access needed."""
+        config = PerceiverConfig(qk_channels=256, v_channels=1280)
+        hf_model = PerceiverForMaskedLM(config)
+        n_src = sum(p.numel() for p in hf_model.parameters())
+        assert n_src == 201_108_230
+
+        our_config, variables = convert_masked_language_model(hf_model)
+        n_tgt = sum(int(np.prod(v.shape)) for v in jax.tree_util.tree_leaves(variables))
+        assert n_tgt == 201_108_230
+        assert our_config.num_latents == 256
+        assert our_config.num_latent_channels == 1280
+        assert our_config.encoder.num_self_attention_layers_per_block == 26
+
+
 class TestImageClassifier:
     @pytest.fixture(scope="class")
     def converted(self):
