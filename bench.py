@@ -60,6 +60,57 @@ def train_step_flops(config, batch_size: int, prefix_dropout_keep: float) -> flo
     return 3.0 * fwd * batch_size
 
 
+def decode_bench(args):
+    """KV-cache decode throughput at full 16k context (the reference's decode
+    hot loop, reference: core/huggingface.py:158-185): tokens generated per
+    second with the sliding-window cache already full."""
+    from perceiver_io_tpu.generation import GenerationConfig, make_generate_fn
+    from perceiver_io_tpu.models.text import CausalLanguageModel
+
+    config = flagship_config(args.seq_len, args.latents)
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    model = CausalLanguageModel(config, dtype=dtype)
+
+    b = args.batch_size
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(rng.integers(0, config.vocab_size, size=(b, args.seq_len)))
+    params = model.init(
+        jax.random.PRNGKey(0), prompt[:, : args.latents + 1], prefix_len=1
+    )
+
+    fns = {
+        k: make_generate_fn(
+            model, args.latents, GenerationConfig(max_new_tokens=k, do_sample=True, top_k=10),
+            cache_dtype=dtype,
+        )
+        for k in (8, 8 + args.steps * 4)
+    }
+
+    def run(k):
+        return float(fns[k](params, prompt)[0, -1])
+
+    n_short, n_long = 8, 8 + args.steps * 4
+    run(n_short)
+    run(n_long)
+
+    def timed(k):
+        t0 = time.perf_counter()
+        run(k)
+        return time.perf_counter() - t0
+
+    t_short = min(timed(n_short) for _ in range(5))
+    t_long = min(timed(n_long) for _ in range(5))
+    per_token = max((t_long - t_short) / (n_long - n_short), 1e-9)
+    result = {
+        "metric": f"perceiver-ar-clm decode tokens/sec @{args.seq_len} ctx "
+        f"(full sliding-window KV cache, {args.dtype}, batch {b})",
+        "value": round(b / per_token, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": None,
+    }
+    print(json.dumps(result))
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--seq-len", type=int, default=16384)
@@ -68,7 +119,11 @@ def main():
     p.add_argument("--steps", type=int, default=50)
     p.add_argument("--dtype", default="bfloat16")
     p.add_argument("--remat", action="store_true", help="activation checkpointing (needed for large seq/batch)")
+    p.add_argument("--mode", choices=["train", "decode"], default="train")
     args = p.parse_args()
+
+    if args.mode == "decode":
+        return decode_bench(args)
 
     from perceiver_io_tpu.models.text import CausalLanguageModel
     from perceiver_io_tpu.training import TrainState, clm_loss_fn, make_optimizer

@@ -80,6 +80,36 @@ def _sample(logits: jnp.ndarray, rng: jax.Array, config: GenerationConfig) -> jn
     return jax.random.categorical(rng, logits, axis=-1)
 
 
+def make_generate_fn(
+    model,
+    num_latents: int = 1,
+    config: Optional[GenerationConfig] = None,
+    cache_dtype=jnp.float32,
+):
+    """Jit-compiled ``fn(params, input_ids, pad_mask, rng) -> tokens``.
+
+    Always prefer this over calling :func:`generate` eagerly on TPU: the
+    eager path re-dispatches the prompt pass and decode-loop setup per call
+    (measured ~20x slower per token at 16k context). One compilation serves
+    all prompts of the same shape."""
+    config = config or GenerationConfig()
+
+    @jax.jit
+    def fn(params, input_ids, pad_mask=None, rng=None):
+        return generate(
+            model,
+            params,
+            input_ids,
+            num_latents=num_latents,
+            pad_mask=pad_mask,
+            config=config,
+            rng=rng,
+            cache_dtype=cache_dtype,
+        )
+
+    return fn
+
+
 def generate(
     model,
     params,

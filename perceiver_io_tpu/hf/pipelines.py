@@ -15,6 +15,7 @@ Each pipeline holds (model, params) plus its host-side processor and exposes
 
 from __future__ import annotations
 
+import dataclasses
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Union
 
@@ -22,9 +23,18 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from perceiver_io_tpu.generation import GenerationConfig, generate
+from perceiver_io_tpu.generation import GenerationConfig, make_generate_fn
 from perceiver_io_tpu.hf.auto import from_pretrained
 from perceiver_io_tpu.hf.mask_filler import MaskFiller
+
+
+def _cached_generate_fn(cache: Dict[Any, Any], model, ids_shape, num_latents: int, gen_config: GenerationConfig):
+    """Memoized jitted generation per (prompt shape, settings) — the eager
+    path costs ~20x per token on TPU (see make_generate_fn)."""
+    key = (tuple(ids_shape), num_latents, *dataclasses.astuple(gen_config))
+    if key not in cache:
+        cache[key] = make_generate_fn(model, num_latents, gen_config)
+    return cache[key]
 
 
 class FillMaskPipeline:
@@ -54,6 +64,16 @@ class TextGenerationPipeline:
         self.model = model
         self.params = params
         self.tokenizer = tokenizer or ByteTokenizer()
+        self._gen_cache: Dict[Any, Any] = {}
+
+    def _generate(self, ids, pad_mask, num_latents: int, gen_config: GenerationConfig, seed: int):
+        fn = _cached_generate_fn(self._gen_cache, self.model, ids.shape, num_latents, gen_config)
+        return fn(
+            self.params,
+            jnp.asarray(ids),
+            pad_mask=None if pad_mask is None else jnp.asarray(pad_mask),
+            rng=jax.random.PRNGKey(seed),
+        )
 
     def __call__(
         self,
@@ -72,20 +92,18 @@ class TextGenerationPipeline:
         ids, pad_mask = self.tokenizer.pad_sequences(seqs, padding_side="left")
         ids, pad_mask, num_latents = _fit_prompt_window(self.model.config, ids, pad_mask, num_latents)
 
-        out = generate(
-            self.model,
-            self.params,
-            jnp.asarray(ids),
-            num_latents=num_latents,
-            pad_mask=jnp.asarray(pad_mask),
-            config=GenerationConfig(
+        out = self._generate(
+            ids,
+            pad_mask,
+            num_latents,
+            GenerationConfig(
                 max_new_tokens=max_new_tokens,
                 do_sample=do_sample,
                 temperature=temperature,
                 top_k=top_k,
                 top_p=top_p,
             ),
-            rng=jax.random.PRNGKey(seed),
+            seed,
         )
         texts = self.tokenizer.batch_decode(np.asarray(out).tolist())
         return texts[0] if single else texts
@@ -241,6 +259,7 @@ class SymbolicAudioGenerationPipeline:
     def __init__(self, model, params):
         self.model = model
         self.params = params
+        self._gen_cache: Dict[Any, Any] = {}
 
     def __call__(
         self,
@@ -271,20 +290,15 @@ class SymbolicAudioGenerationPipeline:
             self.model.config, prompt_ids, None, num_latents
         )
 
-        out = generate(
-            self.model,
-            self.params,
-            jnp.asarray(prompt_ids),
-            num_latents=num_latents,
-            config=GenerationConfig(
-                max_new_tokens=max_new_tokens,
-                do_sample=True,
-                temperature=temperature,
-                top_k=top_k,
-                top_p=top_p,
-            ),
-            rng=jax.random.PRNGKey(seed),
+        gen_config = GenerationConfig(
+            max_new_tokens=max_new_tokens,
+            do_sample=True,
+            temperature=temperature,
+            top_k=top_k,
+            top_p=top_p,
         )
+        fn = _cached_generate_fn(self._gen_cache, self.model, prompt_ids.shape, num_latents, gen_config)
+        out = fn(self.params, jnp.asarray(prompt_ids), rng=jax.random.PRNGKey(seed))
         ids = np.asarray(out[0])
         ids = ids[ids != midi.PAD_ID]
         notes = midi.decode_events(ids.tolist())
