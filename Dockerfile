@@ -1,0 +1,15 @@
+# TPU-ready image for perceiver-io-tpu (reference: Dockerfile — pytorch/cuda
+# runtime + poetry; here a JAX TPU runtime + pip install).
+FROM python:3.12-slim
+
+WORKDIR /app
+COPY pyproject.toml README.md ./
+COPY perceiver_io_tpu ./perceiver_io_tpu
+
+# On a TPU VM replace the first line with:
+#   pip install "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
+RUN pip install --no-cache-dir jax \
+    && pip install --no-cache-dir .[text,vision,audio,test]
+
+ENTRYPOINT ["python", "-m"]
+CMD ["perceiver_io_tpu.scripts.text.clm", "--help"]

@@ -28,10 +28,12 @@ from perceiver_io_tpu.hf.auto import from_pretrained
 from perceiver_io_tpu.hf.mask_filler import MaskFiller
 
 
-def _cached_generate_fn(cache: Dict[Any, Any], model, ids_shape, num_latents: int, gen_config: GenerationConfig):
-    """Memoized jitted generation per (prompt shape, settings) — the eager
-    path costs ~20x per token on TPU (see make_generate_fn)."""
-    key = (tuple(ids_shape), num_latents, *dataclasses.astuple(gen_config))
+def _cached_generate_fn(cache: Dict[Any, Any], model, num_latents: int, gen_config: GenerationConfig):
+    """Memoized jitted generation per sampling settings — the eager path
+    costs ~20x per token on TPU (see make_generate_fn). Prompt-shape
+    specialization is jit's own job; keying on it here would only duplicate
+    wrapper objects."""
+    key = (num_latents, *dataclasses.astuple(gen_config))
     if key not in cache:
         cache[key] = make_generate_fn(model, num_latents, gen_config)
     return cache[key]
@@ -67,7 +69,7 @@ class TextGenerationPipeline:
         self._gen_cache: Dict[Any, Any] = {}
 
     def _generate(self, ids, pad_mask, num_latents: int, gen_config: GenerationConfig, seed: int):
-        fn = _cached_generate_fn(self._gen_cache, self.model, ids.shape, num_latents, gen_config)
+        fn = _cached_generate_fn(self._gen_cache, self.model, num_latents, gen_config)
         return fn(
             self.params,
             jnp.asarray(ids),
@@ -175,25 +177,26 @@ class ImageClassificationPipeline:
         id2label: Optional[Dict[int, Any]] = None,
         image_mean: float = 0.5,
         image_std: float = 0.5,
+        preprocessor=None,
     ):
+        from perceiver_io_tpu.data.vision.preprocessor import ImagePreprocessor
+
         self.model = model
         self.params = params
         self.id2label = id2label
-        self.image_mean = image_mean
-        self.image_std = image_std
+        # no resize/crop by default — images must already match the model's
+        # grid; pass e.g. ImageNetPreprocessor() for the 256->224 val transform
+        self.preprocessor = preprocessor or ImagePreprocessor(
+            size=None, crop_size=None, image_mean=image_mean, image_std=image_std
+        )
 
     def preprocess(self, images) -> np.ndarray:
         x = np.asarray(images)
-        if x.ndim == 3:
-            x = x[None]
-        if x.ndim == 4 and x.shape[-1] not in (1, 3) and x.shape[1] in (1, 3):
-            x = x.transpose(0, 2, 3, 1)  # channels-first input -> channels-last
-        if x.dtype == np.uint8:
-            x = x.astype(np.float32) / 255.0
+        batch = [x[i] for i in range(x.shape[0])] if x.ndim == 4 else [x]
+        x = self.preprocessor.preprocess_batch(batch)
         expected = tuple(self.model.config.encoder.image_shape)
         if x.shape[-1] != expected[-1] and expected[-1] == 1:
             x = x.mean(axis=-1, keepdims=True)  # grayscale option
-        x = (x.astype(np.float32) - self.image_mean) / self.image_std
         return x
 
     def __call__(self, images, top_k: int = 1):
@@ -297,7 +300,7 @@ class SymbolicAudioGenerationPipeline:
             top_k=top_k,
             top_p=top_p,
         )
-        fn = _cached_generate_fn(self._gen_cache, self.model, prompt_ids.shape, num_latents, gen_config)
+        fn = _cached_generate_fn(self._gen_cache, self.model, num_latents, gen_config)
         out = fn(self.params, jnp.asarray(prompt_ids), rng=jax.random.PRNGKey(seed))
         ids = np.asarray(out[0])
         ids = ids[ids != midi.PAD_ID]
