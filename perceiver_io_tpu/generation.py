@@ -80,6 +80,149 @@ def _sample(logits: jnp.ndarray, rng: jax.Array, config: GenerationConfig) -> jn
     return jax.random.categorical(rng, logits, axis=-1)
 
 
+def _validate_window(mcfg, seq_len: int, num_latents: int) -> int:
+    """Shared window validation (reference error contract,
+    reference: core/huggingface.py:187-230). Returns the prefix length."""
+    if not 0 < seq_len <= mcfg.max_seq_len:
+        raise ValueError(f"Input sequence length out of valid range [1..{mcfg.max_seq_len}]")
+    if not 0 < num_latents <= mcfg.max_latents:
+        raise ValueError(f"num_latents={num_latents} out of valid range [1..{mcfg.max_latents}]")
+    num_latents = min(seq_len, num_latents)
+    prefix_len = seq_len - num_latents
+    max_prefix_len = mcfg.max_seq_len - mcfg.max_latents
+    if prefix_len > max_prefix_len:
+        num_latents_min = num_latents + prefix_len - max_prefix_len
+        raise ValueError(
+            f"For given sequence of length={seq_len}, num_latents must "
+            f"be in range [{num_latents_min}..{mcfg.max_latents}]"
+        )
+    return prefix_len
+
+
+def beam_search(
+    model,
+    params,
+    input_ids: jnp.ndarray,
+    num_latents: int = 1,
+    num_beams: int = 4,
+    max_new_tokens: int = 64,
+    length_penalty: float = 1.0,
+    eos_token_id: Optional[int] = None,
+    pad_token_id: int = 0,
+    cache_dtype=jnp.float32,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Beam-search decoding over the fixed-capacity KV caches.
+
+    The reference delegates beam search to HF ``GenerationMixin`` and only
+    supplies cache reordering (reference: core/huggingface.py:140-144
+    ``_reorder_cache``). Here the whole search is one compiled ``lax.scan``:
+    beams live as extra batch rows (B*num_beams), and the reorder is a
+    ``take`` over the cache batch axis each step — static shapes throughout.
+
+    Sequence length must satisfy ``seq_len + max_new_tokens <= max_seq_len``
+    (no sliding window during search; beams must share absolute positions).
+
+    :return: ``(sequences (B, S + max_new_tokens), scores (B,))`` — the best
+        beam per batch element and its length-penalized log-probability.
+    """
+    mcfg = model.config
+    b, seq_len = input_ids.shape
+    if num_beams < 1:
+        raise ValueError("num_beams must be >= 1")
+    if seq_len + max_new_tokens > mcfg.max_seq_len:
+        raise ValueError(
+            f"seq_len + max_new_tokens ({seq_len + max_new_tokens}) exceeds "
+            f"max_seq_len ({mcfg.max_seq_len}) — beam search does not slide the window"
+        )
+    prefix_len = _validate_window(mcfg, seq_len, num_latents)
+
+    from perceiver_io_tpu.core.modules import CausalSequenceModel
+
+    bb = b * num_beams
+    # prompt pass on B rows, then tile caches/logits to B*num_beams rows
+    small_cache = CausalSequenceModel.init_cache(mcfg, b, dtype=cache_dtype)
+    out = model.apply(params, input_ids, prefix_len=prefix_len, kv_cache=small_cache)
+
+    def tile(x):
+        return jnp.repeat(x, num_beams, axis=0)
+
+    cache = tuple(
+        KVCache(k=tile(c.k), v=tile(c.v), length=c.length) for c in out.kv_cache
+    )
+    logprobs0 = jax.nn.log_softmax(out.logits[:, -1].astype(jnp.float32))  # (B, V)
+    vocab = logprobs0.shape[-1]
+
+    # first step: top beams per batch element
+    top0, tok0 = lax.top_k(logprobs0, num_beams)  # (B, beams)
+    beam_scores = top0.reshape(bb)
+    token = tok0.reshape(bb)
+    seqs = jnp.zeros((bb, max_new_tokens), jnp.int32).at[:, 0].set(token)
+    done = jnp.zeros((bb,), bool)
+    if eos_token_id is not None:
+        done = token == eos_token_id
+
+    batch_base = jnp.repeat(jnp.arange(b) * num_beams, num_beams)  # (bb,)
+
+    def step(carry, t):
+        cache, seqs, beam_scores, token, done = carry
+        # slide the self-attention windows when full, exactly as generate()
+        # does (the CA cache cannot fill — validated above); positions keep
+        # counting from the CA length, so beams stay aligned
+        cache = (cache[0],) + tuple(_shift_left_if_full(c) for c in cache[1:])
+        out = model.apply(params, token[:, None], prefix_len=0, kv_cache=cache, decode=True)
+        logprobs = jax.nn.log_softmax(out.logits[:, -1].astype(jnp.float32))  # (bb, V)
+
+        if eos_token_id is not None:
+            # finished beams: only PAD continues, at no cost
+            frozen = jnp.full((vocab,), -jnp.inf).at[pad_token_id].set(0.0)
+            logprobs = jnp.where(done[:, None], frozen[None, :], logprobs)
+
+        cand = beam_scores[:, None] + logprobs  # (bb, V)
+        cand = cand.reshape(b, num_beams * vocab)
+        new_scores, flat_idx = lax.top_k(cand, num_beams)  # (B, beams)
+        beam_idx = flat_idx // vocab  # source beam within the batch element
+        new_token = (flat_idx % vocab).reshape(bb)
+
+        gather_rows = (batch_base.reshape(b, num_beams) + beam_idx).reshape(bb)
+        new_cache = tuple(
+            KVCache(
+                k=jnp.take(c.k, gather_rows, axis=0),
+                v=jnp.take(c.v, gather_rows, axis=0),
+                length=c.length,
+            )
+            for c in out.kv_cache
+        )
+        seqs = jnp.take(seqs, gather_rows, axis=0).at[:, t].set(new_token)
+        done = jnp.take(done, gather_rows, axis=0)
+        if eos_token_id is not None:
+            done = done | (new_token == eos_token_id)
+        return (new_cache, seqs, new_scores.reshape(bb), new_token, done), ()
+
+    carry = (cache, seqs, beam_scores, token, done)
+    if max_new_tokens > 1:
+        carry, _ = lax.scan(step, carry, jnp.arange(1, max_new_tokens))
+    _, seqs, beam_scores, _, done = carry
+
+    # length penalty on the final scores (HF convention: score / len**penalty)
+    if eos_token_id is not None:
+        lengths = jnp.where(
+            (seqs == eos_token_id).any(axis=1),
+            (seqs == eos_token_id).argmax(axis=1) + 1,
+            max_new_tokens,
+        )
+    else:
+        lengths = jnp.full((bb,), max_new_tokens)
+    final = beam_scores / (lengths.astype(jnp.float32) ** length_penalty)
+
+    final = final.reshape(b, num_beams)
+    best = jnp.argmax(final, axis=1)  # (B,)
+    best_rows = jnp.arange(b) * num_beams + best
+    best_seqs = jnp.take(seqs, best_rows, axis=0)
+    best_scores = jnp.take(final.reshape(bb), best_rows, axis=0)
+    prompt_tiled = input_ids
+    return jnp.concatenate([prompt_tiled, best_seqs], axis=1), best_scores
+
+
 def make_generate_fn(
     model,
     num_latents: int = 1,
@@ -137,19 +280,7 @@ def generate(
     if config.max_new_tokens <= 0:
         return input_ids
 
-    if not 0 < seq_len <= mcfg.max_seq_len:
-        raise ValueError(f"Input sequence length out of valid range [1..{mcfg.max_seq_len}]")
-    if not 0 < num_latents <= mcfg.max_latents:
-        raise ValueError(f"num_latents={num_latents} out of valid range [1..{mcfg.max_latents}]")
-    num_latents = min(seq_len, num_latents)
-    prefix_len = seq_len - num_latents
-    max_prefix_len = mcfg.max_seq_len - mcfg.max_latents
-    if prefix_len > max_prefix_len:
-        num_latents_min = num_latents + prefix_len - max_prefix_len
-        raise ValueError(
-            f"For given sequence of length={seq_len}, num_latents must "
-            f"be in range [{num_latents_min}..{mcfg.max_latents}]"
-        )
+    prefix_len = _validate_window(mcfg, seq_len, num_latents)
 
     from perceiver_io_tpu.core.modules import CausalSequenceModel
 

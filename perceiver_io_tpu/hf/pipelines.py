@@ -86,6 +86,7 @@ class TextGenerationPipeline:
         temperature: float = 1.0,
         top_k: Optional[int] = 10,
         top_p: Optional[float] = None,
+        num_beams: int = 1,
         seed: int = 0,
     ):
         single = isinstance(prompt, str)
@@ -93,6 +94,33 @@ class TextGenerationPipeline:
         seqs = self.tokenizer.batch_encode(prompts)
         ids, pad_mask = self.tokenizer.pad_sequences(seqs, padding_side="left")
         ids, pad_mask, num_latents = _fit_prompt_window(self.model.config, ids, pad_mask, num_latents)
+
+        if num_beams > 1:
+            if do_sample:
+                raise ValueError("num_beams > 1 requires do_sample=False (beam search is deterministic)")
+            if pad_mask is not None and pad_mask.any():
+                raise ValueError("beam search requires equal-length prompts (no padding)")
+            from perceiver_io_tpu.generation import beam_search
+
+            # beam search never slides the cross-attention window, so the
+            # prompt must leave room for the new tokens
+            limit = self.model.config.max_seq_len - max_new_tokens
+            if limit < 1:
+                raise ValueError("max_new_tokens leaves no room for a prompt within max_seq_len")
+            if ids.shape[1] > limit:
+                ids = ids[:, -limit:]
+                ids, _, num_latents = _fit_prompt_window(self.model.config, ids, None, num_latents)
+
+            out, _ = beam_search(
+                self.model,
+                self.params,
+                jnp.asarray(ids),
+                num_latents=num_latents,
+                num_beams=num_beams,
+                max_new_tokens=max_new_tokens,
+            )
+            texts = self.tokenizer.batch_decode(np.asarray(out).tolist())
+            return texts[0] if single else texts
 
         out = self._generate(
             ids,
@@ -190,9 +218,19 @@ class ImageClassificationPipeline:
             size=None, crop_size=None, image_mean=image_mean, image_std=image_std
         )
 
-    def preprocess(self, images) -> np.ndarray:
+    @staticmethod
+    def _as_image_list(images):
+        """Split the input into per-image arrays; accepts a single image, a
+        stacked batch, or a (possibly ragged) list of images."""
+        if isinstance(images, (list, tuple)):
+            return [np.asarray(im) for im in images], False
         x = np.asarray(images)
-        batch = [x[i] for i in range(x.shape[0])] if x.ndim == 4 else [x]
+        if x.ndim == 4:
+            return [x[i] for i in range(x.shape[0])], False
+        return [x], True
+
+    def preprocess(self, images) -> np.ndarray:
+        batch, _ = self._as_image_list(images)
         x = self.preprocessor.preprocess_batch(batch)
         expected = tuple(self.model.config.encoder.image_shape)
         if x.shape[-1] != expected[-1] and expected[-1] == 1:
@@ -200,7 +238,7 @@ class ImageClassificationPipeline:
         return x
 
     def __call__(self, images, top_k: int = 1):
-        single = np.asarray(images).ndim == 3
+        _, single = self._as_image_list(images)
         x = self.preprocess(images)
         logits = self.model.apply(self.params, jnp.asarray(x))
         results = _topk_labels(logits, self.id2label, top_k)

@@ -150,3 +150,89 @@ def test_eos_stops_generation(model_and_params):
     row = np.asarray(out[0, 10:])
     assert row[0] == eos
     assert (row[1:] == 63).all()
+
+
+# -------------------------------------------------------------- beam search
+
+
+class TestBeamSearch:
+    def test_beam_one_equals_greedy(self, model_and_params):
+        from perceiver_io_tpu.generation import beam_search
+
+        model, params = model_and_params
+        p = prompt(8)
+        greedy = generate(
+            model, params, p, num_latents=4, config=GenerationConfig(max_new_tokens=6)
+        )
+        beam, _ = beam_search(model, params, p, num_latents=4, num_beams=1, max_new_tokens=6)
+        np.testing.assert_array_equal(np.asarray(beam), np.asarray(greedy))
+
+    def _sequence_logprob(self, model, params, p, seq):
+        """Log-prob of the continuation under a full uncached forward."""
+        full = jnp.concatenate([p, seq], axis=1)
+        n = full.shape[1]
+        out = model.apply(params, full, prefix_len=n - MAX_LATENTS)
+        logp = jax.nn.log_softmax(out.logits.astype(jnp.float32), axis=-1)
+        total = 0.0
+        t0 = p.shape[1]
+        for t in range(seq.shape[1]):
+            # logits position predicting full[:, t0 + t] is at latent index
+            # (t0 + t - 1) - (n - MAX_LATENTS)
+            pos = t0 + t - 1 - (n - MAX_LATENTS)
+            total = total + logp[jnp.arange(p.shape[0]), pos, seq[:, t]]
+        return np.asarray(total)
+
+    def test_beam_improves_or_matches_greedy_logprob(self, model_and_params):
+        from perceiver_io_tpu.generation import beam_search
+
+        model, params = model_and_params
+        p = prompt(8)
+        k = 6
+        greedy = generate(
+            model, params, p, num_latents=4, config=GenerationConfig(max_new_tokens=k)
+        )[:, -k:]
+        beam, scores = beam_search(
+            model, params, p, num_latents=4, num_beams=4, max_new_tokens=k
+        )
+        beam = beam[:, -k:]
+        lp_greedy = self._sequence_logprob(model, params, p, greedy)
+        lp_beam = self._sequence_logprob(model, params, p, beam)
+        assert (lp_beam >= lp_greedy - 1e-4).all()
+        # reported score = mean log-prob at length_penalty 1
+        np.testing.assert_allclose(np.asarray(scores), lp_beam / k, atol=2e-2)  # cached-vs-uncached f32 drift
+
+    def test_beam_one_equals_greedy_past_latent_window(self, model_and_params):
+        """Regression: generation deeper than max_latents must slide the
+        self-attention caches exactly like generate() does."""
+        from perceiver_io_tpu.generation import beam_search
+
+        model, params = model_and_params
+        p = prompt(8)
+        k = 14  # 4 latents + 14 tokens > max_latents (8)
+        greedy = generate(
+            model, params, p, num_latents=4, config=GenerationConfig(max_new_tokens=k)
+        )
+        beam, _ = beam_search(model, params, p, num_latents=4, num_beams=1, max_new_tokens=k)
+        np.testing.assert_array_equal(np.asarray(beam), np.asarray(greedy))
+
+    def test_beam_rejects_window_overflow(self, model_and_params):
+        from perceiver_io_tpu.generation import beam_search
+
+        model, params = model_and_params
+        with pytest.raises(ValueError, match="does not slide the window"):
+            beam_search(model, params, prompt(20), num_latents=8, max_new_tokens=8)
+
+    def test_eos_freezes_beams(self, model_and_params):
+        from perceiver_io_tpu.generation import beam_search
+
+        model, params = model_and_params
+        p = prompt(8)
+        seqs, _ = beam_search(
+            model, params, p, num_latents=4, num_beams=3, max_new_tokens=8,
+            eos_token_id=3, pad_token_id=0,
+        )
+        tail = np.asarray(seqs)[:, 8:]
+        for row in tail:
+            hits = np.nonzero(row == 3)[0]
+            if hits.size:  # everything after the first EOS must be PAD
+                assert (row[hits[0] + 1 :] == 0).all()

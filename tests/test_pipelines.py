@@ -55,6 +55,14 @@ class TestTextGeneration:
         assert len(out) == 2
         assert out[1].startswith("longer prompt")
 
+    def test_beam_search_option(self, clm):
+        model, params = clm
+        p = TextGenerationPipeline(model, params)
+        out = p("hello", max_new_tokens=6, do_sample=False, num_beams=3)
+        assert out.startswith("hello")
+        with pytest.raises(ValueError, match="do_sample=False"):
+            p("hello", num_beams=2, do_sample=True)
+
     def test_factory_from_pretrained(self, clm, tmp_path):
         model, params = clm
         from perceiver_io_tpu.training.checkpoint import save_pretrained
@@ -181,6 +189,37 @@ class TestImageClassification:
         assert out[0]["label"].startswith("c")
         batch = p(np.stack([img_chw.transpose(1, 2, 0)] * 2), top_k=1)
         assert len(batch) == 2 and batch[0]["label"] == out[0]["label"]
+
+    def test_ragged_list_with_resizing_preprocessor(self):
+        from perceiver_io_tpu.data.vision.preprocessor import ImagePreprocessor
+        from perceiver_io_tpu.models.vision.image_classifier import (
+            ImageClassifier,
+            ImageEncoderConfig,
+        )
+
+        enc = ImageEncoderConfig(
+            image_shape=(8, 8, 3),
+            num_frequency_bands=4,
+            num_cross_attention_heads=1,
+            num_self_attention_heads=2,
+            num_self_attention_layers_per_block=1,
+        )
+        dec = ClassificationDecoderConfig(
+            num_classes=3, num_output_query_channels=16, num_cross_attention_heads=2
+        )
+        config = PerceiverIOConfig(enc, dec, num_latents=4, num_latent_channels=16)
+        model = ImageClassifier(config)
+        params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 8, 3)))
+
+        pre = ImagePreprocessor(size=8, crop_size=8)
+        p = ImageClassificationPipeline(model, params, preprocessor=pre)
+        rng = np.random.default_rng(2)
+        imgs = [
+            rng.integers(0, 256, size=(12, 16, 3), dtype=np.uint8),
+            rng.integers(0, 256, size=(20, 10, 3), dtype=np.uint8),
+        ]
+        out = p(imgs)
+        assert len(out) == 2
 
 
 class TestOpticalFlow:
