@@ -15,7 +15,10 @@ Layer map (mirrors the reference's four stacked layers, re-drawn for JAX):
   L2  Core      perceiver_io_tpu.core         attention, encoder/decoder, AR
   L1  Data      perceiver_io_tpu.data         host-side iterators feeding JAX
   ops           perceiver_io_tpu.ops          Pallas kernels
-  parallel      perceiver_io_tpu.parallel     mesh / sharding rules
+  parallel      perceiver_io_tpu.parallel     mesh / sharding / ring attention
+  hf            perceiver_io_tpu.hf           conversion, auto-models, pipelines
+  utils         perceiver_io_tpu.utils        FLOPs estimator, scaling laws, profiling
+  generation    perceiver_io_tpu.generation   compiled decode: sampling + beam search
 """
 
 __version__ = "0.1.0"

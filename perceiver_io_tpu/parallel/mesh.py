@@ -64,16 +64,24 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def batch_sharding(mesh: Mesh, ndim: int = 2) -> NamedSharding:
+def batch_sharding(mesh: Mesh, ndim: int = 2, seq_dim: Optional[int] = None) -> NamedSharding:
     """Shard the leading (batch) dim over data and fsdp axes — the standard
-    JAX zero-style layout where fsdp also contributes data parallelism."""
-    return NamedSharding(mesh, P((AXIS_DATA, AXIS_FSDP), *([None] * (ndim - 1))))
+    JAX zero-style layout where fsdp also contributes data parallelism.
+    ``seq_dim`` additionally shards that dim over the ``seq`` axis (sequence/
+    context parallelism; the dim size must divide the seq axis size)."""
+    spec = [None] * ndim
+    spec[0] = (AXIS_DATA, AXIS_FSDP)
+    if seq_dim is not None and 0 < seq_dim < ndim:
+        spec[seq_dim] = AXIS_SEQ
+    return NamedSharding(mesh, P(*spec))
 
 
-def shard_batch(batch, mesh: Mesh):
-    """Device-put a host batch pytree with leading-dim sharding."""
+def shard_batch(batch, mesh: Mesh, seq_dim: Optional[int] = None):
+    """Device-put a host batch pytree with leading-dim (and optionally
+    sequence-dim) sharding."""
     return jax.tree.map(
-        lambda x: jax.device_put(x, batch_sharding(mesh, ndim=np.ndim(x))), batch
+        lambda x: jax.device_put(x, batch_sharding(mesh, ndim=np.ndim(x), seq_dim=seq_dim)),
+        batch,
     )
 
 

@@ -1,0 +1,87 @@
+"""End-to-end sequence parallelism: the full Perceiver AR CLM training step
+(loss + grads + optimizer update) with the *sequence axis of the batch*
+sharded over the ``seq`` mesh axis must equal the unsharded step.
+
+This validates the GSPMD path for long-context training (SURVEY §5.7: shard
+the prefix KV axis across the mesh — beyond reference parity): XLA partitions
+the embedding, the cross-attention KV projections, and the attention
+softmax over the sharded sequence dim, inserting the collectives the ring
+kernels would otherwise hand-roll."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from perceiver_io_tpu.models.text import CausalLanguageModel, CausalLanguageModelConfig
+from perceiver_io_tpu.parallel import make_mesh
+from perceiver_io_tpu.training import TrainState, clm_loss_fn, make_optimizer
+from perceiver_io_tpu.training.loop import make_train_step
+
+
+def build(seq_len=64, latents=16):
+    config = CausalLanguageModelConfig(
+        vocab_size=64,
+        max_seq_len=seq_len,
+        max_latents=latents,
+        num_channels=32,
+        num_heads=4,
+        num_self_attention_layers=2,
+        cross_attention_dropout=0.0,
+    )
+    model = CausalLanguageModel(config)
+    rng = np.random.default_rng(0)
+    t = rng.integers(0, 64, size=(2, seq_len + 1))
+    batch = {
+        "labels": jnp.asarray(t[:, 1:]),
+        "input_ids": jnp.asarray(t[:, :-1]),
+        "pad_mask": jnp.zeros((2, seq_len), bool),
+    }
+    params = model.init(jax.random.PRNGKey(0), batch["input_ids"], prefix_len=seq_len - latents)
+    tx = make_optimizer(1e-3)
+    state = TrainState.create(model.apply, params, tx, jax.random.PRNGKey(1))
+    step = make_train_step(clm_loss_fn(model.apply, max_latents=latents, deterministic=True), jit=False)
+    return model, state, batch, step
+
+
+def test_seq_sharded_train_step_matches_unsharded():
+    mesh = make_mesh(data=1, seq=4, devices=jax.devices()[:4])
+    model, state, batch, step = build()
+
+    ref_state, ref_metrics = jax.jit(step)(state, batch)
+
+    seq_sharding = {
+        "labels": NamedSharding(mesh, P(None, "seq")),
+        "input_ids": NamedSharding(mesh, P(None, "seq")),
+        "pad_mask": NamedSharding(mesh, P(None, "seq")),
+    }
+    sharded_batch = {k: jax.device_put(v, seq_sharding[k]) for k, v in batch.items()}
+    rep = NamedSharding(mesh, P())
+    sharded_state = jax.tree.map(
+        lambda x: jax.device_put(x, rep) if hasattr(x, "shape") else x, state
+    )
+
+    out_state, metrics = jax.jit(step)(sharded_state, sharded_batch)
+
+    np.testing.assert_allclose(
+        float(metrics["loss"]), float(ref_metrics["loss"]), rtol=2e-5
+    )
+    ref_leaves = jax.tree.leaves(ref_state.params)
+    out_leaves = jax.tree.leaves(out_state.params)
+    for a, b in zip(out_leaves, ref_leaves):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
+
+
+def test_seq_plus_data_sharded_step_runs():
+    """Hybrid data x seq mesh: batch over data, sequence over seq."""
+    mesh = make_mesh(data=2, seq=4, devices=jax.devices()[:8])
+    model, state, batch, step = build()
+
+    sharding = NamedSharding(mesh, P("data", "seq"))
+    sharded_batch = {k: jax.device_put(v, sharding) for k, v in batch.items()}
+    rep = NamedSharding(mesh, P())
+    sharded_state = jax.tree.map(
+        lambda x: jax.device_put(x, rep) if hasattr(x, "shape") else x, state
+    )
+    _, metrics = jax.jit(step)(sharded_state, sharded_batch)
+    assert np.isfinite(float(metrics["loss"]))
