@@ -1,6 +1,7 @@
 from perceiver_io_tpu.parallel.mesh import (
     batch_sharding,
     fsdp_param_shardings,
+    param_shardings,
     make_mesh,
     replicated,
     shard_batch,

@@ -85,20 +85,27 @@ def shard_batch(batch, mesh: Mesh, seq_dim: Optional[int] = None):
     )
 
 
-def _fsdp_spec(shape, fsdp_size: int, min_weight_size: int) -> P:
-    """Choose the largest axis divisible by the fsdp size; replicate small
-    parameters (the per-layer wrap-policy analog of the reference's
-    transformer_auto_wrap_policy over attention layers, clm_fsdp.py:29-36)."""
+def _fsdp_dim(shape, fsdp_size: int, min_weight_size: int, exclude=()) -> Optional[int]:
+    """Largest axis divisible by the fsdp size (None for small/replicated
+    parameters) — the per-layer wrap-policy analog of the reference's
+    transformer_auto_wrap_policy over attention layers (clm_fsdp.py:29-36)."""
     if fsdp_size <= 1 or math.prod(shape) < min_weight_size:
-        return P()
+        return None
     # prefer the last axis, then earlier ones, by size
     order = sorted(range(len(shape)), key=lambda i: (shape[i], i), reverse=True)
     for i in order:
-        if shape[i] % fsdp_size == 0:
-            spec = [None] * len(shape)
-            spec[i] = AXIS_FSDP
-            return P(*spec)
-    return P()
+        if i not in exclude and shape[i] % fsdp_size == 0:
+            return i
+    return None
+
+
+def _fsdp_spec(shape, fsdp_size: int, min_weight_size: int) -> P:
+    dim = _fsdp_dim(shape, fsdp_size, min_weight_size)
+    if dim is None:
+        return P()
+    spec = [None] * len(shape)
+    spec[dim] = AXIS_FSDP
+    return P(*spec)
 
 
 def fsdp_param_shardings(params, mesh: Mesh, min_weight_size: int = 2**14):
@@ -110,3 +117,47 @@ def fsdp_param_shardings(params, mesh: Mesh, min_weight_size: int = 2**14):
         return NamedSharding(mesh, _fsdp_spec(np.shape(x), fsdp_size, min_weight_size))
 
     return jax.tree.map(spec_for, params)
+
+
+# Megatron-style tensor parallelism over the attention-head / MLP-hidden dims
+# (beyond reference parity — SURVEY §2.7 P8): column-parallel projections
+# shard their output dim, row-parallel projections their input dim; GSPMD
+# propagates the activation shardings and inserts the all-reduces.
+_TENSOR_COL_PARALLEL = ("q_proj", "k_proj", "v_proj", "dense_1")
+_TENSOR_ROW_PARALLEL = ("o_proj", "dense_2")
+
+
+def _tensor_spec(path_names, shape, tensor_size: int) -> P:
+    if tensor_size <= 1 or not shape:
+        return P()
+    leaf = path_names[-1]
+    col = any(n in _TENSOR_COL_PARALLEL for n in path_names)
+    row = any(n in _TENSOR_ROW_PARALLEL for n in path_names)
+    if leaf == "kernel" and len(shape) == 2:
+        if col and shape[1] % tensor_size == 0:
+            return P(None, AXIS_TENSOR)
+        if row and shape[0] % tensor_size == 0:
+            return P(AXIS_TENSOR, None)
+    if leaf == "bias" and len(shape) == 1 and col and shape[0] % tensor_size == 0:
+        return P(AXIS_TENSOR)
+    return P()
+
+
+def param_shardings(params, mesh: Mesh, min_weight_size: int = 2**14):
+    """Combined tensor-parallel + FSDP parameter shardings: the TP rule picks
+    the head/hidden dim, FSDP shards a remaining dim of large tensors."""
+    tensor_size = mesh.shape[AXIS_TENSOR]
+    fsdp_size = mesh.shape[AXIS_FSDP]
+
+    def spec_for(path, x):
+        shape = np.shape(x)
+        names = [getattr(k, "key", str(k)) for k in path]
+        tp = _tensor_spec(names, shape, tensor_size)
+        taken = {i for i, a in enumerate(tp) if a is not None}
+        spec = list(tp) + [None] * (len(shape) - len(tp))
+        dim = _fsdp_dim(shape, fsdp_size, min_weight_size, exclude=taken)
+        if dim is not None:
+            spec[dim] = AXIS_FSDP
+        return NamedSharding(mesh, P(*spec))
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)

@@ -122,7 +122,7 @@ class TrainerArgs:
     precision: str = "float32"  # float32 | bfloat16 (params stay f32)
     gradient_clip_val: Optional[float] = None
     accumulate_grad_batches: int = 1
-    strategy: str = "dp"  # dp (DDP parity) | fsdp (FSDP/ZeRO parity)
+    strategy: str = "dp"  # dp (DDP parity) | fsdp (ZeRO parity) | tp | fsdp_tp (tensor parallel)
     fsdp_min_weight_size: int = 2**14
     devices: int = -1  # -1 = all visible
     seed: int = 0
@@ -251,7 +251,13 @@ def make_mesh_for(trainer: TrainerArgs):
         return make_mesh(data=len(devices), devices=devices)
     if trainer.strategy == "fsdp":
         return make_mesh(data=1, fsdp=len(devices), devices=devices)
-    raise ValueError(f"unknown strategy: {trainer.strategy} (expected dp|fsdp)")
+    if trainer.strategy == "tp":
+        return make_mesh(data=1, tensor=len(devices), devices=devices)
+    if trainer.strategy == "fsdp_tp":
+        n = len(devices)
+        tensor = 2 if n % 2 == 0 else 1
+        return make_mesh(data=1, fsdp=n // tensor, tensor=tensor, devices=devices)
+    raise ValueError(f"unknown strategy: {trainer.strategy} (expected dp|fsdp|tp|fsdp_tp)")
 
 
 def make_lr_schedule(opt: OptimizerArgs, max_steps: int):

@@ -13,7 +13,7 @@ from typing import Callable, Optional
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from perceiver_io_tpu.parallel.mesh import batch_sharding, fsdp_param_shardings
+from perceiver_io_tpu.parallel.mesh import batch_sharding, param_shardings
 from perceiver_io_tpu.training.state import TrainState
 
 
@@ -48,22 +48,45 @@ def make_eval_step(eval_fn: Callable) -> Callable:
 
 def shard_train_state(state: TrainState, mesh: Mesh, min_weight_size: int = 2**14) -> TrainState:
     """Place a train state on the mesh: parameters (and matching optimizer
-    state) sharded along the fsdp axis, scalars replicated."""
-    param_shardings = fsdp_param_shardings(state.params, mesh, min_weight_size=min_weight_size)
-    params = jax.tree.map(jax.device_put, state.params, param_shardings)
+    state) sharded along the tensor (head/hidden dims) and fsdp axes,
+    scalars replicated."""
+    shardings = param_shardings(state.params, mesh, min_weight_size=min_weight_size)
+    params = jax.tree.map(jax.device_put, state.params, shardings)
 
-    # optimizer state: shard tensors that match a parameter shape, replicate the rest
-    flat_params, _ = jax.tree.flatten(state.params)
-    shapes = {tuple(p.shape): s for p, s in zip(flat_params, jax.tree.leaves(param_shardings))}
+    if mesh.shape["tensor"] > 1 and not any(
+        "tensor" in str(s.spec) for s in jax.tree.leaves(shardings)
+    ):
+        print(
+            "WARNING: tensor axis size "
+            f"{mesh.shape['tensor']} does not divide any projection dim — "
+            "no parameter is tensor-sharded (fully replicated TP)"
+        )
 
-    def place(x):
-        if hasattr(x, "shape") and tuple(x.shape) in shapes:
-            return jax.device_put(x, shapes[tuple(x.shape)])
-        if hasattr(x, "shape"):
-            return jax.device_put(x, NamedSharding(mesh, P()))
-        return x
+    # Optimizer state: optax moments mirror the param tree, so each leaf path
+    # ends with the corresponding parameter's path (e.g. mu/<param path>).
+    # Match by path suffix (+ shape) — shape alone collides when same-shape
+    # kernels carry different TP specs (e.g. q_proj vs o_proj).
+    def _names(path):
+        return tuple(str(getattr(k, "key", k)) for k in path)
 
-    opt_state = jax.tree.map(place, state.opt_state)
+    by_path = {
+        _names(p): s
+        for (p, x), s in zip(
+            jax.tree_util.tree_flatten_with_path(state.params)[0], jax.tree.leaves(shardings)
+        )
+    }
+
+    def place(path, x):
+        if not hasattr(x, "shape"):
+            return x
+        names = _names(path)
+        for i in range(len(names)):
+            s = by_path.get(names[i:])
+            if s is not None:
+                return jax.device_put(x, s)
+        return jax.device_put(x, NamedSharding(mesh, P()))
+
+    opt_state = jax.tree_util.tree_map_with_path(place, state.opt_state)
     rng = jax.device_put(state.rng, NamedSharding(mesh, P()))
     step = jax.device_put(state.step, NamedSharding(mesh, P()))
     return state.replace(params=params, opt_state=opt_state, rng=rng, step=step)

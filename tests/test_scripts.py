@@ -78,6 +78,10 @@ def test_make_mesh_for_strategies():
     assert mesh is not None and mesh.shape["data"] == 8
     mesh = cli.make_mesh_for(cli.TrainerArgs(strategy="fsdp"))
     assert mesh.shape["fsdp"] == 8 and mesh.shape["data"] == 1
+    mesh = cli.make_mesh_for(cli.TrainerArgs(strategy="tp"))
+    assert mesh.shape["tensor"] == 8
+    mesh = cli.make_mesh_for(cli.TrainerArgs(strategy="fsdp_tp"))
+    assert mesh.shape["tensor"] == 2 and mesh.shape["fsdp"] == 4
     with pytest.raises(ValueError, match="unknown strategy"):
         cli.make_mesh_for(cli.TrainerArgs(strategy="nope"))
 
