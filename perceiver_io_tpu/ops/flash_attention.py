@@ -335,12 +335,24 @@ def _flash_fwd(q, k, v, bias, causal, offset, sm_scale, block_q, block_kv, num_h
     return out, (q, k, v, bias, out, lse)
 
 
+# Backward block sizes (None = same as forward). The bwd kernels have a
+# different VMEM/compute profile than the forward (three matmuls + the
+# recompute per tile); values must be power-of-two divisors of the forward
+# blocks so they divide the padded array sizes.
+BWD_BLOCK_Q: Optional[int] = None
+BWD_BLOCK_KV: Optional[int] = None
+
+
 def _flash_bwd(causal, offset, sm_scale, block_q, block_kv, num_heads, residuals, g):
     q, k, v, bias, out, lse = residuals
     bh, nq, d_qk = q.shape
     nkv = k.shape[1]
     d_v = v.shape[2]
     h = num_heads
+    if BWD_BLOCK_Q is not None:
+        block_q = min(block_q, BWD_BLOCK_Q)
+    if BWD_BLOCK_KV is not None:
+        block_kv = min(block_kv, BWD_BLOCK_KV)
 
     # delta_i = sum_c dO_ic * O_ic, broadcast over lanes for tiled loads
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
