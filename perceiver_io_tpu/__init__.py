@@ -24,3 +24,7 @@ Layer map (mirrors the reference's four stacked layers, re-drawn for JAX):
 __version__ = "0.1.0"
 
 from perceiver_io_tpu.core import config as config  # noqa: F401
+
+__all__ = [
+    "config",
+]

@@ -1,1 +1,6 @@
 from perceiver_io_tpu.data.loader import Batches, shard_indices_for_process
+
+__all__ = [
+    "Batches",
+    "shard_indices_for_process",
+]

@@ -1,2 +1,9 @@
 from perceiver_io_tpu.data.audio.midi import Note, decode_events, encode_notes
 from perceiver_io_tpu.data.audio.symbolic import SymbolicAudioDataModule
+
+__all__ = [
+    "Note",
+    "decode_events",
+    "encode_notes",
+    "SymbolicAudioDataModule",
+]

@@ -6,3 +6,12 @@ from perceiver_io_tpu.data.text.collators import (
 )
 from perceiver_io_tpu.data.text.datamodule import TextDataModule
 from perceiver_io_tpu.data.text.tokenizer import ByteTokenizer
+
+__all__ = [
+    "DefaultCollator",
+    "RandomTruncateCollator",
+    "TokenMaskingCollator",
+    "WordMaskingCollator",
+    "TextDataModule",
+    "ByteTokenizer",
+]

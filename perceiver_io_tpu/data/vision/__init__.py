@@ -1,2 +1,8 @@
 from perceiver_io_tpu.data.vision.mnist import MNISTDataModule
 from perceiver_io_tpu.data.vision.optical_flow import OpticalFlowProcessor, render_optical_flow
+
+__all__ = [
+    "MNISTDataModule",
+    "OpticalFlowProcessor",
+    "render_optical_flow",
+]

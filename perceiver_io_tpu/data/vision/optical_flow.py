@@ -10,7 +10,6 @@ channels per pixel are the 3x3 neighborhood of the 3 image channels in
 from __future__ import annotations
 
 import itertools
-import math
 from pathlib import Path
 from typing import List, Sequence, Tuple
 

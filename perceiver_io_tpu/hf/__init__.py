@@ -17,3 +17,22 @@ from perceiver_io_tpu.hf.pipelines import (  # noqa: F401
     TextGenerationPipeline,
     pipeline,
 )
+
+__all__ = [
+    "auto_model_for_config",
+    "from_pretrained",
+    "convert_image_classifier",
+    "convert_image_classifier_config",
+    "convert_masked_language_model",
+    "convert_mlm_config",
+    "convert_optical_flow",
+    "convert_optical_flow_config",
+    "MaskFiller",
+    "FillMaskPipeline",
+    "ImageClassificationPipeline",
+    "OpticalFlowPipeline",
+    "SymbolicAudioGenerationPipeline",
+    "TextClassificationPipeline",
+    "TextGenerationPipeline",
+    "pipeline",
+]

@@ -1,1 +1,6 @@
 from perceiver_io_tpu.models.audio.symbolic import SymbolicAudioModel, SymbolicAudioModelConfig
+
+__all__ = [
+    "SymbolicAudioModel",
+    "SymbolicAudioModelConfig",
+]

@@ -2,3 +2,14 @@ from perceiver_io_tpu.models.text.classifier import TextClassifier, TextClassifi
 from perceiver_io_tpu.models.text.clm import CausalLanguageModel, CausalLanguageModelConfig
 from perceiver_io_tpu.models.text.common import TextEncoderConfig
 from perceiver_io_tpu.models.text.mlm import MaskedLanguageModel, MaskedLanguageModelConfig, TextDecoderConfig
+
+__all__ = [
+    "TextClassifier",
+    "TextClassifierConfig",
+    "CausalLanguageModel",
+    "CausalLanguageModelConfig",
+    "TextEncoderConfig",
+    "MaskedLanguageModel",
+    "MaskedLanguageModelConfig",
+    "TextDecoderConfig",
+]

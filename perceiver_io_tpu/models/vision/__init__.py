@@ -10,3 +10,14 @@ from perceiver_io_tpu.models.vision.optical_flow import (
     OpticalFlowDecoderConfig,
     OpticalFlowEncoderConfig,
 )
+
+__all__ = [
+    "ImageClassifier",
+    "ImageClassifierConfig",
+    "ImageEncoderConfig",
+    "ImageInputAdapter",
+    "OpticalFlow",
+    "OpticalFlowConfig",
+    "OpticalFlowDecoderConfig",
+    "OpticalFlowEncoderConfig",
+]

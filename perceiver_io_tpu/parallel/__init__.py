@@ -12,3 +12,16 @@ from perceiver_io_tpu.parallel.ring_attention import (
     ring_self_attention,
     seq_sharded_cross_attention,
 )
+
+__all__ = [
+    "batch_sharding",
+    "fsdp_param_shardings",
+    "param_shardings",
+    "make_mesh",
+    "replicated",
+    "shard_batch",
+    "make_ring_cross_attention",
+    "make_ring_self_attention",
+    "ring_self_attention",
+    "seq_sharded_cross_attention",
+]

@@ -11,7 +11,6 @@ from perceiver_io_tpu.data.text.datamodule import (
     BookCorpusDataModule,
     BookCorpusOpenDataModule,
     Enwik8DataModule,
-    HFDatasetTextDataModule,
     ImdbDataModule,
     TextDataModule,
     TextFileDataModule,

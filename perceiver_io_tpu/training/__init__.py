@@ -23,3 +23,26 @@ from perceiver_io_tpu.training.checkpoint import (
 )
 from perceiver_io_tpu.training.metrics import MetricsLogger
 from perceiver_io_tpu.training.trainer import Trainer, TrainerConfig
+
+__all__ = [
+    "constant_with_warmup",
+    "cosine_with_warmup",
+    "make_optimizer",
+    "TrainState",
+    "classification_loss_fn",
+    "clm_loss_fn",
+    "masked_lm_loss_fn",
+    "mse_loss_fn",
+    "freeze_mask",
+    "CheckpointManager",
+    "config_from_dict",
+    "config_to_dict",
+    "load_config",
+    "load_params_into",
+    "load_pretrained",
+    "save_config",
+    "save_pretrained",
+    "MetricsLogger",
+    "Trainer",
+    "TrainerConfig",
+]

@@ -8,12 +8,12 @@ from the mesh (data/fsdp axes); XLA GSPMD inserts all collectives.
 
 from __future__ import annotations
 
-from typing import Callable, Optional
+from typing import Callable
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from perceiver_io_tpu.parallel.mesh import batch_sharding, param_shardings
+from perceiver_io_tpu.parallel.mesh import param_shardings
 from perceiver_io_tpu.training.state import TrainState
 
 

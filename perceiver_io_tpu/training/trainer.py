@@ -13,7 +13,7 @@ collectives (the NCCL-free equivalent of DDP/FSDP strategies, SURVEY §2.7).
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Callable, Dict, Iterable, Optional, Sequence
 
 import jax

@@ -8,3 +8,17 @@ from perceiver_io_tpu.utils.flops import (  # noqa: F401
 )
 from perceiver_io_tpu.utils.laws import ScalingLaw, fit_power_law, fit_scaling_law  # noqa: F401
 from perceiver_io_tpu.utils.profiling import StepTimer, trace  # noqa: F401
+
+__all__ = [
+    "ComputeEstimator",
+    "ModelInfo",
+    "num_model_params",
+    "num_training_steps",
+    "num_training_tokens",
+    "training_flops",
+    "ScalingLaw",
+    "fit_power_law",
+    "fit_scaling_law",
+    "StepTimer",
+    "trace",
+]

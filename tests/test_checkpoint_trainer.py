@@ -10,9 +10,8 @@ import os
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
-from perceiver_io_tpu.core.config import ClassificationDecoderConfig, EncoderConfig
+from perceiver_io_tpu.core.config import ClassificationDecoderConfig
 from perceiver_io_tpu.models.text import (
     CausalLanguageModelConfig,
     TextClassifier,

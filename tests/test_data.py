@@ -5,14 +5,14 @@ flow processors)."""
 import numpy as np
 import pytest
 
-from perceiver_io_tpu.data.audio.midi import PAD_ID, VOCAB_SIZE, Note, decode_events, encode_notes
+from perceiver_io_tpu.data.audio.midi import VOCAB_SIZE, Note, decode_events, encode_notes
 from perceiver_io_tpu.data.audio.symbolic import (
     EXAMPLE_SEPARATOR,
     SymbolicAudioCollator,
     SymbolicAudioNumpyDataset,
 )
 from perceiver_io_tpu.data.loader import Batches, shard_indices_for_process
-from perceiver_io_tpu.data.text.collators import RandomTruncateCollator, TokenMaskingCollator, WordMaskingCollator
+from perceiver_io_tpu.data.text.collators import TokenMaskingCollator, WordMaskingCollator
 from perceiver_io_tpu.data.text.datamodule import TextDataModule
 from perceiver_io_tpu.data.text.streaming import StreamingTextDataModule, shard_stream, shuffle_window
 from perceiver_io_tpu.data.text.tokenizer import ByteTokenizer

@@ -3,7 +3,6 @@
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 import pytest
 
 from perceiver_io_tpu.core.config import ClassificationDecoderConfig

@@ -3,7 +3,6 @@ tiny end-to-end `fit` runs per task (reference test strategy category 2/6,
 SURVEY §4)."""
 
 import argparse
-import json
 from pathlib import Path
 
 import numpy as np
