@@ -53,13 +53,26 @@ def main(argv: Optional[Sequence[str]] = None):
         parser,
         ImageEncoderConfig,
         "model.encoder",
-        {"image_shape": (28, 28, 1), "num_frequency_bands": 32, "dropout": 0.0},
+        {
+            "image_shape": (28, 28, 1),
+            "num_frequency_bands": 32,
+            "dropout": 0.0,
+            # paper presets (reference: vision/image_classifier.py:20-21):
+            # 1 cross-attention head — qk width defaults to the Fourier
+            # feature count, which need not divide a multi-head split
+            "num_cross_attention_heads": 1,
+            "num_self_attention_heads": 8,
+        },
     )
     cli.add_dataclass_args(
         parser,
         ClassificationDecoderConfig,
         "model.decoder",
-        {"num_output_query_channels": 128, "num_classes": 10},
+        {
+            "num_output_query_channels": 128,
+            "num_classes": 10,
+            "num_cross_attention_heads": 1,
+        },
     )
     parser.add_argument("--model.num_latents", dest="model.num_latents", type=int, default=32)
     parser.add_argument(
