@@ -687,7 +687,14 @@ class PerceiverAR(nn.Module):
         kv_cache: Optional[Tuple[KVCache, ...]] = None,
         decode: bool = False,
         deterministic: bool = True,
+        sa_pad_mask=None,
+        pos_shift=None,
     ) -> BlockOutput:
+        """``sa_pad_mask``/``pos_shift`` apply to decode steps only:
+        slot masks for the self-attention caches (expired sliding-window
+        slots) and an explicit left-pad position shift (B, 1) — needed when
+        ``pad_mask`` also marks expired slots and can no longer double as the
+        left-pad count (see generation.py's roll-free sliding window)."""
         if decode and kv_cache is None:
             raise ValueError("decode=True requires kv_cache")
         if kv_cache is not None and not deterministic and self.cross_attention_dropout > 0.0:
@@ -696,7 +703,12 @@ class PerceiverAR(nn.Module):
 
         if decode:
             return self._decode_step(
-                x, pad_mask=pad_mask, kv_cache=kv_cache, deterministic=deterministic
+                x,
+                pad_mask=pad_mask,
+                kv_cache=kv_cache,
+                deterministic=deterministic,
+                sa_pad_mask=sa_pad_mask,
+                pos_shift=pos_shift,
             )
         return self._forward(
             x, prefix_len=prefix_len, pad_mask=pad_mask, kv_cache=kv_cache, deterministic=deterministic
@@ -771,13 +783,16 @@ class PerceiverAR(nn.Module):
             new_cache = (ca_out.kv_cache,) + tuple(sa_out.kv_cache)
         return BlockOutput(last_hidden_state=sa_out.last_hidden_state, kv_cache=new_cache)
 
-    def _decode_step(self, x, pad_mask, kv_cache, deterministic):
+    def _decode_step(self, x, pad_mask, kv_cache, deterministic, sa_pad_mask=None, pos_shift=None):
         """One incremental step: the whole input is latent; absolute positions
         continue from the cache fill level (dynamic values, static shapes)."""
         b, n_x = x.shape[0], x.shape[1]
         ca_cache, sa_cache = kv_cache[0], tuple(kv_cache[1:])
 
-        shift = None if pad_mask is None else pad_mask.sum(axis=1, keepdims=True).astype(jnp.int32)
+        if pos_shift is not None:
+            shift = pos_shift
+        else:
+            shift = None if pad_mask is None else pad_mask.sum(axis=1, keepdims=True).astype(jnp.int32)
         n_total = ca_cache.length + n_x  # dynamic
         q_pos = positions(b, n_x, shift=shift, offset=n_total - n_x)
 
@@ -796,7 +811,7 @@ class PerceiverAR(nn.Module):
             x_emb, None, x_prefix, pad_mask, frq_q, rope_k_ca, ca_cache, deterministic
         )
         sa_out = self.self_attention(
-            ca_out.last_hidden_state, None, frq_q, rope_k_sa, sa_cache, deterministic
+            ca_out.last_hidden_state, sa_pad_mask, frq_q, rope_k_sa, sa_cache, deterministic
         )
         new_cache = (ca_out.kv_cache,) + tuple(sa_out.kv_cache)
         return BlockOutput(last_hidden_state=sa_out.last_hidden_state, kv_cache=new_cache)
@@ -881,6 +896,8 @@ class CausalSequenceModel(nn.Module):
         kv_cache: Optional[Tuple[KVCache, ...]] = None,
         decode: bool = False,
         deterministic: bool = True,
+        sa_pad_mask=None,
+        pos_shift=None,
     ) -> CausalModelOutput:
         if prefix_len > self.max_prefix_len:
             raise ValueError(
@@ -893,6 +910,8 @@ class CausalSequenceModel(nn.Module):
             kv_cache=kv_cache,
             decode=decode,
             deterministic=deterministic,
+            sa_pad_mask=sa_pad_mask,
+            pos_shift=pos_shift,
         )
         h = out.last_hidden_state
         if self.config.output_norm:
