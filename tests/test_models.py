@@ -196,3 +196,52 @@ def test_weight_shared_encoder_blocks():
         return sum(p.size for p in jax.tree.leaves(params))
 
     assert build(first_shared=False) > build(first_shared=True)
+
+
+class TestEncoderValidationRules:
+    """Constructor validation parity (reference: PerceiverEncoder.__init__
+    rules, perceiver/model/core/modules.py:497-516)."""
+
+    def _encoder(self, **overrides):
+        import jax
+        import jax.numpy as jnp
+
+        from perceiver_io_tpu.core.adapter import TokenInputAdapter
+        from perceiver_io_tpu.core.modules import PerceiverEncoder
+
+        adapter = TokenInputAdapter(vocab_size=32, max_seq_len=16, num_input_channels=16)
+        kwargs = dict(
+            input_adapter=adapter,
+            num_latents=4,
+            num_latent_channels=16,
+            num_cross_attention_heads=2,
+            num_self_attention_heads=2,
+            num_self_attention_layers_per_block=1,
+        )
+        kwargs.update(overrides)
+        enc = PerceiverEncoder(**kwargs)
+        return enc.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+
+    def test_cross_attention_layers_must_be_positive(self):
+        import pytest
+
+        with pytest.raises(ValueError, match="num_cross_attention_layers must be > 0"):
+            self._encoder(num_cross_attention_layers=0)
+
+    def test_self_attention_blocks_must_be_positive(self):
+        import pytest
+
+        with pytest.raises(ValueError, match="num_self_attention_blocks must be > 0"):
+            self._encoder(num_self_attention_blocks=0)
+
+    def test_cross_layers_bounded_by_blocks(self):
+        import pytest
+
+        with pytest.raises(ValueError, match="must be <= num_self_attention_blocks"):
+            self._encoder(num_cross_attention_layers=3, num_self_attention_blocks=2)
+
+    def test_head_divisibility(self):
+        import pytest
+
+        with pytest.raises(ValueError, match="divisible by num_heads"):
+            self._encoder(num_cross_attention_qk_channels=18, num_cross_attention_heads=4)
