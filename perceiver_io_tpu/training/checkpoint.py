@@ -121,16 +121,69 @@ def save_pretrained(directory: str, params, config=None) -> None:
 def load_pretrained(directory: str, template_params=None):
     """Returns ``(params, config)``; ``config`` is None when absent. When
     ``template_params`` is given the loaded tree is validated/coerced against
-    it (shapes and dtypes), otherwise the raw tree of numpy arrays returns."""
-    with open(os.path.join(directory, PARAMS_FILE), "rb") as f:
-        data = f.read()
+    it (shapes and dtypes), otherwise the raw tree of numpy arrays returns.
+
+    Accepts either a ``save_pretrained`` artifact (params.msgpack) or an
+    orbax *training* checkpoint directory — a run's ``checkpoints/`` root (or
+    the run dir containing it) — so warm starts can point straight at a
+    training run, mirroring the reference's load-from-.ckpt UX
+    (reference: perceiver/model/core/lightning.py:145-147)."""
+    msgpack_path = os.path.join(directory, PARAMS_FILE)
+    if os.path.exists(msgpack_path):
+        with open(msgpack_path, "rb") as f:
+            data = f.read()
+        if template_params is not None:
+            params = serialization.from_bytes(template_params, data)
+        else:
+            params = serialization.msgpack_restore(data)
+        config_path = os.path.join(directory, CONFIG_FILE)
+        config = load_config(directory) if os.path.exists(config_path) else None
+        return params, config
+    return _load_orbax_pretrained(directory, template_params)
+
+
+def _load_orbax_pretrained(directory: str, template_params=None):
+    root = os.path.abspath(directory)
+    if not _has_orbax_steps(root):
+        nested = os.path.join(root, "checkpoints")
+        if _has_orbax_steps(nested):
+            root = nested
+        else:
+            raise FileNotFoundError(
+                f"{directory} has neither {PARAMS_FILE} nor orbax checkpoint steps"
+            )
+    # prefer the best retained step by the standard monitor (the reference's
+    # ModelCheckpoint monitors val_loss); fall back to the latest when no
+    # per-step metrics were recorded
+    options = ocp.CheckpointManagerOptions(
+        best_fn=lambda metrics: metrics.get("val_loss", float("inf")), best_mode="min"
+    )
+    mngr = ocp.CheckpointManager(root, options=options)
+    try:
+        step = mngr.best_step()
+        if step is None:
+            step = mngr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint steps under {root}")
+        payload = mngr.restore(step)
+    finally:
+        mngr.close()
+    params = payload["params"] if isinstance(payload, dict) and "params" in payload else payload
     if template_params is not None:
-        params = serialization.from_bytes(template_params, data)
-    else:
-        params = serialization.msgpack_restore(data)
-    config_path = os.path.join(directory, CONFIG_FILE)
-    config = load_config(directory) if os.path.exists(config_path) else None
+        params = serialization.from_state_dict(
+            template_params, serialization.to_state_dict(params)
+        )
+    config_path = os.path.join(root, CONFIG_FILE)
+    config = load_config(root) if os.path.exists(config_path) else None
     return params, config
+
+
+def _has_orbax_steps(root: str) -> bool:
+    if not os.path.isdir(root):
+        return False
+    return any(
+        name.isdigit() and os.path.isdir(os.path.join(root, name)) for name in os.listdir(root)
+    )
 
 
 def load_params_into(params, source_params, subtree: Optional[str] = None):
