@@ -26,7 +26,7 @@ TPU-first differences from the reference:
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -135,6 +135,36 @@ class MultiHeadAttention(nn.Module):
         self.o_proj = dense(self.output_channels, self.out_bias, "o_proj")
         self.attn_dropout = nn.Dropout(self.dropout)
 
+    def _split_heads(self, x: jnp.ndarray, channels_per_head: int) -> jnp.ndarray:
+        b = x.shape[0]
+        return x.reshape(b, x.shape[1], self.num_heads, channels_per_head).transpose(0, 2, 1, 3)
+
+    def project_q(self, x_q: jnp.ndarray, rope_q: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+        """Queries as scaled (and rotated) heads (B, H, N, Dk/H) — the exact
+        query pipeline of ``__call__``, exposed for blockwise/sequence-parallel
+        attention compositions that supply their own attend step."""
+        q = self._split_heads(self.q_proj(x_q), self.qk_channels // self.num_heads)
+        q = q * (self.qk_channels // self.num_heads) ** -0.5
+        if rope_q is not None:
+            q = apply_rotary_pos_emb(q, rope_q[:, None, :, :])
+        return q
+
+    def project_kv(
+        self, x_kv: jnp.ndarray, rope_k: Optional[jnp.ndarray] = None
+    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Keys/values as heads ((B, H, M, Dk/H), (B, H, M, Dv/H)), keys
+        rotated — the cache-free key/value pipeline of ``__call__``."""
+        k = self._split_heads(self.k_proj(x_kv), self.qk_channels // self.num_heads)
+        v = self._split_heads(self.v_proj(x_kv), self.v_channels // self.num_heads)
+        if rope_k is not None:
+            k = apply_rotary_pos_emb(k, rope_k[:, None, :, :])
+        return k, v
+
+    def merge_output(self, o: jnp.ndarray) -> jnp.ndarray:
+        """Head-merge + output projection: (B, H, N, Dv/H) -> (B, N, out)."""
+        b, _, n, _ = o.shape
+        return self.o_proj(o.transpose(0, 2, 1, 3).reshape(b, n, self.v_channels))
+
     def __call__(
         self,
         x_q: jnp.ndarray,
@@ -156,7 +186,7 @@ class MultiHeadAttention(nn.Module):
             at ``cache.length``. The caller must ensure capacity is not
             exceeded (slide the window first — see generation).
         """
-        b, n_q = x_q.shape[0], x_q.shape[1]
+        n_q = x_q.shape[1]
         h = self.num_heads
 
         q = self.q_proj(x_q)
@@ -176,12 +206,9 @@ class MultiHeadAttention(nn.Module):
 
         n_kv = k_slots.shape[1]
 
-        def split_heads(x, channels_per_head):
-            return x.reshape(b, x.shape[1], h, channels_per_head).transpose(0, 2, 1, 3)
-
-        q = split_heads(q, self.qk_channels // h)
-        k_h = split_heads(k_slots, self.qk_channels // h)
-        v_h = split_heads(v_slots, self.v_channels // h)
+        q = self._split_heads(q, self.qk_channels // h)
+        k_h = self._split_heads(k_slots, self.qk_channels // h)
+        v_h = self._split_heads(v_slots, self.v_channels // h)
 
         q = q * (self.qk_channels // h) ** -0.5
 
@@ -204,8 +231,7 @@ class MultiHeadAttention(nn.Module):
             o = flash_attention(
                 q, k_h, v_h, pad_mask=pad_mask, causal=self.causal_attention, sm_scale=1.0
             )
-            o = o.transpose(0, 2, 1, 3).reshape(b, n_q, self.v_channels)
-            return AttentionOutput(last_hidden_state=self.o_proj(o), kv_cache=None)
+            return AttentionOutput(last_hidden_state=self.merge_output(o), kv_cache=None)
 
         # Combined boolean mask (True = masked), shape broadcastable to (B, 1, N, M).
         kv_idx = jnp.arange(n_kv, dtype=jnp.int32)
@@ -236,6 +262,4 @@ class MultiHeadAttention(nn.Module):
             ]
             o = jnp.concatenate(o_chunks, axis=1)
 
-        o = o.transpose(0, 2, 1, 3).reshape(b, n_q, self.v_channels)
-        o = self.o_proj(o)
-        return AttentionOutput(last_hidden_state=o, kv_cache=new_cache)
+        return AttentionOutput(last_hidden_state=self.merge_output(o), kv_cache=new_cache)

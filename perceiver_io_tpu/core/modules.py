@@ -783,6 +783,111 @@ class PerceiverAR(nn.Module):
             new_cache = (ca_out.kv_cache,) + tuple(sa_out.kv_cache)
         return BlockOutput(last_hidden_state=sa_out.last_hidden_state, kv_cache=new_cache)
 
+    def seq_parallel_forward(
+        self,
+        x_latent,
+        frq_latent,
+        x_prefix_local,
+        frq_prefix_local,
+        *,
+        axis_name: str,
+        prefix_pad_local=None,
+        deterministic: bool = True,
+    ):
+        """Sequence-parallel forward with the **prefix sharded** over the mesh
+        axis ``axis_name`` — call inside ``jax.shard_map``.
+
+        This is the explicit-overlap wiring of the ring/blockwise kernels into
+        the model (SURVEY §5.7: shard the prefix KV axis — beyond reference
+        parity; the reference handles long context single-device,
+        perceiver/model/core/modules.py:850-866). The decomposition follows
+        the Perceiver AR structure: latents (queries) are replicated, the
+        long prefix is sharded, so the causal cross-attention over
+        [prefix; latents] splits exactly into
+
+        - a per-device partial over the local prefix block (no causal mask —
+          every prefix position precedes every latent), LSE-combined across
+          the axis with one ``pmax`` + two ``psum`` (communication O(latents),
+          independent of context length), and
+        - a local causal partial over the latent block (replicated),
+
+        merged with an online-softmax combine — numerically identical to the
+        dense forward. The latent self-attention stack is small (O(latents²))
+        and runs replicated; no communication.
+
+        Inputs are pre-embedded (see ``CausalSequenceModel.seq_parallel_forward``
+        for the token-level entry): ``x_latent``/``frq_latent`` (B, L, C)/(B, L, R)
+        replicated, ``x_prefix_local``/``frq_prefix_local`` the per-device
+        prefix block, ``prefix_pad_local`` (B, P_local) True at padding.
+        Prefix cross-attention dropout is a training regularizer of the dense
+        path; here it must be off (``deterministic=True``).
+        """
+        from perceiver_io_tpu.ops.online_softmax import (
+            NEG_INF,
+            block_attention,
+            finalize,
+            online_combine,
+        )
+
+        if not deterministic and (
+            self.cross_attention_dropout > 0.0
+            or self.post_attention_dropout > 0.0
+            or self.residual_dropout > 0.0
+        ):
+            # the hand-wired cross-attention block below applies no dropout,
+            # so allowing it only in the SA stack would silently diverge from
+            # the dense path — reject any active dropout
+            raise ValueError(
+                "dropout is not supported on the sequence-parallel path; set "
+                "cross_attention_dropout/post_attention_dropout/residual_dropout "
+                "to 0 or pass deterministic=True"
+            )
+
+        ca_layer = self.cross_attention
+        ca = ca_layer.cross_attn
+        mha = ca.attention
+
+        # Reference KV construction for the prefix mode (modules.py:222-224):
+        # x_kv = concat(kv_norm(prefix), q_norm(latents)).
+        q_in = ca.q_norm(x_latent)
+        kv_prefix = ca.kv_norm(x_prefix_local)
+
+        q = mha.project_q(q_in, rope_q=frq_latent)
+        k_p, v_p = mha.project_kv(kv_prefix, rope_k=frq_prefix_local)
+        k_l, v_l = mha.project_kv(q_in, rope_k=frq_latent)
+
+        # per-device prefix partial; all prefix positions precede all latents,
+        # so only the pad mask applies
+        p_local = x_prefix_local.shape[1]
+        masked_p = jnp.zeros((1, 1, 1, p_local), bool)
+        if prefix_pad_local is not None:
+            masked_p = masked_p | prefix_pad_local[:, None, None, :]
+        o_p, m_p, l_p = block_attention(q, k_p, v_p, masked_p)
+
+        # LSE-combine the prefix partials across the axis: O(L) communication
+        m_glob = lax.pmax(m_p, axis_name)
+        scale = jnp.exp(m_p - jnp.maximum(m_glob, NEG_INF / 2))
+        o_p = lax.psum(o_p * scale[..., None], axis_name)
+        l_p = lax.psum(l_p * scale, axis_name)
+
+        # replicated causal latent partial
+        n_lat = x_latent.shape[1]
+        lat_idx = jnp.arange(n_lat, dtype=jnp.int32)
+        masked_l = (lat_idx[None, None, None, :] > lat_idx[None, None, :, None])
+        o_l, m_l, l_l = block_attention(q, k_l, v_l, masked_l)
+
+        o, _, l = online_combine((o_p, m_glob, l_p), (o_l, m_l, l_l))
+        h_attn = mha.merge_output(finalize(o, l).astype(x_latent.dtype))
+
+        # cross-attention layer residuals + MLP (dropout inactive: deterministic)
+        h = x_latent + h_attn
+        h = h + ca_layer.mlp(h)
+
+        sa_out = self.self_attention(
+            h, None, frq_latent, frq_latent, None, deterministic
+        )
+        return sa_out.last_hidden_state
+
     def _decode_step(self, x, pad_mask, kv_cache, deterministic, sa_pad_mask=None, pos_shift=None):
         """One incremental step: the whole input is latent; absolute positions
         continue from the cache fill level (dynamic values, static shapes)."""
@@ -887,6 +992,68 @@ class CausalSequenceModel(nn.Module):
             for _ in range(config.num_self_attention_layers)
         )
         return (ca,) + sas
+
+    def seq_parallel_forward(
+        self,
+        latent_ids,
+        prefix_ids_local,
+        *,
+        axis_name: str,
+        prefix_pad_local=None,
+        deterministic: bool = True,
+    ):
+        """Token-level sequence-parallel forward — call inside ``shard_map``
+        with ``latent_ids`` (B, L) replicated and ``prefix_ids_local``
+        (B, P/n_dev) this device's prefix block (see
+        ``parallel.long_context.make_seq_parallel_clm_forward`` for the
+        whole-array wrapper). Returns replicated latent logits (B, L, V).
+
+        Absolute positions are global: device ``i`` embeds prefix positions
+        ``[i*P_local, (i+1)*P_local)``; latents sit at ``[P, P+L)``. Left
+        padding shifts positions by the global pad count (``psum`` over the
+        axis), matching the dense path's ``positions()`` shift
+        (reference: perceiver/model/core/modules.py:775-779).
+        """
+        b, n_lat = latent_ids.shape
+        p_local = prefix_ids_local.shape[1]
+        n_dev = lax.axis_size(axis_name)
+        idx = lax.axis_index(axis_name)
+        p_total = p_local * n_dev
+
+        # the dense __call__ validation (window bounds), on static shapes
+        if p_total > self.max_prefix_len:
+            raise ValueError(
+                f"prefix_len ({p_total}) exceeds max_prefix_len ({self.max_prefix_len})"
+            )
+        if not 0 < n_lat <= self.max_latents:
+            raise ValueError(
+                f"number of latent positions ({n_lat}) out of valid range "
+                f"[1..{self.max_latents}]"
+            )
+
+        shift = None
+        if prefix_pad_local is not None:
+            local_pad = prefix_pad_local.sum(axis=1, keepdims=True).astype(jnp.int32)
+            shift = lax.psum(local_pad, axis_name)
+
+        pos_prefix = positions(b, p_local, shift=shift, offset=idx * p_local)
+        pos_latent = positions(b, n_lat, shift=shift, offset=p_total)
+
+        emb_prefix, frq_prefix = self.input_adapter(prefix_ids_local, pos_prefix)
+        emb_latent, frq_latent = self.input_adapter(latent_ids, pos_latent)
+
+        h = self.perceiver_ar.seq_parallel_forward(
+            emb_latent,
+            frq_latent,
+            emb_prefix,
+            frq_prefix,
+            axis_name=axis_name,
+            prefix_pad_local=prefix_pad_local,
+            deterministic=deterministic,
+        )
+        if self.config.output_norm:
+            h = self.out_norm(h)
+        return self.output_adapter(h, attend=self.input_adapter.attend)
 
     def __call__(
         self,

@@ -1,0 +1,64 @@
+"""Blockwise (online) softmax-attention primitives.
+
+The exact-decomposition core shared by the sequence-parallel paths
+(`parallel.ring_attention`, `core.modules.PerceiverAR.seq_parallel_forward`):
+attention over a partitioned key/value axis is computed per block and the
+partial results are combined with a log-sum-exp reduction — numerically
+identical to dense softmax attention (up to float error), never
+materializing the full score matrix on one device.
+
+All statistics are float32 regardless of the input dtype (the same
+bfloat16-safety rule as `core.attention` and `ops.flash_attention`).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+
+def block_attention(q, k, v, masked):
+    """One attention block with running-softmax statistics.
+
+    q: (B, H, N, Dk), k: (B, H, M, Dk), v: (B, H, M, Dv) — any dtype;
+    masked: bool broadcastable to (B, 1|H, N, M), True = masked out.
+
+    Returns (o, m, l) in float32: un-normalized output ``o`` (B, H, N, Dv),
+    row maxima ``m`` and row sums ``l`` (B, H, N). Fully-masked rows yield
+    o = 0, l = 0 and m = -inf-surrogate, which combine correctly.
+
+    The max statistic carries no gradient: the normalized output o/l is
+    shift-invariant in m (d(o/l)/dm == 0 exactly), so ``stop_gradient``
+    changes nothing numerically while keeping the statistic — and every
+    collective applied to it (``pmax`` has no differentiation rule) — out of
+    the autodiff graph. Dense softmax does the same internally.
+    """
+    s = jnp.einsum("bhnd,bhmd->bhnm", q, k, preferred_element_type=jnp.float32)
+    s = jnp.where(masked, NEG_INF, s)
+    m = lax.stop_gradient(jnp.max(s, axis=-1))
+    # guard fully-masked rows: exp(NEG_INF - NEG_INF) would be exp(0)=1
+    m_safe = jnp.maximum(m, NEG_INF / 2)
+    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.where(masked, 0.0, p)
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum("bhnm,bhmd->bhnd", p.astype(v.dtype), v).astype(jnp.float32)
+    return o, m, l
+
+
+def online_combine(acc, new):
+    """Combine two (o, m, l) partial-softmax states into one."""
+    o_a, m_a, l_a = acc
+    o_n, m_n, l_n = new
+    m = jnp.maximum(m_a, m_n)
+    m_safe = jnp.maximum(m, NEG_INF / 2)
+    s_a = jnp.exp(m_a - m_safe)
+    s_n = jnp.exp(m_n - m_safe)
+    return o_a * s_a[..., None] + o_n * s_n[..., None], m, l_a * s_a + l_n * s_n
+
+
+def finalize(o, l):
+    """Normalize accumulated output; fully-masked rows return 0."""
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    return o / l_safe[..., None]

@@ -1,0 +1,109 @@
+"""Long-context sequence parallelism wired into the model: the Perceiver AR
+forward with the **prefix sharded** over the ``seq`` mesh axis.
+
+This is the explicit ``shard_map`` counterpart of the GSPMD path validated in
+``tests/test_seq_parallel_step.py`` (where XLA partitions the dense forward
+from sharding annotations alone). Here the blockwise/online-softmax
+decomposition is explicit — per-device prefix partials, one ``pmax`` + two
+``psum`` of size O(latents) — so the communication volume is independent of
+the context length, and a 16k..1M-token prefix never exists in one device's
+HBM (SURVEY §5.7; the reference handles long context on a single device,
+perceiver/model/core/modules.py:850-866, and has no sequence parallelism,
+SURVEY §2.7 P8).
+
+Usage::
+
+    mesh = make_mesh(seq=8)
+    fwd = make_seq_parallel_clm_forward(model, mesh, prefix_len=prefix_len)
+    logits = fwd(params, input_ids)                 # (B, L, V) latent logits
+
+    loss = make_seq_parallel_clm_loss(model, mesh, prefix_len=prefix_len)
+    l, grads = jax.value_and_grad(loss)(params, input_ids, labels)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from perceiver_io_tpu.parallel.mesh import AXIS_SEQ
+
+
+def _split_prompt(input_ids, pad_mask, prefix_len: int):
+    latent_ids = input_ids[:, prefix_len:]
+    prefix_ids = input_ids[:, :prefix_len]
+    prefix_pad = None if pad_mask is None else pad_mask[:, :prefix_len]
+    # value check only on concrete (eager) masks — under jit/grad the mask is
+    # a tracer and the contract (left padding only) is documented, not checked
+    if (
+        pad_mask is not None
+        and not isinstance(pad_mask, jax.core.Tracer)
+        and bool(jnp.any(pad_mask[:, prefix_len:]))
+    ):
+        raise ValueError("padding must be confined to the (left-padded) prefix")
+    return latent_ids, prefix_ids, prefix_pad
+
+
+def make_seq_parallel_clm_forward(model, mesh: Mesh, *, prefix_len: int, axis_name: str = AXIS_SEQ):
+    """Jitted ``fn(params, input_ids, pad_mask=None) -> latent logits``.
+
+    ``input_ids`` is the full (B, S) prompt; the first ``prefix_len`` columns
+    are sharded over ``axis_name`` (must divide ``prefix_len``), the latent
+    suffix is replicated. ``pad_mask`` marks left padding (prefix only).
+    """
+    seq_size = mesh.shape[axis_name]
+    if prefix_len % seq_size != 0:
+        raise ValueError(f"prefix_len ({prefix_len}) must be divisible by the "
+                         f"'{axis_name}' axis size ({seq_size})")
+
+    def per_device(params, latent_ids, prefix_local, prefix_pad_local=None):
+        return model.apply(
+            params,
+            latent_ids,
+            prefix_local,
+            axis_name=axis_name,
+            prefix_pad_local=prefix_pad_local,
+            method="seq_parallel_forward",
+        )
+
+    shard = P(None, axis_name)
+    with_mask = jax.jit(jax.shard_map(
+        per_device, mesh=mesh, in_specs=(P(), P(), shard, shard), out_specs=P()
+    ))
+    no_mask = jax.jit(jax.shard_map(
+        per_device, mesh=mesh, in_specs=(P(), P(), shard), out_specs=P()
+    ))
+
+    def fn(params, input_ids, pad_mask: Optional[jnp.ndarray] = None):
+        latent_ids, prefix_ids, prefix_pad = _split_prompt(input_ids, pad_mask, prefix_len)
+        if prefix_pad is not None:
+            return with_mask(params, latent_ids, prefix_ids, prefix_pad)
+        return no_mask(params, latent_ids, prefix_ids)
+
+    return fn
+
+
+def make_seq_parallel_clm_loss(model, mesh: Mesh, *, prefix_len: int, axis_name: str = AXIS_SEQ):
+    """``loss(params, input_ids, labels) -> scalar`` — mean next-token CE over
+    the latent positions (the reference's CLM loss window: loss over the last
+    ``max_latents`` logits, perceiver/model/core/lightning.py:117-133), with
+    the prefix sharded over ``axis_name``. Differentiable through the
+    ``shard_map`` (psum/pmax have transfer rules), so
+    ``jax.value_and_grad`` gives sequence-parallel training gradients.
+
+    ``labels``: (B, L) target ids for the latent positions, -100 = ignore.
+    """
+    fwd = make_seq_parallel_clm_forward(model, mesh, prefix_len=prefix_len, axis_name=axis_name)
+
+    def loss(params, input_ids, labels, pad_mask: Optional[jnp.ndarray] = None):
+        logits = fwd(params, input_ids, pad_mask).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        valid = labels != -100
+        tgt = jnp.where(valid, labels, 0)
+        ll = jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+        return -(ll * valid).sum() / jnp.maximum(valid.sum(), 1)
+
+    return loss

@@ -44,48 +44,13 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from perceiver_io_tpu.ops.online_softmax import (
+    NEG_INF as _NEG_INF,
+    block_attention as _block_attention,
+    finalize as _finalize,
+    online_combine as _online_combine,
+)
 from perceiver_io_tpu.parallel.mesh import AXIS_SEQ
-
-_NEG_INF = float(jnp.finfo(jnp.float32).min)
-
-
-def _block_attention(q, k, v, masked):
-    """One attention block with running-softmax statistics.
-
-    q: (B, H, N, Dk), k: (B, H, M, Dk), v: (B, H, M, Dv) — all any dtype;
-    masked: bool broadcastable to (B, 1|H, N, M), True = masked out.
-
-    Returns (o, m, l) in float32: un-normalized output ``o`` (B, H, N, Dv),
-    row maxima ``m`` and row sums ``l`` (B, H, N). Fully-masked rows yield
-    o = 0, l = 0 and m = -inf-surrogate, which combine correctly.
-    """
-    s = jnp.einsum("bhnd,bhmd->bhnm", q, k, preferred_element_type=jnp.float32)
-    s = jnp.where(masked, _NEG_INF, s)
-    m = jnp.max(s, axis=-1)
-    # guard fully-masked rows: exp(_NEG_INF - _NEG_INF) would be exp(0)=1
-    m_safe = jnp.maximum(m, _NEG_INF / 2)
-    p = jnp.exp(s - m_safe[..., None])
-    p = jnp.where(masked, 0.0, p)
-    l = jnp.sum(p, axis=-1)
-    o = jnp.einsum("bhnm,bhmd->bhnd", p.astype(v.dtype), v).astype(jnp.float32)
-    return o, m, l
-
-
-def _online_combine(acc, new):
-    """Combine two (o, m, l) partial-softmax states into one."""
-    o_a, m_a, l_a = acc
-    o_n, m_n, l_n = new
-    m = jnp.maximum(m_a, m_n)
-    m_safe = jnp.maximum(m, _NEG_INF / 2)
-    s_a = jnp.exp(m_a - m_safe)
-    s_n = jnp.exp(m_n - m_safe)
-    return o_a * s_a[..., None] + o_n * s_n[..., None], m, l_a * s_a + l_n * s_n
-
-
-def _finalize(o, l):
-    """Normalize accumulated output; fully-masked rows return 0."""
-    l_safe = jnp.where(l == 0.0, 1.0, l)
-    return o / l_safe[..., None]
 
 
 def seq_sharded_cross_attention(

@@ -1,0 +1,160 @@
+"""Explicit sequence-parallel model wiring: the Perceiver AR forward with the
+prefix sharded over the ``seq`` axis (``shard_map`` + online-softmax combine,
+`parallel/long_context.py`) must equal the dense single-device forward, for
+logits and for gradients.
+
+This complements `test_seq_parallel_step.py` (GSPMD partitioning of the dense
+forward) and `test_ring_attention.py` (standalone kernels): here the
+blockwise decomposition is wired *into the model* — the path whose
+communication is O(latents) regardless of context length (SURVEY §5.7)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from perceiver_io_tpu.models.text import CausalLanguageModel, CausalLanguageModelConfig
+from perceiver_io_tpu.parallel import make_mesh
+from perceiver_io_tpu.parallel.long_context import (
+    make_seq_parallel_clm_forward,
+    make_seq_parallel_clm_loss,
+)
+
+SEQ_LEN, LATENTS, VOCAB = 64, 16, 64
+PREFIX = SEQ_LEN - LATENTS
+
+
+@pytest.fixture(scope="module")
+def setup():
+    config = CausalLanguageModelConfig(
+        vocab_size=VOCAB,
+        max_seq_len=SEQ_LEN,
+        max_latents=LATENTS,
+        num_channels=32,
+        num_heads=4,
+        num_self_attention_layers=2,
+        cross_attention_dropout=0.0,
+    )
+    model = CausalLanguageModel(config)
+    rng = np.random.default_rng(7)
+    input_ids = jnp.asarray(rng.integers(0, VOCAB, size=(2, SEQ_LEN)))
+    params = model.init(jax.random.PRNGKey(0), input_ids, prefix_len=PREFIX)
+    return model, params, input_ids
+
+
+def dense_latent_logits(model, params, input_ids, pad_mask=None):
+    out = model.apply(params, input_ids, prefix_len=PREFIX, pad_mask=pad_mask)
+    return out.logits
+
+
+def test_seq_parallel_forward_matches_dense(setup):
+    model, params, input_ids = setup
+    mesh = make_mesh(data=1, seq=4, devices=jax.devices()[:4])
+    fwd = make_seq_parallel_clm_forward(model, mesh, prefix_len=PREFIX)
+
+    ref = dense_latent_logits(model, params, input_ids)
+    out = fwd(params, input_ids)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_seq_parallel_forward_with_left_padding(setup):
+    model, params, input_ids = setup
+    mesh = make_mesh(data=1, seq=4, devices=jax.devices()[:4])
+    fwd = make_seq_parallel_clm_forward(model, mesh, prefix_len=PREFIX)
+
+    pad_mask = jnp.zeros((2, SEQ_LEN), bool).at[0, :5].set(True).at[1, :11].set(True)
+    ref = dense_latent_logits(model, params, input_ids, pad_mask=pad_mask)
+    out = fwd(params, input_ids, pad_mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_seq_parallel_grads_match_dense(setup):
+    model, params, input_ids = setup
+    mesh = make_mesh(data=1, seq=4, devices=jax.devices()[:4])
+    loss_fn = make_seq_parallel_clm_loss(model, mesh, prefix_len=PREFIX)
+
+    rng = np.random.default_rng(3)
+    labels = jnp.asarray(rng.integers(0, VOCAB, size=(2, LATENTS)))
+
+    def dense_loss(p):
+        logits = dense_latent_logits(model, p, input_ids).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        return -ll.mean()
+
+    ref_loss, ref_grads = jax.value_and_grad(dense_loss)(params)
+    out_loss, out_grads = jax.jit(jax.value_and_grad(loss_fn))(params, input_ids, labels)
+
+    np.testing.assert_allclose(float(out_loss), float(ref_loss), rtol=2e-5)
+    for a, b in zip(jax.tree.leaves(out_grads), jax.tree.leaves(ref_grads)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
+
+
+def test_seq_parallel_padded_loss_under_jit(setup):
+    """pad_mask must survive jit tracing (no concrete bool() on tracers)."""
+    model, params, input_ids = setup
+    mesh = make_mesh(data=1, seq=4, devices=jax.devices()[:4])
+    loss_fn = make_seq_parallel_clm_loss(model, mesh, prefix_len=PREFIX)
+    labels = jnp.asarray(np.random.default_rng(5).integers(0, VOCAB, size=(2, LATENTS)))
+    pad_mask = jnp.zeros((2, SEQ_LEN), bool).at[0, :4].set(True)
+    loss, grads = jax.jit(jax.value_and_grad(loss_fn))(params, input_ids, labels, pad_mask)
+    assert np.isfinite(float(loss))
+    assert all(np.all(np.isfinite(np.asarray(g))) for g in jax.tree.leaves(grads))
+
+
+def test_seq_parallel_rejects_indivisible_prefix(setup):
+    model, params, input_ids = setup
+    mesh = make_mesh(data=1, seq=4, devices=jax.devices()[:4])
+    with pytest.raises(ValueError, match="divisible"):
+        make_seq_parallel_clm_forward(model, mesh, prefix_len=PREFIX + 1)
+
+
+def test_seq_parallel_rejects_window_violations(setup):
+    """The dense __call__ window validation also applies on the sharded path
+    (reference error contract, core/huggingface.py:187-230)."""
+    model, params, input_ids = setup
+    mesh = make_mesh(data=1, seq=4, devices=jax.devices()[:4])
+    # prefix longer than max_prefix_len: pass an over-long prompt
+    long_ids = jnp.concatenate([input_ids, input_ids[:, :8]], axis=1)
+    fwd = make_seq_parallel_clm_forward(model, mesh, prefix_len=PREFIX + 8)
+    with pytest.raises(ValueError, match="max_prefix_len"):
+        fwd(params, long_ids)
+    # latent suffix longer than max_latents
+    fwd2 = make_seq_parallel_clm_forward(model, mesh, prefix_len=PREFIX - 8)
+    with pytest.raises(ValueError, match="latent"):
+        fwd2(params, input_ids)
+
+
+def test_seq_parallel_rejects_active_dropout():
+    config = CausalLanguageModelConfig(
+        vocab_size=VOCAB,
+        max_seq_len=SEQ_LEN,
+        max_latents=LATENTS,
+        num_channels=32,
+        num_heads=4,
+        num_self_attention_layers=1,
+        cross_attention_dropout=0.5,
+    )
+    model = CausalLanguageModel(config)
+    ids = jnp.zeros((1, SEQ_LEN), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids, prefix_len=PREFIX)
+    mesh = make_mesh(data=1, seq=4, devices=jax.devices()[:4])
+
+    def per_device(params, latent_ids, prefix_local):
+        return model.apply(
+            params,
+            latent_ids,
+            prefix_local,
+            axis_name="seq",
+            deterministic=False,
+            method="seq_parallel_forward",
+            rngs={"dropout": jax.random.PRNGKey(1)},
+        )
+
+    from jax.sharding import PartitionSpec as P
+
+    smapped = jax.shard_map(
+        per_device, mesh=mesh, in_specs=(P(), P(), P(None, "seq")), out_specs=P()
+    )
+    with pytest.raises(ValueError, match="dropout"):
+        smapped(params, ids[:, PREFIX:], ids[:, :PREFIX])
