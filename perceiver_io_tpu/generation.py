@@ -83,6 +83,22 @@ def _sample(logits: jnp.ndarray, rng: jax.Array, config: GenerationConfig) -> jn
     return jax.random.categorical(rng, logits, axis=-1)
 
 
+def _require_pads_in_prefix(pad_mask, prefix_len: int) -> None:
+    """Left padding must not reach into the latent region: the latent
+    self-attention stack carries no pad mask (reference semantics — pads are
+    masked in the cross-attention only), so a pad token that becomes a latent
+    would be attended. Checked eagerly on concrete masks; under jit the
+    contract is documented, not checked."""
+    if pad_mask is None or isinstance(pad_mask, jax.core.Tracer):
+        return
+    max_pads = int(jnp.max(jnp.sum(pad_mask, axis=1)))
+    if max_pads > prefix_len:
+        raise ValueError(
+            f"left padding ({max_pads} tokens) reaches into the latent region "
+            f"(prefix_len={prefix_len}); lower num_latents or shorten the padding"
+        )
+
+
 def _validate_window(mcfg, seq_len: int, num_latents: int) -> int:
     """Shared window validation (reference error contract,
     reference: core/huggingface.py:187-230). Returns the prefix length."""
@@ -112,6 +128,7 @@ def beam_search(
     length_penalty: float = 1.0,
     eos_token_id: Optional[int] = None,
     pad_token_id: int = 0,
+    pad_mask: Optional[jnp.ndarray] = None,
     cache_dtype=jnp.float32,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Beam-search decoding over the fixed-capacity KV caches.
@@ -125,6 +142,9 @@ def beam_search(
     Sequence length must satisfy ``seq_len + max_new_tokens <= max_seq_len``
     (no sliding window during search; beams must share absolute positions).
 
+    :param pad_mask: boolean (B, S), True at (left) padding — mixed-length
+        prompts batched with left padding; positions are shifted per row so a
+        padded row decodes exactly like its unpadded equivalent.
     :return: ``(sequences (B, S + max_new_tokens), scores (B,))`` — the best
         beam per batch element and its length-penalized log-probability.
     """
@@ -138,13 +158,16 @@ def beam_search(
             f"max_seq_len ({mcfg.max_seq_len}) — beam search does not slide the window"
         )
     prefix_len = _validate_window(mcfg, seq_len, num_latents)
+    _require_pads_in_prefix(pad_mask, prefix_len)
 
     from perceiver_io_tpu.core.modules import CausalSequenceModel
 
     bb = b * num_beams
     # prompt pass on B rows, then tile caches/logits to B*num_beams rows
     small_cache = CausalSequenceModel.init_cache(mcfg, b, dtype=cache_dtype)
-    out = model.apply(params, input_ids, prefix_len=prefix_len, kv_cache=small_cache)
+    out = model.apply(
+        params, input_ids, prefix_len=prefix_len, pad_mask=pad_mask, kv_cache=small_cache
+    )
 
     def tile(x):
         return jnp.repeat(x, num_beams, axis=0)
@@ -152,6 +175,18 @@ def beam_search(
     cache = tuple(
         KVCache(k=tile(c.k), v=tile(c.v), length=c.length) for c in out.kv_cache
     )
+
+    # left-pad handling for decode steps: padded prompt slots stay masked in
+    # the CA window forever (slot-aligned mask over the cache capacity), and
+    # positions shift down by the per-row pad count — the same contract as
+    # generate()'s decode loop
+    if pad_mask is not None:
+        ca_capacity = cache[0].capacity
+        pos_shift = tile(pad_mask.sum(axis=1, keepdims=True).astype(jnp.int32))
+        pad_slots = jnp.zeros((bb, ca_capacity), bool).at[:, :seq_len].set(tile(pad_mask))
+    else:
+        pos_shift = None
+        pad_slots = None
     logprobs0 = jax.nn.log_softmax(out.logits[:, -1].astype(jnp.float32))  # (B, V)
     vocab = logprobs0.shape[-1]
 
@@ -172,7 +207,15 @@ def beam_search(
         # does (the CA cache cannot fill — validated above); positions keep
         # counting from the CA length, so beams stay aligned
         cache = (cache[0],) + tuple(_shift_left_if_full(c) for c in cache[1:])
-        out = model.apply(params, token[:, None], prefix_len=0, kv_cache=cache, decode=True)
+        out = model.apply(
+            params,
+            token[:, None],
+            prefix_len=0,
+            pad_mask=pad_slots,
+            kv_cache=cache,
+            decode=True,
+            pos_shift=pos_shift,
+        )
         logprobs = jax.nn.log_softmax(out.logits[:, -1].astype(jnp.float32))  # (bb, V)
 
         if eos_token_id is not None:
@@ -284,6 +327,7 @@ def generate(
         return input_ids
 
     prefix_len = _validate_window(mcfg, seq_len, num_latents)
+    _require_pads_in_prefix(pad_mask, prefix_len)
 
     from perceiver_io_tpu.core.modules import CausalSequenceModel
 

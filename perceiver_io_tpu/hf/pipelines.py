@@ -98,8 +98,6 @@ class TextGenerationPipeline:
         if num_beams > 1:
             if do_sample:
                 raise ValueError("num_beams > 1 requires do_sample=False (beam search is deterministic)")
-            if pad_mask is not None and pad_mask.any():
-                raise ValueError("beam search requires equal-length prompts (no padding)")
             from perceiver_io_tpu.generation import beam_search
 
             # beam search never slides the cross-attention window, so the
@@ -109,7 +107,14 @@ class TextGenerationPipeline:
                 raise ValueError("max_new_tokens leaves no room for a prompt within max_seq_len")
             if ids.shape[1] > limit:
                 ids = ids[:, -limit:]
-                ids, _, num_latents = _fit_prompt_window(self.model.config, ids, None, num_latents)
+                if pad_mask is not None:
+                    pad_mask = pad_mask[:, -limit:]
+                ids, pad_mask, num_latents = _fit_prompt_window(
+                    self.model.config, ids, pad_mask, num_latents
+                )
+            num_latents = _clamp_latents_to_real_length(
+                self.model.config, ids, pad_mask, num_latents
+            )
 
             out, _ = beam_search(
                 self.model,
@@ -118,6 +123,7 @@ class TextGenerationPipeline:
                 num_latents=num_latents,
                 num_beams=num_beams,
                 max_new_tokens=max_new_tokens,
+                pad_mask=None if pad_mask is None or not pad_mask.any() else jnp.asarray(pad_mask),
             )
             texts = self.tokenizer.batch_decode(np.asarray(out).tolist())
             return texts[0] if single else texts
@@ -167,6 +173,26 @@ def _fit_prompt_window(config, ids: np.ndarray, pad_mask: Optional[np.ndarray], 
     num_latents = max(num_latents, min_latents)
     num_latents = min(num_latents, config.max_latents, ids.shape[1])
     return ids, pad_mask, num_latents
+
+
+def _clamp_latents_to_real_length(config, ids: np.ndarray, pad_mask: Optional[np.ndarray], num_latents: int):
+    """Keep left padding out of the latent region (generation contract:
+    pads are masked in cross-attention only): num_latents may not exceed the
+    shortest real prompt length. Raises when the window minimum (forced by
+    max_prefix_len) already conflicts — i.e. the batch mixes prompts too
+    disparate in length for one shared window."""
+    if pad_mask is None or not pad_mask.any():
+        return num_latents
+    seq_len = ids.shape[1]
+    shortest_real = seq_len - int(pad_mask.sum(axis=1).max())
+    min_latents = max(1, seq_len - (config.max_seq_len - config.max_latents))
+    if shortest_real < min_latents:
+        raise ValueError(
+            "prompt lengths differ too much to share one window: the shortest "
+            f"prompt has {shortest_real} tokens but the window forces at least "
+            f"{min_latents} latents; batch prompts of similar length"
+        )
+    return min(max(num_latents, min_latents), shortest_real)
 
 
 class TextClassificationPipeline:

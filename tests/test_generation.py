@@ -222,6 +222,47 @@ class TestBeamSearch:
         with pytest.raises(ValueError, match="does not slide the window"):
             beam_search(model, params, prompt(20), num_latents=8, max_new_tokens=8)
 
+    def test_beam_padded_batch_equals_unpadded_rows(self, model_and_params):
+        """Mixed-length prompts via left padding: each padded row's beam
+        continuation equals the row run alone without padding (pad slots
+        masked in the CA window, positions shifted per row)."""
+        from perceiver_io_tpu.generation import beam_search
+
+        model, params = model_and_params
+        ids = np.array(prompt(10))
+        pad = np.zeros((B, 10), bool)
+        pad[1, :3] = True
+        ids[1, :3] = 0
+        k = 6
+
+        out, _ = beam_search(
+            model, params, jnp.asarray(ids), pad_mask=jnp.asarray(pad),
+            num_latents=4, num_beams=3, max_new_tokens=k,
+        )
+        out0, _ = beam_search(
+            model, params, jnp.asarray(ids[:1]), num_latents=4, num_beams=3, max_new_tokens=k
+        )
+        out1, _ = beam_search(
+            model, params, jnp.asarray(ids[1:, 3:]), num_latents=4, num_beams=3, max_new_tokens=k
+        )
+        np.testing.assert_array_equal(np.asarray(out[0, -k:]), np.asarray(out0[0, -k:]))
+        np.testing.assert_array_equal(np.asarray(out[1, -k:]), np.asarray(out1[0, -k:]))
+
+    def test_beam_rejects_pads_in_latent_region(self, model_and_params):
+        """Padding deeper than prefix_len would put a pad token into the
+        (unmasked) latent self-attention — rejected eagerly."""
+        from perceiver_io_tpu.generation import beam_search
+
+        model, params = model_and_params
+        ids = np.zeros((B, 10), np.int64)
+        pad = np.zeros((B, 10), bool)
+        pad[1, :8] = True  # 8 pads > prefix_len = 10 - 4 = 6
+        with pytest.raises(ValueError, match="latent region"):
+            beam_search(
+                model, params, jnp.asarray(ids), pad_mask=jnp.asarray(pad),
+                num_latents=4, num_beams=2, max_new_tokens=4,
+            )
+
     def test_eos_freezes_beams(self, model_and_params):
         from perceiver_io_tpu.generation import beam_search
 

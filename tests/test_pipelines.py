@@ -63,6 +63,17 @@ class TestTextGeneration:
         with pytest.raises(ValueError, match="do_sample=False"):
             p("hello", num_beams=2, do_sample=True)
 
+    def test_beam_search_mixed_length_prompts(self, clm):
+        """Left-padded beam search through the pipeline: each prompt's beam
+        continuation equals the prompt run alone."""
+        model, params = clm
+        p = TextGenerationPipeline(model, params)
+        batched = p(["hey", "longer one"], max_new_tokens=5, do_sample=False, num_beams=3)
+        assert batched[0].startswith("hey") and batched[1].startswith("longer one")
+        for i, s in enumerate(["hey", "longer one"]):
+            alone = p(s, max_new_tokens=5, do_sample=False, num_beams=3)
+            assert batched[i] == alone
+
     def test_factory_from_pretrained(self, clm, tmp_path):
         model, params = clm
         from perceiver_io_tpu.training.checkpoint import save_pretrained
