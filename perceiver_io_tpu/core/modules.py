@@ -39,6 +39,28 @@ from perceiver_io_tpu.core.position import frequency_position_encoding, position
 LAYER_NORM_EPSILON = 1e-5  # match torch nn.LayerNorm default
 
 
+def _remat(layer_cls, static_argnums, checkpoint: bool, offload: bool):
+    """Activation-checkpointing wrapper for an attention layer class; returns
+    the class unchanged when neither flag is set.
+
+    ``checkpoint``: plain ``nn.remat`` — recompute in the backward pass
+    (reference: fairscale checkpoint_wrapper, modules.py:933-956).
+    ``offload``: the TPU analog of the reference's ``activation_offloading``
+    (CPU offload of saved activations, config.py:60-61,75-76) — dot outputs
+    are kept in **pinned host memory** instead of HBM and fetched back during
+    backward (``offload_dot_with_no_batch_dims``); everything else is
+    rematerialized.
+    """
+    if not (checkpoint or offload):
+        return layer_cls
+    policy = None
+    if offload:
+        policy = jax.checkpoint_policies.offload_dot_with_no_batch_dims(
+            "device", "pinned_host"
+        )
+    return nn.remat(layer_cls, static_argnums=static_argnums, prevent_cse=False, policy=policy)
+
+
 @struct.dataclass
 class BlockOutput:
     last_hidden_state: jnp.ndarray
@@ -358,6 +380,7 @@ class SelfAttentionBlock(nn.Module):
     dropout: float = 0.0
     residual_dropout: float = 0.0
     activation_checkpointing: bool = False
+    activation_offloading: bool = False
     qkv_bias: bool = True
     out_bias: bool = True
     mlp_bias: bool = True
@@ -365,10 +388,10 @@ class SelfAttentionBlock(nn.Module):
     dtype: jnp.dtype = jnp.float32
 
     def setup(self):
-        layer_cls = SelfAttentionLayer
-        if self.activation_checkpointing:
-            # static_argnums counts `self` at 0; 6 == `deterministic`.
-            layer_cls = nn.remat(SelfAttentionLayer, static_argnums=(6,), prevent_cse=False)
+        # static_argnums counts `self` at 0; 6 == `deterministic`.
+        layer_cls = _remat(
+            SelfAttentionLayer, (6,), self.activation_checkpointing, self.activation_offloading
+        )
         self.layers = [
             layer_cls(
                 num_heads=self.num_heads,
@@ -446,6 +469,7 @@ class PerceiverEncoder(nn.Module):
     residual_dropout: float = 0.0
     init_scale: float = 0.02
     activation_checkpointing: bool = False
+    activation_offloading: bool = False
     dtype: jnp.dtype = jnp.float32
 
     @property
@@ -473,9 +497,9 @@ class PerceiverEncoder(nn.Module):
             dtype=self.dtype,
         )
 
-        cross_attn_cls = CrossAttentionLayer
-        if self.activation_checkpointing:
-            cross_attn_cls = nn.remat(CrossAttentionLayer, static_argnums=(8,), prevent_cse=False)
+        cross_attn_cls = _remat(
+            CrossAttentionLayer, (8,), self.activation_checkpointing, self.activation_offloading
+        )
 
         def cross_attn(name):
             return cross_attn_cls(
@@ -504,6 +528,7 @@ class PerceiverEncoder(nn.Module):
                 dropout=self.dropout,
                 residual_dropout=self.residual_dropout,
                 activation_checkpointing=self.activation_checkpointing,
+                activation_offloading=self.activation_offloading,
                 init_scale=self.init_scale,
                 dtype=self.dtype,
                 name=name,
@@ -562,12 +587,13 @@ class PerceiverDecoder(nn.Module):
     dropout: float = 0.0
     init_scale: float = 0.02
     activation_checkpointing: bool = False
+    activation_offloading: bool = False
     dtype: jnp.dtype = jnp.float32
 
     def setup(self):
-        cross_attn_cls = CrossAttentionLayer
-        if self.activation_checkpointing:
-            cross_attn_cls = nn.remat(CrossAttentionLayer, static_argnums=(8,), prevent_cse=False)
+        cross_attn_cls = _remat(
+            CrossAttentionLayer, (8,), self.activation_checkpointing, self.activation_offloading
+        )
         self.cross_attn = cross_attn_cls(
             num_heads=self.num_cross_attention_heads,
             num_q_input_channels=self.output_query_provider.num_query_channels,
@@ -633,14 +659,15 @@ class PerceiverAR(nn.Module):
     post_attention_dropout: float = 0.0
     residual_dropout: float = 0.0
     activation_checkpointing: bool = False
+    activation_offloading: bool = False
     init_scale: float = 0.02
     dtype: jnp.dtype = jnp.float32
 
     def setup(self):
         num_channels = self.input_adapter.num_input_channels
-        cross_attn_cls = CrossAttentionLayer
-        if self.activation_checkpointing:
-            cross_attn_cls = nn.remat(CrossAttentionLayer, static_argnums=(8,), prevent_cse=False)
+        cross_attn_cls = _remat(
+            CrossAttentionLayer, (8,), self.activation_checkpointing, self.activation_offloading
+        )
         self.cross_attention = cross_attn_cls(
             num_heads=self.num_heads,
             num_q_input_channels=num_channels,
@@ -667,6 +694,7 @@ class PerceiverAR(nn.Module):
             residual_dropout=self.residual_dropout,
             num_rotary_layers=self.num_self_attention_rotary_layers,
             activation_checkpointing=self.activation_checkpointing,
+            activation_offloading=self.activation_offloading,
             qkv_bias=False,
             out_bias=False,
             mlp_bias=False,
@@ -948,7 +976,7 @@ class CausalSequenceModel(nn.Module):
             dtype=self.dtype,
             name="input_adapter",
         )
-        ar_kwargs = cfg.base_kwargs(exclude=("activation_offloading",))
+        ar_kwargs = cfg.base_kwargs()
         self.perceiver_ar = PerceiverAR(
             input_adapter=self.input_adapter,
             init_scale=cfg.init_scale,

@@ -27,6 +27,7 @@ class TextClassifier(nn.Module):
             num_latents=cfg.num_latents,
             num_latent_channels=cfg.num_latent_channels,
             activation_checkpointing=cfg.activation_checkpointing,
+            activation_offloading=cfg.activation_offloading,
             dtype=self.dtype,
         )
         self.decoder = PerceiverDecoder(
@@ -44,6 +45,7 @@ class TextClassifier(nn.Module):
             ),
             num_latent_channels=cfg.num_latent_channels,
             activation_checkpointing=cfg.activation_checkpointing,
+            activation_offloading=cfg.activation_offloading,
             dtype=self.dtype,
             **cfg.decoder.base_kwargs(),
         )

@@ -38,6 +38,7 @@ def make_text_encoder(
     num_latents: int,
     num_latent_channels: int,
     activation_checkpointing: bool = False,
+    activation_offloading: bool = False,
     dtype=jnp.float32,
     name: str = "encoder",
 ) -> PerceiverEncoder:
@@ -49,6 +50,7 @@ def make_text_encoder(
         num_latents=num_latents,
         num_latent_channels=num_latent_channels,
         activation_checkpointing=activation_checkpointing,
+        activation_offloading=activation_offloading,
         dtype=dtype,
         name=name,
         **config.base_kwargs(),

@@ -44,6 +44,7 @@ class MaskedLanguageModel(nn.Module):
             num_latents=cfg.num_latents,
             num_latent_channels=cfg.num_latent_channels,
             activation_checkpointing=cfg.activation_checkpointing,
+            activation_offloading=cfg.activation_offloading,
             dtype=self.dtype,
         )
 
@@ -76,6 +77,7 @@ class MaskedLanguageModel(nn.Module):
             output_query_provider=output_query_provider,
             num_latent_channels=cfg.num_latent_channels,
             activation_checkpointing=cfg.activation_checkpointing,
+            activation_offloading=cfg.activation_offloading,
             dtype=self.dtype,
             **cfg.decoder.base_kwargs(),
         )

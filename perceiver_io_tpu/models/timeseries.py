@@ -118,6 +118,7 @@ class TimeSeriesPerceiver(nn.Module):
             num_latents=cfg.num_latents,
             num_latent_channels=cfg.num_latent_channels,
             activation_checkpointing=cfg.activation_checkpointing,
+            activation_offloading=cfg.activation_offloading,
             dtype=self.dtype,
             name="encoder",
             **cfg.encoder.base_kwargs(),
@@ -135,6 +136,7 @@ class TimeSeriesPerceiver(nn.Module):
             ),
             num_latent_channels=cfg.num_latent_channels,
             activation_checkpointing=cfg.activation_checkpointing,
+            activation_offloading=cfg.activation_offloading,
             dtype=self.dtype,
             name="decoder",
             **cfg.decoder.base_kwargs(),

@@ -76,6 +76,7 @@ class ImageClassifier(nn.Module):
             num_latents=cfg.num_latents,
             num_latent_channels=cfg.num_latent_channels,
             activation_checkpointing=cfg.activation_checkpointing,
+            activation_offloading=cfg.activation_offloading,
             dtype=self.dtype,
             name="encoder",
             **encoder_kwargs,
@@ -95,6 +96,7 @@ class ImageClassifier(nn.Module):
             ),
             num_latent_channels=cfg.num_latent_channels,
             activation_checkpointing=cfg.activation_checkpointing,
+            activation_offloading=cfg.activation_offloading,
             dtype=self.dtype,
             name="decoder",
             **cfg.decoder.base_kwargs(),

@@ -140,6 +140,7 @@ class OpticalFlow(nn.Module):
             num_latents=cfg.num_latents,
             num_latent_channels=cfg.num_latent_channels,
             activation_checkpointing=cfg.activation_checkpointing,
+            activation_offloading=cfg.activation_offloading,
             dtype=self.dtype,
             name="encoder",
             **encoder_kwargs,
@@ -156,6 +157,7 @@ class OpticalFlow(nn.Module):
             ),
             num_latent_channels=cfg.num_latent_channels,
             activation_checkpointing=cfg.activation_checkpointing,
+            activation_offloading=cfg.activation_offloading,
             dtype=self.dtype,
             name="decoder",
             **cfg.decoder.base_kwargs(),

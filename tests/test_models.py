@@ -245,3 +245,67 @@ class TestEncoderValidationRules:
 
         with pytest.raises(ValueError, match="divisible by num_heads"):
             self._encoder(num_cross_attention_qk_channels=18, num_cross_attention_heads=4)
+
+
+class TestActivationCheckpointing:
+    """Remat (reference: fairscale checkpoint_wrapper, modules.py:933-956) and
+    its host-offload variant (reference: activation_offloading / CPU offload,
+    config.py:60-61,75-76 — here offload_dot_with_no_batch_dims to
+    pinned_host): both must leave forward values and gradients unchanged."""
+
+    def _clm(self, **flags):
+        config = CausalLanguageModelConfig(
+            vocab_size=VOCAB,
+            max_seq_len=MAX_SEQ_LEN,
+            max_latents=8,
+            num_channels=32,
+            num_heads=4,
+            num_self_attention_layers=2,
+            cross_attention_dropout=0.0,
+            **flags,
+        )
+        return CausalLanguageModel(config)
+
+    @pytest.mark.parametrize("flag", ["activation_checkpointing", "activation_offloading"])
+    def test_clm_values_and_grads_unchanged(self, flag):
+        base = self._clm()
+        wrapped = self._clm(**{flag: True})
+        ids = jnp.asarray(
+            jax.random.randint(jax.random.PRNGKey(1), (B, MAX_SEQ_LEN), 0, VOCAB)
+        )
+        params = base.init(jax.random.PRNGKey(0), ids, prefix_len=24)
+
+        def loss(model, p):
+            return model.apply(p, ids, prefix_len=24).logits.astype(jnp.float32).mean()
+
+        ref, ref_g = jax.value_and_grad(lambda p: loss(base, p))(params)
+        out, out_g = jax.jit(jax.value_and_grad(lambda p: loss(wrapped, p)))(params)
+        assert float(out) == pytest.approx(float(ref), abs=1e-8)
+        for a, b in zip(jax.tree.leaves(out_g), jax.tree.leaves(ref_g)):
+            assert jnp.allclose(a, b, atol=1e-6)
+
+    def test_image_classifier_offloading_builds_and_runs(self):
+        config = ImageClassifierConfig(
+            encoder=ImageEncoderConfig(
+                image_shape=(14, 14, 1),
+                num_frequency_bands=8,
+                num_cross_attention_heads=1,
+                num_self_attention_heads=2,
+                num_self_attention_layers_per_block=2,
+            ),
+            decoder=ClassificationDecoderConfig(
+                num_classes=10, num_output_query_channels=32, num_cross_attention_heads=1
+            ),
+            num_latents=8,
+            num_latent_channels=16,
+            activation_offloading=True,
+        )
+        model = ImageClassifier(config)
+        x = jnp.zeros((B, 14, 14, 1))
+        params = model.init(jax.random.PRNGKey(0), x)
+
+        def loss(p):
+            return model.apply(p, x).astype(jnp.float32).sum()
+
+        g = jax.jit(jax.grad(loss))(params)
+        assert all(jnp.all(jnp.isfinite(le)) for le in jax.tree.leaves(g))
