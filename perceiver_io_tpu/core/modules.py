@@ -34,7 +34,7 @@ from jax import lax
 
 from perceiver_io_tpu.core.attention import AttentionOutput, KVCache, MultiHeadAttention, init_kv_cache
 from perceiver_io_tpu.core.config import CausalSequenceModelConfig
-from perceiver_io_tpu.core.position import frequency_position_encoding, positions
+from perceiver_io_tpu.core.position import positions
 
 LAYER_NORM_EPSILON = 1e-5  # match torch nn.LayerNorm default
 
@@ -775,16 +775,12 @@ class PerceiverAR(nn.Module):
             ca_cache, sa_cache = None, None
         else:
             ca_cache, sa_cache = kv_cache[0], tuple(kv_cache[1:])
-            # Align slot-indexed quantities to the cache capacity.
-            ca_capacity = ca_cache.capacity
-            n_kv = rope_k_ca.shape[1]
-            rope_k_ca = jnp.pad(rope_k_ca, ((0, 0), (0, ca_capacity - n_kv), (0, 0)))
+            # the pad mask reads against cache slots — align it to capacity
+            # (rope_k_ca needs no alignment: keys rotate at write, so it
+            # covers exactly the appended tokens)
             if pad_ca is not None:
-                pad_ca = jnp.pad(pad_ca, ((0, 0), (0, ca_capacity - n_kv)))
-            sa_capacity = sa_cache[0].capacity
-            rope_k_sa = jnp.pad(
-                frq_latent, ((0, 0), (0, sa_capacity - frq_latent.shape[1]), (0, 0))
-            )
+                ca_capacity = ca_cache.capacity
+                pad_ca = jnp.pad(pad_ca, ((0, 0), (0, ca_capacity - pad_ca.shape[1])))
 
         ca_out = self.cross_attention(
             x_latent,
@@ -800,7 +796,7 @@ class PerceiverAR(nn.Module):
             ca_out.last_hidden_state,
             None,
             frq_latent,
-            frq_latent if kv_cache is None else rope_k_sa,
+            frq_latent,
             sa_cache,
             deterministic,
         )
@@ -918,7 +914,10 @@ class PerceiverAR(nn.Module):
 
     def _decode_step(self, x, pad_mask, kv_cache, deterministic, sa_pad_mask=None, pos_shift=None):
         """One incremental step: the whole input is latent; absolute positions
-        continue from the cache fill level (dynamic values, static shapes)."""
+        continue from the cache fill level (dynamic values, static shapes).
+        Cached keys carry their rotation from write time, so only the new
+        tokens' encodings are computed — O(1) rotary work per step instead of
+        O(window)."""
         b, n_x = x.shape[0], x.shape[1]
         ca_cache, sa_cache = kv_cache[0], tuple(kv_cache[1:])
 
@@ -931,20 +930,13 @@ class PerceiverAR(nn.Module):
 
         x_emb, frq_q = self.input_adapter(x, q_pos)
 
-        ca_slot_pos = positions(b, ca_cache.capacity, shift=shift)
-        rope_k_ca = frequency_position_encoding(ca_slot_pos, self.rotated_channels)
-
-        sa_eff = sa_cache[0].length + n_x
-        sa_slot_pos = positions(b, sa_cache[0].capacity, shift=shift, offset=n_total - sa_eff)
-        rope_k_sa = frequency_position_encoding(sa_slot_pos, self.rotated_channels)
-
         x_prefix = jnp.zeros((b, 0, x_emb.shape[-1]), dtype=x_emb.dtype)
 
         ca_out = self.cross_attention(
-            x_emb, None, x_prefix, pad_mask, frq_q, rope_k_ca, ca_cache, deterministic
+            x_emb, None, x_prefix, pad_mask, frq_q, frq_q, ca_cache, deterministic
         )
         sa_out = self.self_attention(
-            ca_out.last_hidden_state, sa_pad_mask, frq_q, rope_k_sa, sa_cache, deterministic
+            ca_out.last_hidden_state, sa_pad_mask, frq_q, frq_q, sa_cache, deterministic
         )
         new_cache = (ca_out.kv_cache,) + tuple(sa_out.kv_cache)
         return BlockOutput(last_hidden_state=sa_out.last_hidden_state, kv_cache=new_cache)

@@ -99,11 +99,16 @@ def test_self_attn_cache(self_attn):
     hidden_ref, cache_ref = out_ref.last_hidden_state, out_ref.kv_cache
 
     # incremental: one latent at a time against the fixed-capacity cache
+    # (rope_k covers the newly appended token — keys rotate at write)
     cache = make_sa_cache(NUM_LATENTS)
     hidden = []
     for i in range(NUM_LATENTS):
         out = block.apply(
-            params, x[:, i : i + 1], rope_q=enc[:, i : i + 1], rope_k=enc, kv_cache=cache
+            params,
+            x[:, i : i + 1],
+            rope_q=enc[:, i : i + 1],
+            rope_k=enc[:, i : i + 1],
+            kv_cache=cache,
         )
         hidden.append(out.last_hidden_state)
         cache = out.kv_cache
@@ -143,17 +148,23 @@ def test_cross_attn_cache(cross_attn):
     hidden_ref, cache_ref = out_ref.last_hidden_state, out_ref.kv_cache
 
     # incremental: prefix + first latent, then one latent at a time
+    # (rope_k covers exactly the tokens appended by each call)
     cache = empty_cache()
     hidden = []
     empty_prefix = jnp.zeros((BATCH_SIZE, 0, NUM_CHANNELS))
     for i in range(NUM_LATENTS):
+        rope_k = (
+            enc[:, : NUM_PREFIX + 1]
+            if i == 0
+            else enc[:, NUM_PREFIX + i : NUM_PREFIX + i + 1]
+        )
         out = layer.apply(
             params,
             x_q[:, i : i + 1],
             x_kv_prefix=x_kv_prefix if i == 0 else empty_prefix,
             pad_mask=pad_mask,
             rope_q=enc[:, NUM_PREFIX + i : NUM_PREFIX + i + 1],
-            rope_k=enc,
+            rope_k=rope_k,
             kv_cache=cache,
         )
         hidden.append(out.last_hidden_state)

@@ -278,9 +278,9 @@ class TestActivationCheckpointing:
         def loss(model, p):
             return model.apply(p, ids, prefix_len=24).logits.astype(jnp.float32).mean()
 
-        ref, ref_g = jax.value_and_grad(lambda p: loss(base, p))(params)
+        ref, ref_g = jax.jit(jax.value_and_grad(lambda p: loss(base, p)))(params)
         out, out_g = jax.jit(jax.value_and_grad(lambda p: loss(wrapped, p)))(params)
-        assert float(out) == pytest.approx(float(ref), abs=1e-8)
+        assert float(out) == pytest.approx(float(ref), abs=1e-6)
         for a, b in zip(jax.tree.leaves(out_g), jax.tree.leaves(ref_g)):
             assert jnp.allclose(a, b, atol=1e-6)
 
