@@ -72,8 +72,20 @@ class TokenInputAdapter(nn.Module):
         if not self.abs_pos_emb:
             return self.txt_embedding(x)
         if abs_pos is None:
-            abs_pos = positions(x.shape[0], x.shape[1])
-        elif x.shape[1] < abs_pos.shape[1]:
+            # Positions are arange(n) (statically no padding): the lookup is a
+            # table *slice*, whose gradient is a pad instead of a scatter-add.
+            # The general gather path below costs ~38% of a 16k-context train
+            # step in its backward scatter alone (measured on v5e).
+            n = x.shape[1]
+            table = self.pos_embedding.embedding.astype(self.dtype)
+            pos_emb = table[: min(n, self.max_seq_len)]
+            if n > self.max_seq_len:
+                # clip parity with the gather path: positions past the table
+                # end repeat the last row
+                tail = jnp.broadcast_to(table[-1], (n - self.max_seq_len, table.shape[1]))
+                pos_emb = jnp.concatenate([pos_emb, tail], axis=0)
+            return self.txt_embedding(x) + pos_emb[None]
+        if x.shape[1] < abs_pos.shape[1]:
             abs_pos = abs_pos[:, -x.shape[1] :]
         abs_pos = jnp.clip(abs_pos, 0, self.max_seq_len - 1)
         return self.txt_embedding(x) + self.pos_embedding(abs_pos)
@@ -99,9 +111,11 @@ class TokenInputAdapterWithRotarySupport(TokenInputAdapter):
     rotated_channels_per_head: int = 0
 
     def __call__(self, x: jnp.ndarray, abs_pos: Optional[jnp.ndarray] = None):
+        # keep abs_pos=None flowing into embed(): it selects the scatter-free
+        # slice path; the frequency encoding is built from the same arange
+        embedded = self.embed(x, abs_pos)
         if abs_pos is None:
             abs_pos = positions(x.shape[0], x.shape[1])
-        embedded = self.embed(x, abs_pos)
         frq = frequency_position_encoding(abs_pos, self.rotated_channels_per_head)
         return embedded, frq
 

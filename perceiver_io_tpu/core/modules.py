@@ -747,8 +747,13 @@ class PerceiverAR(nn.Module):
         if not 0 <= prefix_len < n:
             raise ValueError(f"prefix_len ({prefix_len}) out of valid range [0..{n})")
 
-        shift = None if pad_mask is None else pad_mask.sum(axis=1, keepdims=True).astype(jnp.int32)
-        x_emb, frq = self.input_adapter(x, positions(b, n, shift=shift))
+        # pad_mask None statically means positions are arange(n) — the adapter
+        # then embeds positions via a table slice (scatter-free backward)
+        if pad_mask is None:
+            x_emb, frq = self.input_adapter(x, None)
+        else:
+            shift = pad_mask.sum(axis=1, keepdims=True).astype(jnp.int32)
+            x_emb, frq = self.input_adapter(x, positions(b, n, shift=shift))
 
         x_latent, x_prefix = x_emb[:, prefix_len:], x_emb[:, :prefix_len]
         frq_latent, frq_prefix = frq[:, prefix_len:], frq[:, :prefix_len]

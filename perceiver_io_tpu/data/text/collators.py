@@ -54,7 +54,7 @@ class RandomTruncateCollator:
             return batch
         drop = int(self.rng.integers(1, seq_len - self.min_seq_len + 1))
         for key in ("labels", "input_ids", "pad_mask"):
-            if key in batch:
+            if batch.get(key) is not None:  # pad_mask is None for pad-free batches
                 batch[key] = batch[key][:, :-drop]
         return batch
 

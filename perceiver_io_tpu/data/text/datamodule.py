@@ -86,10 +86,15 @@ class _ClmCollator:
             else:
                 ids[r, : len(seq)] = seq
                 mask[r, : len(seq)] = False
+        pad_mask = mask[:, :-1]
         return {
             "labels": ids[:, 1:],
             "input_ids": ids[:, :-1],
-            "pad_mask": mask[:, :-1],
+            # a pad-free batch (every window full — the common case for
+            # chunked/packed text) reports pad_mask None: the model then takes
+            # the scatter-free position-embedding path (see adapter.embed).
+            # Mixed pipelines alternate two jit specializations at worst.
+            "pad_mask": pad_mask if pad_mask.any() else None,
         }
 
 

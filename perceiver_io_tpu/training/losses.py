@@ -76,11 +76,16 @@ def clm_loss_fn(apply_fn, max_latents: int, deterministic: bool = False) -> Call
     shift."""
 
     def loss_fn(params, batch, rng, deterministic: bool = deterministic) -> Tuple[jnp.ndarray, Dict]:
-        labels, x, pad_mask = batch["labels"], batch["input_ids"], batch["pad_mask"]
+        labels, x = batch["labels"], batch["input_ids"]
+        # the key is required (a pipeline dropping it should fail loudly) but
+        # the value may be None: static no-padding knowledge that selects the
+        # scatter-free position-embedding path (see adapter.embed)
+        pad_mask = batch["pad_mask"]
         seq_len = x.shape[1]
         if seq_len < max_latents:
             raise ValueError(f"Training sequence length must be at least {max_latents} (= max_latents)")
-        labels = jnp.where(pad_mask, IGNORE_INDEX, labels)
+        if pad_mask is not None:
+            labels = jnp.where(pad_mask, IGNORE_INDEX, labels)
         kwargs = {} if deterministic else {"rngs": {"dropout": rng}}
         out = apply_fn(
             params,

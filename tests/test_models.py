@@ -3,6 +3,7 @@
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import pytest
 
 from perceiver_io_tpu.core.config import ClassificationDecoderConfig
@@ -309,3 +310,24 @@ class TestActivationCheckpointing:
 
         g = jax.jit(jax.grad(loss))(params)
         assert all(jnp.all(jnp.isfinite(le)) for le in jax.tree.leaves(g))
+
+
+def test_pos_embedding_slice_path_matches_gather():
+    """The scatter-free (abs_pos=None) embedding path must equal the explicit
+    arange gather path, including clip behavior past max_seq_len."""
+    from perceiver_io_tpu.core.adapter import TokenInputAdapter
+    from perceiver_io_tpu.core.position import positions
+
+    adapter = TokenInputAdapter(vocab_size=50, max_seq_len=12, num_input_channels=16)
+    x = jnp.asarray(np.random.default_rng(0).integers(0, 50, size=(2, 12)))
+    params = adapter.init(jax.random.PRNGKey(0), x)
+
+    fast = adapter.apply(params, x)  # abs_pos=None
+    ref = adapter.apply(params, x, positions(2, 12))
+    np.testing.assert_allclose(np.asarray(fast), np.asarray(ref), atol=1e-7)
+
+    # longer than the table: positions clip to the last row on both paths
+    x_long = jnp.asarray(np.random.default_rng(1).integers(0, 50, size=(2, 15)))
+    fast_long = adapter.apply(params, x_long)
+    ref_long = adapter.apply(params, x_long, positions(2, 15))
+    np.testing.assert_allclose(np.asarray(fast_long), np.asarray(ref_long), atol=1e-7)
