@@ -24,14 +24,17 @@ import numpy as np
 def robust_slope(run, n_short: int, n_long: int, estimates: int = 3, reps: int = 4) -> float:
     """Per-iteration time as the slope between two chain lengths, hardened
     against axon-tunnel jitter: short/long timings are interleaved (so clock
-    drift hits both), min-reduced per estimate, and the best (smallest) of
-    several independent slope estimates wins — a stall can only ever make a
-    run slower, never faster, so the fastest consistent estimate is the true
-    sustained rate. A single-estimate version of this measurement has been
-    observed 20x off during a multi-second tunnel stall."""
+    drift hits both), min-reduced per estimate, and the **median** of several
+    independent slope estimates wins. Median, not min: a stall landing on an
+    estimate's short-chain reps inflates t_short and *deflates* that
+    estimate's slope, so taking the min would systematically select the most
+    corrupted estimate (and a negative slope would report garbage
+    throughput). Non-positive estimates are dropped outright. A
+    single-estimate version of this measurement has been observed 20x off
+    during a multi-second tunnel stall."""
     run(n_short)  # compile
     run(n_long)
-    best = float("inf")
+    slopes = []
     for _ in range(estimates):
         t_short = t_long = float("inf")
         for _ in range(reps):
@@ -41,8 +44,13 @@ def robust_slope(run, n_short: int, n_long: int, estimates: int = 3, reps: int =
             t0 = time.perf_counter()
             run(n_long)
             t_long = min(t_long, time.perf_counter() - t0)
-        best = min(best, (t_long - t_short) / (n_long - n_short))
-    return max(best, 1e-9)
+        s = (t_long - t_short) / (n_long - n_short)
+        if s > 0:
+            slopes.append(s)
+    if not slopes:
+        return 1e-9
+    slopes.sort()
+    return slopes[len(slopes) // 2]
 
 
 def flagship_config(seq_len: int, latents: int, remat: bool = False):

@@ -68,12 +68,27 @@ class _ListDataset:
 
 class _ClmCollator:
     """Window of max_seq_len+1 -> shifted (labels, input_ids, pad_mask)
-    (reference: CLMDataset shift-by-1 + C4Collator)."""
+    (reference: CLMDataset shift-by-1 + C4Collator).
 
-    def __init__(self, pad_id: int, window: int, padding_side: str = "left"):
+    ``report_pad_free`` controls whether a batch with no padding reports
+    ``pad_mask`` as None — the static signal that selects the scatter-free
+    position-embedding path in the model (see adapter.embed). Default True
+    (per-batch detection) is right for single-host training; **multi-host
+    SPMD must pass False** (or guarantee pad-free data): the batch pytree
+    structure must be identical on every host for the traced programs to
+    match, and per-host detection can diverge on the stream tail."""
+
+    def __init__(
+        self,
+        pad_id: int,
+        window: int,
+        padding_side: str = "left",
+        report_pad_free: bool = True,
+    ):
         self.pad_id = pad_id
         self.window = window
         self.padding_side = padding_side
+        self.report_pad_free = report_pad_free
 
     def __call__(self, examples: Sequence[Dict]) -> Dict[str, np.ndarray]:
         ids = np.full((len(examples), self.window), self.pad_id, dtype=np.int32)
@@ -87,14 +102,12 @@ class _ClmCollator:
                 ids[r, : len(seq)] = seq
                 mask[r, : len(seq)] = False
         pad_mask = mask[:, :-1]
+        if self.report_pad_free and not pad_mask.any():
+            pad_mask = None  # pad-free: scatter-free embedding path
         return {
             "labels": ids[:, 1:],
             "input_ids": ids[:, :-1],
-            # a pad-free batch (every window full — the common case for
-            # chunked/packed text) reports pad_mask None: the model then takes
-            # the scatter-free position-embedding path (see adapter.embed).
-            # Mixed pipelines alternate two jit specializations at worst.
-            "pad_mask": pad_mask if pad_mask.any() else None,
+            "pad_mask": pad_mask,
         }
 
 
@@ -126,6 +139,7 @@ class TextDataModule:
         train_texts: Optional[Sequence] = None,
         valid_texts: Optional[Sequence] = None,
         seed: int = 0,
+        report_pad_free: bool = True,
     ):
         if task not in TASKS:
             raise ValueError(f"task must be one of {TASKS}")
@@ -149,6 +163,8 @@ class TextDataModule:
         self._train_texts = train_texts
         self._valid_texts = valid_texts
         self.seed = seed
+        # multi-host SPMD must pass False (see _ClmCollator.report_pad_free)
+        self.report_pad_free = report_pad_free
         self._prepared: Optional[Dict] = None
 
     # ------------------------------------------------------------------ hooks
@@ -282,7 +298,10 @@ class TextDataModule:
                 seed=seed,
             )
             collate = _ClmCollator(
-                self.tokenizer.pad_token_id, self.max_seq_len + 1, self.padding_side
+                self.tokenizer.pad_token_id,
+                self.max_seq_len + 1,
+                self.padding_side,
+                report_pad_free=self.report_pad_free,
             )
             if train and self.random_min_seq_len is not None:
                 collate = RandomTruncateCollator(collate, self.random_min_seq_len, seed=seed)

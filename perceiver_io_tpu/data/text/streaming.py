@@ -61,6 +61,7 @@ class StreamingTextDataModule:
         shuffle_window_seed: int = 0,
         padding_side: str = "left",
         shard_for_processes: bool = True,
+        report_pad_free: Optional[bool] = None,
     ):
         if min_seq_len is not None and not 0 < min_seq_len < max_seq_len:
             raise ValueError("min_seq_len must satisfy 0 < min_seq_len < max_seq_len")
@@ -73,6 +74,10 @@ class StreamingTextDataModule:
         self.shuffle_window_seed = shuffle_window_seed
         self.padding_side = padding_side
         self.shard_for_processes = shard_for_processes
+        # None = auto: per-batch pad-free detection (scatter-free embedding
+        # path) on a single host; disabled under multi-host SPMD, where every
+        # host must build the identical batch pytree structure
+        self.report_pad_free = report_pad_free
 
     @property
     def vocab_size(self) -> int:
@@ -104,7 +109,17 @@ class StreamingTextDataModule:
     def batches(self, train: bool = True) -> Iterator[Dict[str, np.ndarray]]:
         """Yield shifted {labels, input_ids, pad_mask} batches indefinitely
         (bounded by the underlying stream)."""
-        collate = _ClmCollator(self.tokenizer.pad_token_id, self.max_seq_len + 1, self.padding_side)
+        report_pad_free = self.report_pad_free
+        if report_pad_free is None:
+            import jax
+
+            report_pad_free = jax.process_count() == 1
+        collate = _ClmCollator(
+            self.tokenizer.pad_token_id,
+            self.max_seq_len + 1,
+            self.padding_side,
+            report_pad_free=report_pad_free,
+        )
         chunks = self._chunks(randomize_len=train)
         while True:
             batch = list(itertools.islice(chunks, self.batch_size))

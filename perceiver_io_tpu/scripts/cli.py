@@ -122,7 +122,7 @@ class TrainerArgs:
     precision: str = "float32"  # float32 | bfloat16 (params stay f32)
     gradient_clip_val: Optional[float] = None
     accumulate_grad_batches: int = 1
-    strategy: str = "dp"  # dp (DDP parity) | fsdp (ZeRO parity) | tp | fsdp_tp (tensor parallel)
+    strategy: str = "dp"  # dp (DDP parity) | fsdp (ZeRO parity) | tp | fsdp_tp | seq (context parallel)
     fsdp_min_weight_size: int = 2**14
     devices: int = -1  # -1 = all visible
     seed: int = 0
@@ -257,7 +257,12 @@ def make_mesh_for(trainer: TrainerArgs):
         n = len(devices)
         tensor = 2 if n % 2 == 0 else 1
         return make_mesh(data=1, fsdp=n // tensor, tensor=tensor, devices=devices)
-    raise ValueError(f"unknown strategy: {trainer.strategy} (expected dp|fsdp|tp|fsdp_tp)")
+    if trainer.strategy == "seq":
+        # sequence/context parallelism: the batch's token dim is sharded over
+        # the seq axis (beyond reference parity — SURVEY §2.7 P8); the
+        # sequence length must be divisible by the device count
+        return make_mesh(data=1, seq=len(devices), devices=devices)
+    raise ValueError(f"unknown strategy: {trainer.strategy} (expected dp|fsdp|tp|fsdp_tp|seq)")
 
 
 def make_lr_schedule(opt: OptimizerArgs, max_steps: int):

@@ -19,7 +19,7 @@ from typing import Callable, Dict, Iterable, Optional, Sequence
 import jax
 import numpy as np
 
-from perceiver_io_tpu.parallel.mesh import shard_batch
+from perceiver_io_tpu.parallel.mesh import AXIS_SEQ, shard_batch
 from perceiver_io_tpu.training.checkpoint import CheckpointManager
 from perceiver_io_tpu.training.loop import make_train_step, shard_train_state
 from perceiver_io_tpu.training.metrics import MetricsLogger
@@ -65,6 +65,11 @@ class Trainer:
     ):
         self.config = config or TrainerConfig()
         self.mesh = mesh
+        # a non-trivial seq axis also shards the token dim of every batch
+        # (sequence/context parallelism); decided once — the mesh is fixed
+        self._batch_seq_dim = (
+            1 if mesh is not None and mesh.shape.get(AXIS_SEQ, 1) > 1 else None
+        )
         self.logger = logger
         self.lr_schedule = lr_schedule
         self.callbacks = list(callbacks)
@@ -100,7 +105,7 @@ class Trainer:
 
     def _prepare_batch(self, batch):
         if self.mesh is not None:
-            return shard_batch(batch, self.mesh)
+            return shard_batch(batch, self.mesh, seq_dim=self._batch_seq_dim)
         return batch
 
     def _log(self, step: int, metrics: Dict[str, float]) -> None:

@@ -135,3 +135,22 @@ def test_tensor_fsdp_combined_shardings():
         if "q_proj" in "/".join(str(k.key) for k in path) and "kernel" in str(path[-1])
     ]
     assert combined and all("tensor" in c and "fsdp" in c for c in combined)
+
+
+def test_trainer_seq_strategy_fits():
+    """The 'seq' CLI strategy end-to-end: Trainer shards the token dim over
+    the seq axis and trains."""
+    from perceiver_io_tpu.scripts.cli import TrainerArgs, make_mesh_for
+    from perceiver_io_tpu.training.trainer import Trainer, TrainerConfig
+
+    model, state, batch, _ = build()
+    mesh = make_mesh_for(TrainerArgs(strategy="seq", devices=4))
+    assert dict(mesh.shape)["seq"] == 4
+
+    trainer = Trainer(
+        clm_loss_fn(model.apply, max_latents=16, deterministic=True),
+        mesh=mesh,
+        config=TrainerConfig(max_steps=3, log_interval=10),
+    )
+    out_state = trainer.fit(state, iter(lambda: dict(batch), None))
+    assert int(out_state.step) == 3
