@@ -48,9 +48,13 @@ def robust_slope(run, n_short: int, n_long: int, estimates: int = 3, reps: int =
         if s > 0:
             slopes.append(s)
     if not slopes:
-        return 1e-9
+        raise RuntimeError(
+            "every slope estimate was non-positive — the measurement is "
+            "unusable (sustained tunnel stall?); rerun the benchmark"
+        )
     slopes.sort()
-    return slopes[len(slopes) // 2]
+    n = len(slopes)
+    return (slopes[(n - 1) // 2] + slopes[n // 2]) / 2
 
 
 def flagship_config(seq_len: int, latents: int, remat: bool = False):

@@ -139,7 +139,7 @@ class TextDataModule:
         train_texts: Optional[Sequence] = None,
         valid_texts: Optional[Sequence] = None,
         seed: int = 0,
-        report_pad_free: bool = True,
+        report_pad_free: Optional[bool] = None,
     ):
         if task not in TASKS:
             raise ValueError(f"task must be one of {TASKS}")
@@ -163,7 +163,8 @@ class TextDataModule:
         self._train_texts = train_texts
         self._valid_texts = valid_texts
         self.seed = seed
-        # multi-host SPMD must pass False (see _ClmCollator.report_pad_free)
+        # None = auto: pad-free detection on a single host, disabled under
+        # multi-host SPMD (see _ClmCollator.report_pad_free)
         self.report_pad_free = report_pad_free
         self._prepared: Optional[Dict] = None
 
@@ -297,11 +298,16 @@ class TextDataModule:
                 random_shift=train and self.random_train_shift,
                 seed=seed,
             )
+            report_pad_free = self.report_pad_free
+            if report_pad_free is None:
+                import jax
+
+                report_pad_free = jax.process_count() == 1
             collate = _ClmCollator(
                 self.tokenizer.pad_token_id,
                 self.max_seq_len + 1,
                 self.padding_side,
-                report_pad_free=self.report_pad_free,
+                report_pad_free=report_pad_free,
             )
             if train and self.random_min_seq_len is not None:
                 collate = RandomTruncateCollator(collate, self.random_min_seq_len, seed=seed)

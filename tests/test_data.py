@@ -295,3 +295,30 @@ def test_batches_drop_last_and_shuffle():
     batches2 = list(b)  # epoch advances -> different order
     seen_second = {tuple(x["x"].ravel()) for x in batches2}
     assert seen_first != seen_second or True  # order may coincide; just smoke
+
+
+def test_byte_tokenizer_matches_hf_perceiver_tokenizer():
+    """Cross-framework tokenizer parity (SURVEY §4 category 4, offline): our
+    self-contained ByteTokenizer must produce the exact ids of the HF
+    PerceiverTokenizer the reference trains with (UTF-8 bytes + 6 specials,
+    byte b -> b + 6)."""
+    pytest.importorskip("transformers")
+    from transformers.models.perceiver.tokenization_perceiver import PerceiverTokenizer
+
+    hf = PerceiverTokenizer()  # instantiates offline: no vocab file needed
+    ours = ByteTokenizer()
+    assert ours.vocab_size == len(hf) == 262
+
+    for text in ["Hello, Perceiver!", "naïve café — 中文 😀", "", "a\nb\tc"]:
+        hf_ids = hf(text, add_special_tokens=False)["input_ids"]
+        assert ours.encode(text) == hf_ids
+        # with specials: reference wraps [CLS] ... [SEP]
+        hf_special = hf(text, add_special_tokens=True)["input_ids"]
+        assert ours.encode(text, add_special_tokens=True) == hf_special
+        assert ours.decode(hf_ids) == hf.decode(hf_ids)
+
+    # special-token id layout parity
+    assert ours.pad_token_id == hf.pad_token_id
+    assert ours.mask_token_id == hf.mask_token_id
+    assert ours.cls_token_id == hf.cls_token_id
+    assert ours.sep_token_id == hf.sep_token_id
