@@ -227,3 +227,46 @@ def test_csm_cache(csm):
     for i in range(1 + NUM_LAYERS):
         np.testing.assert_allclose(np.asarray(cache[i].k), np.asarray(cache_ref[i].k), atol=ATOL)
         np.testing.assert_allclose(np.asarray(cache[i].v), np.asarray(cache_ref[i].v), atol=ATOL)
+
+
+def test_max_heads_parallel_matches_full(cross_attn):
+    """Head-chunked attention (reference: max_heads_parallel,
+    modules.py:142-166) must equal the all-heads computation, with and
+    without a cache (the cached path slices the slots-major head axis)."""
+    rng = np.random.default_rng(4)
+    x_q = jnp.asarray(rng.normal(size=(BATCH_SIZE, NUM_LATENTS, NUM_CHANNELS)), jnp.float32)
+    x_kv_prefix = jnp.asarray(rng.normal(size=(BATCH_SIZE, NUM_PREFIX, NUM_CHANNELS)), jnp.float32)
+
+    def layer(chunk):
+        return CrossAttentionLayer(
+            num_heads=NUM_HEADS,
+            num_q_input_channels=NUM_CHANNELS,
+            num_kv_input_channels=NUM_CHANNELS,
+            num_qk_channels=NUM_CHANNELS // 2,
+            num_v_channels=NUM_CHANNELS // 2,
+            causal_attention=True,
+            max_heads_parallel=chunk,
+        )
+
+    _, params = cross_attn  # same param structure for any chunking
+    full = layer(None).apply(params, x_q, x_kv_prefix=x_kv_prefix)
+    # chunk=3 leaves a partial final chunk (8 heads) — must also work
+    for chunk in (2, 3):
+        chunked = layer(chunk).apply(params, x_q, x_kv_prefix=x_kv_prefix)
+        np.testing.assert_allclose(
+            np.asarray(chunked.last_hidden_state), np.asarray(full.last_hidden_state), atol=ATOL
+        )
+
+    total = NUM_PREFIX + NUM_LATENTS
+    cache_full = init_kv_cache(BATCH_SIZE, total, NUM_CHANNELS // 2, NUM_CHANNELS // 2)
+    full_c = layer(None).apply(params, x_q, x_kv_prefix=x_kv_prefix, kv_cache=cache_full)
+    cache_chunk = init_kv_cache(BATCH_SIZE, total, NUM_CHANNELS // 2, NUM_CHANNELS // 2)
+    chunked_c = layer(2).apply(params, x_q, x_kv_prefix=x_kv_prefix, kv_cache=cache_chunk)
+    np.testing.assert_allclose(
+        np.asarray(chunked_c.last_hidden_state),
+        np.asarray(full_c.last_hidden_state),
+        atol=ATOL,
+    )
+    np.testing.assert_allclose(
+        np.asarray(chunked_c.last_hidden_state), np.asarray(full.last_hidden_state), atol=ATOL
+    )
