@@ -13,12 +13,31 @@ Values > 1.0 mean faster than the A100 estimate.
 from __future__ import annotations
 
 import argparse
+import functools
 import json
 import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+
+def scan_step_time(step, state, batch, steps: int) -> float:
+    """Sustained per-step time of a train step: the whole k-step chain runs
+    inside ONE jitted ``lax.scan`` (single dispatch — per-call latency through
+    the axon tunnel has multi-ms jitter) and the step time is the
+    ``robust_slope`` between two chain lengths, so fixed costs cancel."""
+
+    @functools.partial(jax.jit, static_argnums=2)
+    def run(state, batch, k):
+        def body(s, _):
+            s, metrics = step(s, batch)
+            return s, metrics["loss"]
+
+        _, losses = jax.lax.scan(body, state, None, length=k)
+        return losses[-1]
+
+    return robust_slope(lambda k: float(run(state, batch, k)), 2, 2 + steps)
 
 
 def robust_slope(run, n_short: int, n_long: int, estimates: int = 3, reps: int = 4) -> float:
@@ -96,6 +115,90 @@ def train_step_flops(config, batch_size: int, prefix_dropout_keep: float) -> flo
     return 3.0 * fwd * batch_size
 
 
+def image_bench(args):
+    """Perceiver IO image-classifier training throughput (img/sec/chip) on
+    synthetic ImageNet-shaped batches — the BASELINE.json metric's second
+    workload (paper-style Fourier encoding config, reference:
+    vision/image_classifier/backend.py + deepmind/vision-perceiver-fourier
+    geometry scaled to fit one chip)."""
+    from perceiver_io_tpu.models.vision.image_classifier import (
+        ImageClassifier,
+        ImageClassifierConfig,
+        ImageEncoderConfig,
+    )
+    from perceiver_io_tpu.core.config import ClassificationDecoderConfig
+    from perceiver_io_tpu.training import TrainState, classification_loss_fn, make_optimizer
+    from perceiver_io_tpu.training.loop import make_train_step
+
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    config = ImageClassifierConfig(
+        encoder=ImageEncoderConfig(
+            image_shape=(224, 224, 3),
+            num_frequency_bands=64,
+            num_cross_attention_heads=1,
+            num_self_attention_heads=8,
+            num_self_attention_layers_per_block=6,
+            num_self_attention_blocks=8,
+            first_self_attention_block_shared=True,
+        ),
+        decoder=ClassificationDecoderConfig(
+            num_classes=1000, num_output_query_channels=1024, num_cross_attention_heads=1
+        ),
+        num_latents=512,
+        num_latent_channels=1024,
+        activation_checkpointing=args.remat,
+    )
+    model = ImageClassifier(config, dtype=dtype)
+    b = args.batch_size
+    image_shape = config.encoder.image_shape
+    n_classes = config.decoder.num_classes
+    rng = np.random.default_rng(0)
+    batch = {
+        "image": jnp.asarray(rng.normal(size=(b,) + image_shape), jnp.float32),
+        "label": jnp.asarray(rng.integers(0, n_classes, size=(b,))),
+    }
+    params = model.init(jax.random.PRNGKey(0), batch["image"])
+    n_params = sum(p.size for p in jax.tree.leaves(params))
+    tx = make_optimizer(1e-3, gradient_clip=1.0)
+    state = TrainState.create(model.apply, params, tx, jax.random.PRNGKey(1))
+    step = make_train_step(classification_loss_fn(model.apply), jit=False)
+
+    step_time = scan_step_time(step, state, batch, args.steps)
+
+    # analytic step FLOPs (same style as train_step_flops): encoder CA over
+    # the pixel array + the weight-shared SA stack; fwd+bwd ~ 3x fwd matmuls
+    enc = config.encoder
+    lat, lc = config.num_latents, config.num_latent_channels
+    m = int(np.prod(image_shape[:-1]))
+    in_ch = image_shape[-1] + len(image_shape[:-1]) * (2 * enc.num_frequency_bands + 1)
+    qk = in_ch  # qk channels default to the adapter width
+    ca = (
+        2 * lat * lc * qk  # q proj
+        + 2 * m * in_ch * qk * 2  # k, v proj
+        + 2 * 2 * lat * m * qk  # scores + values
+        + 2 * lat * qk * lc  # out proj
+        + 2 * lat * 2 * enc.cross_attention_widening_factor * lc * lc  # mlp
+    )
+    layers = enc.num_self_attention_layers_per_block * enc.num_self_attention_blocks
+    sa = layers * (
+        2 * lat * 4 * lc * lc
+        + 2 * 2 * lat * lat * lc
+        + 2 * lat * 2 * enc.self_attention_widening_factor * lc * lc
+    )
+    flops = 3.0 * (ca + sa) * b
+    vs_baseline = round((flops / (312e12 * 0.40)) / step_time, 3)
+
+    result = {
+        "metric": f"perceiver-io img-clf train img/sec/chip "
+        f"@{image_shape[0]}x{image_shape[1]} "
+        f"({n_params/1e6:.1f}M params, {args.dtype}, batch {b})",
+        "value": round(b / step_time, 2),
+        "unit": "img/sec/chip",
+        "vs_baseline": vs_baseline,
+    }
+    print(json.dumps(result))
+
+
 def decode_bench(args):
     """KV-cache decode throughput at full 16k context (the reference's decode
     hot loop, reference: core/huggingface.py:158-185): tokens generated per
@@ -145,11 +248,13 @@ def main():
     p.add_argument("--steps", type=int, default=50)
     p.add_argument("--dtype", default="bfloat16")
     p.add_argument("--remat", action="store_true", help="activation checkpointing (needed for large seq/batch)")
-    p.add_argument("--mode", choices=["train", "decode"], default="train")
+    p.add_argument("--mode", choices=["train", "decode", "img"], default="train")
     args = p.parse_args()
 
     if args.mode == "decode":
         return decode_bench(args)
+    if args.mode == "img":
+        return image_bench(args)
 
     from perceiver_io_tpu.models.text import CausalLanguageModel
     from perceiver_io_tpu.training import TrainState, clm_loss_fn, make_optimizer
@@ -181,23 +286,7 @@ def main():
     state = TrainState.create(model.apply, params, tx, jax.random.PRNGKey(1))
     step = make_train_step(clm_loss_fn(model.apply, max_latents=args.latents), jit=False)
 
-    # NOTE: through the axon tunnel block_until_ready is a no-op, host
-    # fetches cost a fixed ~70ms round trip, and *per-step dispatch latency
-    # is variable* (measured 2-3x jitter). So the whole k-step chain runs
-    # inside ONE jitted lax.scan (single dispatch), and the step time is the
-    # slope between two chain lengths — fixed costs cancel.
-    import functools
-
-    @functools.partial(jax.jit, static_argnums=2)
-    def run(state, batch, k):
-        def body(s, _):
-            s, metrics = step(s, batch)
-            return s, metrics["loss"]
-        _, losses = jax.lax.scan(body, state, None, length=k)
-        return losses[-1]
-
-    n_short, n_long = 2, 2 + args.steps
-    step_time = robust_slope(lambda k: float(run(state, batch, k)), n_short, n_long)
+    step_time = scan_step_time(step, state, batch, args.steps)
     tokens_per_sec = b * n / step_time
 
     # analytic A100 reference: same step at 312 TFLOPS bf16, 40% MFU

@@ -460,6 +460,15 @@ def flash_attention(
     kf = _pad_to(k.reshape(b * h, nkv, d_qk), 1, block_kv)
     vf = _pad_to(v.reshape(b * h, nkv, d_v), 1, block_kv)
 
+    # zero-pad odd head dims to a tile-compatible multiple of 8: zero qk
+    # channels contribute nothing to the scores, zero v channels produce
+    # extra output channels sliced off below (e.g. the vision classifier's
+    # qk width 261 — pixel channels + Fourier bands, reference parity —
+    # would otherwise fall back to the dense O(Nq x Nkv) path)
+    qf = _pad_to(qf, 2, 8)
+    kf = _pad_to(kf, 2, 8)
+    vf = _pad_to(vf, 2, 8)
+
     # additive kv bias per (batch*head) row: padded slots + user pad mask
     nkv_p = kf.shape[1]
     bias = jnp.zeros((b, nkv_p), jnp.float32)
@@ -471,7 +480,7 @@ def flash_attention(
     bias = bias[:, None, :]
 
     out = _flash(qf, kf, vf, bias, causal, offset, sm_scale, block_q, block_kv, h)
-    return out[:, :nq].reshape(b, h, nq, d_v)
+    return out[:, :nq, :d_v].reshape(b, h, nq, d_v)
 
 
 def _round_pow2_cap(n: int) -> int:
@@ -487,11 +496,12 @@ def flash_supported(
     nq: int, nkv: int, d_qk: int, d_v: int, has_dropout: bool
 ) -> bool:
     """Whether the fused path applies: no attention-prob dropout (the einsum
-    path keeps that reference feature), head dims tile-compatible, and
+    path keeps that reference feature), head dims within the tile budget
+    (odd widths are zero-padded to a multiple of 8 by the wrapper), and
     sequences long enough to be worth a kernel launch."""
     if has_dropout:
         return False
-    if d_qk % 8 != 0 or d_v % 8 != 0 or d_qk > 512 or d_v > 512:
+    if d_qk > 512 or d_v > 512:
         return False
     return nq >= 128 and nkv >= 128
 

@@ -88,3 +88,30 @@ def test_bfloat16_forward(rng):
     np.testing.assert_allclose(
         np.asarray(out, np.float32), np.asarray(ref), atol=3e-2, rtol=3e-2
     )
+
+
+def test_odd_head_dims_match_einsum(rng):
+    """Head widths that are not multiples of 8 are zero-padded inside the
+    wrapper (the vision classifier's qk width 261 — pixels + Fourier bands —
+    takes this path); values and gradients must match the dense reference."""
+    b, h, nq, nkv, d_qk, d_v = 1, 2, 256, 384, 37, 21
+    q = jnp.asarray(rng.normal(size=(b, h, nq, d_qk)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, h, nkv, d_qk)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, h, nkv, d_v)), jnp.float32)
+
+    out = flash_attention(q, k, v, causal=True, sm_scale=d_qk**-0.5,
+                          block_q=128, block_kv=128)
+    ref = einsum_attention(q, k, v, causal=True, sm_scale=d_qk**-0.5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    def f(fn):
+        def loss(q, k, v):
+            o = fn(q, k, v)
+            return (o.astype(jnp.float32) ** 2).sum()
+        return jax.grad(loss, argnums=(0, 1, 2))
+
+    g_flash = f(lambda q, k, v: flash_attention(
+        q, k, v, causal=True, sm_scale=d_qk**-0.5, block_q=128, block_kv=128))(q, k, v)
+    g_ref = f(lambda q, k, v: einsum_attention(q, k, v, causal=True, sm_scale=d_qk**-0.5))(q, k, v)
+    for a, r in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r), atol=5e-5, rtol=5e-5)
