@@ -26,7 +26,7 @@ from perceiver_io_tpu.data.text.collators import (
     TokenMaskingCollator,
     WordMaskingCollator,
 )
-from perceiver_io_tpu.data.text.tokenizer import ByteTokenizer
+from perceiver_io_tpu.data.text.tokenizer import ByteTokenizer, encode_to_np
 
 TASKS = ("clm", "mlm", "clf")
 
@@ -256,12 +256,16 @@ class TextDataModule:
             )
 
         if self.task == "clm":
-            stream: List[int] = []
+            eos = None
+            if self.add_eos_token:
+                eos = np.asarray([self.tokenizer.eos_token_id], dtype=np.int32)
+            parts: List[np.ndarray] = []
             for t in texts:
-                stream.extend(self.tokenizer.encode(t))
-                if self.add_eos_token:
-                    stream.append(self.tokenizer.eos_token_id)
-            return {f"{split}_stream": np.asarray(stream, dtype=np.int32)}
+                parts.append(encode_to_np(self.tokenizer, t))
+                if eos is not None:
+                    parts.append(eos)
+            stream = np.concatenate(parts) if parts else np.empty((0,), np.int32)
+            return {f"{split}_stream": stream}
 
         if self.task == "mlm":
             chunks, chunk_word_ids = [], []

@@ -16,7 +16,7 @@ from typing import Callable, Dict, Iterable, Iterator, List, Optional
 import numpy as np
 
 from perceiver_io_tpu.data.text.datamodule import _ClmCollator
-from perceiver_io_tpu.data.text.tokenizer import ByteTokenizer
+from perceiver_io_tpu.data.text.tokenizer import ByteTokenizer, encode_to_np
 
 
 def shuffle_window(it: Iterable, window_size: int, seed: int = 0) -> Iterator:
@@ -96,15 +96,27 @@ class StreamingTextDataModule:
                 return rng.randint(self.min_seq_len, self.max_seq_len) + 1
             return self.max_seq_len + 1
 
-        buf: List[int] = []
+        # vectorized byte path when the tokenizer offers it; parts-list
+        # accumulation with a running length so chunk assembly concatenates
+        # once per emitted chunk, not once per document (a rolling-buffer
+        # concat per text is quadratic for many short documents)
+        eos = np.asarray([self.tokenizer.eos_token_id], dtype=np.int32)
+        parts: List[np.ndarray] = []
+        buffered = 0
         target = chunk_len()
         for text in texts:
-            buf.extend(self.tokenizer.encode(text))
-            buf.append(self.tokenizer.eos_token_id)
-            while len(buf) >= target:
-                yield np.asarray(buf[:target], dtype=np.int32)
-                buf = buf[target:]
-                target = chunk_len()
+            ids = encode_to_np(self.tokenizer, text)
+            parts.append(ids)
+            parts.append(eos)
+            buffered += len(ids) + 1
+            while buffered >= target:
+                buf = np.concatenate(parts)
+                while buffered >= target:
+                    yield buf[:target].copy()
+                    buf = buf[target:]
+                    buffered -= target
+                    target = chunk_len()
+                parts = [buf]
 
     def batches(self, train: bool = True) -> Iterator[Dict[str, np.ndarray]]:
         """Yield shifted {labels, input_ids, pad_mask} batches indefinitely

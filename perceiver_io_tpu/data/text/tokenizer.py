@@ -18,6 +18,16 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 
+def encode_to_np(tokenizer, text: str) -> np.ndarray:
+    """Encode via the tokenizer's vectorized ``encode_np`` when it has one
+    (ByteTokenizer: ~10x list encode), else through the standard ``encode``
+    protocol — the shared fast-path dispatch for chunking pipelines."""
+    encode_np = getattr(tokenizer, "encode_np", None)
+    if encode_np is not None:
+        return encode_np(text)
+    return np.asarray(tokenizer.encode(text), dtype=np.int32)
+
+
 class ByteTokenizer:
     """UTF-8 bytes + specials: [PAD]=0 [BOS]=1 [EOS]=2 [MASK]=3 [CLS]=4
     [SEP]=5, byte b -> b + 6."""
@@ -51,21 +61,32 @@ class ByteTokenizer:
         return 256 + self.num_special_tokens
 
     def encode(self, text: str, add_special_tokens: bool = False) -> List[int]:
-        ids = [b + self.num_special_tokens for b in text.encode("utf-8")]
+        # vectorized byte mapping (~10x the per-byte comprehension; tokenizer
+        # throughput is the host-side bottleneck feeding a pod — SURVEY §7.3)
+        ids = self.encode_np(text).tolist()
         if add_special_tokens:
             ids = [self.cls_token_id] + ids + [self.sep_token_id]
         return ids
+
+    def encode_np(self, text: str) -> np.ndarray:
+        """Encode to an int32 numpy array (no special tokens) — the zero-copy
+        path for streaming/chunking pipelines."""
+        raw = np.frombuffer(text.encode("utf-8"), dtype=np.uint8)
+        return raw.astype(np.int32) + self.num_special_tokens
 
     def batch_encode(self, texts: Sequence[str], add_special_tokens: bool = False) -> List[List[int]]:
         return [self.encode(t, add_special_tokens=add_special_tokens) for t in texts]
 
     def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
+        arr = np.asarray(ids, dtype=np.int64).reshape(-1)
+        if skip_special_tokens:
+            byte_vals = arr[arr >= self.num_special_tokens] - self.num_special_tokens
+            return bytes(byte_vals.astype(np.uint8)).decode("utf-8", errors="replace")
+        # slow path: special-token strings interleaved with byte runs
         out: List[bytes] = []
-        for i in ids:
-            i = int(i)
+        for i in arr.tolist():
             if i < self.num_special_tokens:
-                if not skip_special_tokens:
-                    out.append(self._special_strings[i].encode("utf-8"))
+                out.append(self._special_strings[i].encode("utf-8"))
             else:
                 out.append(bytes([i - self.num_special_tokens]))
         return b"".join(out).decode("utf-8", errors="replace")

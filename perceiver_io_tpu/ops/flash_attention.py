@@ -332,7 +332,11 @@ def _flash_fwd_impl(q, k, v, bias, causal, offset, sm_scale, block_q, block_kv, 
 
 def _flash_fwd(q, k, v, bias, causal, offset, sm_scale, block_q, block_kv, num_heads):
     out, lse = _flash_fwd_impl(q, k, v, bias, causal, offset, sm_scale, block_q, block_kv, num_heads)
-    return out, (q, k, v, bias, out, lse)
+    # the kernel emits lse broadcast across all 128 lanes (tiled loads);
+    # keep ONE lane as the residual — at 48 attention calls per step the
+    # full-lane buffers alone were ~3GB at batch 32 (measured, image
+    # classifier); the backward re-broadcasts transiently
+    return out, (q, k, v, bias, out, lse[..., :1])
 
 
 # Backward block sizes (None = same as forward). The bwd kernels have a
@@ -344,7 +348,8 @@ BWD_BLOCK_KV: Optional[int] = None
 
 
 def _flash_bwd(causal, offset, sm_scale, block_q, block_kv, num_heads, residuals, g):
-    q, k, v, bias, out, lse = residuals
+    q, k, v, bias, out, lse_col = residuals
+    lse = jnp.broadcast_to(lse_col, lse_col.shape[:2] + (LANES,))
     bh, nq, d_qk = q.shape
     nkv = k.shape[1]
     d_v = v.shape[2]
