@@ -322,3 +322,25 @@ def test_byte_tokenizer_matches_hf_perceiver_tokenizer():
     assert ours.mask_token_id == hf.mask_token_id
     assert ours.cls_token_id == hf.cls_token_id
     assert ours.sep_token_id == hf.sep_token_id
+
+
+def test_streaming_chunks_match_naive_construction():
+    """The parts-list chunk assembly must be byte-identical to the naive
+    rolling-list construction (concat docs with EOS, cut fixed windows)."""
+    tok = ByteTokenizer()
+    docs = [f"document number {i} with some text. " * (i % 7 + 1) for i in range(200)]
+    dm = StreamingTextDataModule(
+        lambda: iter(docs), max_seq_len=64, batch_size=2,
+        shuffle_window_size=1, shard_for_processes=False,
+    )
+    chunks = list(dm._chunks(randomize_len=False))
+
+    buf = []
+    for t in docs:  # shuffle window of 1 preserves order
+        buf.extend(tok.encode(t))
+        buf.append(tok.eos_token_id)
+    naive = [buf[i : i + 65] for i in range(0, len(buf) - 64, 65)]
+
+    assert len(chunks) == len(naive)
+    for c, n in zip(chunks, naive):
+        np.testing.assert_array_equal(np.asarray(c), np.asarray(n))
