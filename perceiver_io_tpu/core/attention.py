@@ -206,12 +206,14 @@ class MultiHeadAttention(nn.Module):
         if kv_cache is not None:
             # rotate-at-write (see module docstring): new keys carry their
             # absolute-position rotation into the cache; cached keys are
-            # never touched again
+            # never touched again. Rotation happens in the slots-major
+            # storage layout — (B, M, C) -> (B, M, H, D) is a bitcast, so no
+            # head transpose: a transpose here showed up as two full-buffer
+            # re-layout copies of the prompt pass in the compiled HLO.
             if rope_k is not None:
-                k_heads = apply_rotary_pos_emb(
-                    self._split_heads(k, qk_per_head), rope_k[:, None, :, :]
-                )
-                k = k_heads.transpose(0, 2, 1, 3).reshape(k.shape)
+                k4 = k.reshape(k.shape[0], k.shape[1], h, qk_per_head)
+                k4 = apply_rotary_pos_emb(k4, rope_k[:, :, None, :])
+                k = k4.reshape(k.shape)
             start = kv_cache.length
             k_slots = lax.dynamic_update_slice(kv_cache.k, k.astype(kv_cache.k.dtype), (0, start, 0))
             v_slots = lax.dynamic_update_slice(kv_cache.v, v.astype(kv_cache.v.dtype), (0, start, 0))
