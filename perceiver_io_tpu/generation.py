@@ -30,9 +30,11 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from perceiver_io_tpu.core.attention import KVCache
+from perceiver_io_tpu.utils.arrays import concrete_or_none
 
 
 @dataclass
@@ -89,9 +91,10 @@ def _require_pads_in_prefix(pad_mask, prefix_len: int) -> None:
     masked in the cross-attention only), so a pad token that becomes a latent
     would be attended. Checked eagerly on concrete masks; under jit the
     contract is documented, not checked."""
-    if pad_mask is None or isinstance(pad_mask, jax.core.Tracer):
+    pad_mask = concrete_or_none(pad_mask)
+    if pad_mask is None:
         return
-    max_pads = int(jnp.max(jnp.sum(pad_mask, axis=1)))
+    max_pads = int(np.max(np.sum(pad_mask, axis=1)))
     if max_pads > prefix_len:
         raise ValueError(
             f"left padding ({max_pads} tokens) reaches into the latent region "

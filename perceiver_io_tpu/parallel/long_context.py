@@ -23,13 +23,12 @@ Usage::
 
 from __future__ import annotations
 
-from typing import Optional
-
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from perceiver_io_tpu.parallel.mesh import AXIS_SEQ
+from perceiver_io_tpu.utils.arrays import concrete_or_none
 
 
 def _split_prompt(input_ids, pad_mask, prefix_len: int):
@@ -38,11 +37,8 @@ def _split_prompt(input_ids, pad_mask, prefix_len: int):
     prefix_pad = None if pad_mask is None else pad_mask[:, :prefix_len]
     # value check only on concrete (eager) masks — under jit/grad the mask is
     # a tracer and the contract (left padding only) is documented, not checked
-    if (
-        pad_mask is not None
-        and not isinstance(pad_mask, jax.core.Tracer)
-        and bool(jnp.any(pad_mask[:, prefix_len:]))
-    ):
+    concrete_mask = concrete_or_none(pad_mask)
+    if concrete_mask is not None and bool(concrete_mask[:, prefix_len:].any()):
         raise ValueError("padding must be confined to the (left-padded) prefix")
     return latent_ids, prefix_ids, prefix_pad
 
@@ -55,33 +51,60 @@ def make_seq_parallel_clm_forward(model, mesh: Mesh, *, prefix_len: int, axis_na
     suffix is replicated. ``pad_mask`` marks left padding (prefix only).
     """
     seq_size = mesh.shape[axis_name]
+    if prefix_len < seq_size:
+        # prefix_len=0 would pass the divisibility check below but give every
+        # device an empty prefix block, which crashes in block_attention with
+        # an obscure zero-size-axis reduction error during tracing
+        raise ValueError(
+            f"prefix_len ({prefix_len}) must be at least the '{axis_name}' "
+            f"axis size ({seq_size}) so every device gets a non-empty prefix "
+            f"block; use the dense forward for prefix-free inputs"
+        )
     if prefix_len % seq_size != 0:
         raise ValueError(f"prefix_len ({prefix_len}) must be divisible by the "
                          f"'{axis_name}' axis size ({seq_size})")
 
-    def per_device(params, latent_ids, prefix_local, prefix_pad_local=None):
+    def per_device(params, latent_ids, prefix_local, prefix_pad_local, dropout_rng):
+        rngs = None if dropout_rng is None else {"dropout": dropout_rng}
         return model.apply(
             params,
             latent_ids,
             prefix_local,
             axis_name=axis_name,
             prefix_pad_local=prefix_pad_local,
+            deterministic=dropout_rng is None,
+            rngs=rngs,
             method="seq_parallel_forward",
         )
 
     shard = P(None, axis_name)
-    with_mask = jax.jit(jax.shard_map(
-        per_device, mesh=mesh, in_specs=(P(), P(), shard, shard), out_specs=P()
-    ))
-    no_mask = jax.jit(jax.shard_map(
-        per_device, mesh=mesh, in_specs=(P(), P(), shard), out_specs=P()
-    ))
+    variants = {}
 
-    def fn(params, input_ids, pad_mask: Optional[jnp.ndarray] = None):
+    def variant(has_mask: bool, has_rng: bool):
+        """Jitted shard_map specialization for the optional-arg combination
+        (shard_map in_specs must match the positional signature exactly)."""
+        key = (has_mask, has_rng)
+        if key not in variants:
+            specs = [P(), P(), shard] + ([shard] if has_mask else []) + ([P()] if has_rng else [])
+
+            def f(params, latent_ids, prefix_local, *rest):
+                pad = rest[0] if has_mask else None
+                rng = rest[-1] if has_rng else None
+                return per_device(params, latent_ids, prefix_local, pad, rng)
+
+            variants[key] = jax.jit(
+                jax.shard_map(f, mesh=mesh, in_specs=tuple(specs), out_specs=P())
+            )
+        return variants[key]
+
+    def fn(params, input_ids, pad_mask=None, dropout_rng=None):
         latent_ids, prefix_ids, prefix_pad = _split_prompt(input_ids, pad_mask, prefix_len)
+        args = (params, latent_ids, prefix_ids)
         if prefix_pad is not None:
-            return with_mask(params, latent_ids, prefix_ids, prefix_pad)
-        return no_mask(params, latent_ids, prefix_ids)
+            args += (prefix_pad,)
+        if dropout_rng is not None:
+            args += (dropout_rng,)
+        return variant(prefix_pad is not None, dropout_rng is not None)(*args)
 
     return fn
 
@@ -95,11 +118,13 @@ def make_seq_parallel_clm_loss(model, mesh: Mesh, *, prefix_len: int, axis_name:
     ``jax.value_and_grad`` gives sequence-parallel training gradients.
 
     ``labels``: (B, L) target ids for the latent positions, -100 = ignore.
+    ``dropout_rng`` enables training mode: prefix cross-attention dropout as
+    the per-device keep-mask (see ``PerceiverAR.seq_parallel_forward``).
     """
     fwd = make_seq_parallel_clm_forward(model, mesh, prefix_len=prefix_len, axis_name=axis_name)
 
-    def loss(params, input_ids, labels, pad_mask: Optional[jnp.ndarray] = None):
-        logits = fwd(params, input_ids, pad_mask).astype(jnp.float32)
+    def loss(params, input_ids, labels, pad_mask=None, dropout_rng=None):
+        logits = fwd(params, input_ids, pad_mask, dropout_rng).astype(jnp.float32)
         logp = jax.nn.log_softmax(logits, axis=-1)
         valid = labels != -100
         tgt = jnp.where(valid, labels, 0)

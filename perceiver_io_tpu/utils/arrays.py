@@ -1,0 +1,27 @@
+"""Small array helpers shared across eager-only validation paths."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+
+
+def concrete_or_none(x) -> Optional[np.ndarray]:
+    """``np.asarray(x)`` if ``x`` holds eagerly readable values, else None.
+
+    Used by validations that only run on concrete (eager) inputs and are
+    documented no-ops under ``jit``/``grad``. Tracers refuse host conversion
+    (``ConcretizationTypeError``), which this catches without touching
+    ``jax.core`` internals directly — ``isinstance(x, jax.core.Tracer)``
+    would break when that deprecated alias is removed. Genuinely malformed
+    concrete inputs (ragged lists, wrong types) still raise, keeping the
+    callers' eager checks alive for them.
+    """
+    if x is None:
+        return None
+    try:
+        return np.asarray(x)
+    except (jax.errors.ConcretizationTypeError, jax.errors.TracerArrayConversionError):
+        return None
