@@ -848,8 +848,18 @@ class PerceiverAR(nn.Module):
         for the token-level entry): ``x_latent``/``frq_latent`` (B, L, C)/(B, L, R)
         replicated, ``x_prefix_local``/``frq_prefix_local`` the per-device
         prefix block, ``prefix_pad_local`` (B, P_local) True at padding.
-        Prefix cross-attention dropout is a training regularizer of the dense
-        path; here it must be off (``deterministic=True``).
+
+        Training (``deterministic=False``) supports the reference's prefix
+        cross-attention dropout (default 0.5, reference: modules.py:809-830)
+        as a **keep-mask**: every device draws the dense path's exact keep
+        set from the replicated ``'dropout'`` rng (same ``make_rng`` fold,
+        same ``top_k`` draw over the global prefix) and masks its local
+        block's dropped positions — masked softmax over the kept set is
+        numerically the dense path's gathered softmax (SURVEY §7.3:
+        masking, not gather). Post-attention/residual dropout stay
+        unsupported here (the hand-wired cross-attention block applies
+        none, so enabling them only in the SA stack would silently diverge
+        from the dense path).
         """
         from perceiver_io_tpu.ops.online_softmax import (
             NEG_INF,
@@ -859,17 +869,12 @@ class PerceiverAR(nn.Module):
         )
 
         if not deterministic and (
-            self.cross_attention_dropout > 0.0
-            or self.post_attention_dropout > 0.0
-            or self.residual_dropout > 0.0
+            self.post_attention_dropout > 0.0 or self.residual_dropout > 0.0
         ):
-            # the hand-wired cross-attention block below applies no dropout,
-            # so allowing it only in the SA stack would silently diverge from
-            # the dense path — reject any active dropout
             raise ValueError(
-                "dropout is not supported on the sequence-parallel path; set "
-                "cross_attention_dropout/post_attention_dropout/residual_dropout "
-                "to 0 or pass deterministic=True"
+                "post-attention/residual dropout is not supported on the "
+                "sequence-parallel path; set post_attention_dropout/"
+                "residual_dropout to 0 or pass deterministic=True"
             )
 
         ca_layer = self.cross_attention
@@ -886,11 +891,25 @@ class PerceiverAR(nn.Module):
         k_l, v_l = mha.project_kv(q_in, rope_k=frq_latent)
 
         # per-device prefix partial; all prefix positions precede all latents,
-        # so only the pad mask applies
+        # so only the pad mask (and the training keep-mask) applies
         p_local = x_prefix_local.shape[1]
         masked_p = jnp.zeros((1, 1, 1, p_local), bool)
         if prefix_pad_local is not None:
             masked_p = masked_p | prefix_pad_local[:, None, None, :]
+        if not deterministic and self.cross_attention_dropout > 0.0 and p_local > 0:
+            # the dense path's static-count keep set (see _forward), drawn
+            # identically on every device from the replicated rng, then
+            # sliced to this device's block
+            b = x_latent.shape[0]
+            p_total = p_local * lax.axis_size(axis_name)
+            keep = p_total - int(p_total * self.cross_attention_dropout)
+            rand = jax.random.uniform(self.make_rng("dropout"), (b, p_total))
+            _, keep_idx = lax.top_k(rand, keep)
+            keep_mask = jnp.zeros((b, p_total), bool)
+            keep_mask = keep_mask.at[jnp.arange(b)[:, None], keep_idx].set(True)
+            start = lax.axis_index(axis_name) * p_local
+            keep_local = lax.dynamic_slice_in_dim(keep_mask, start, p_local, axis=1)
+            masked_p = masked_p | ~keep_local[:, None, None, :]
         o_p, m_p, l_p = block_attention(q, k_p, v_p, masked_p)
 
         # LSE-combine the prefix partials across the axis: O(L) communication

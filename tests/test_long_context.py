@@ -125,7 +125,61 @@ def test_seq_parallel_rejects_window_violations(setup):
         fwd2(params, input_ids)
 
 
-def test_seq_parallel_rejects_active_dropout():
+def test_seq_parallel_prefix_dropout_step_matches_dense():
+    """Sharded-dropout training step ≡ dense-dropout step under a fixed key:
+    the keep-mask path draws the dense path's exact static-count keep set
+    (same make_rng fold, same top_k) and masks instead of gathering
+    (reference regularizer: perceiver/model/core/modules.py:809-830,
+    default 0.5)."""
+    from perceiver_io_tpu.training import clm_loss_fn
+
+    config = CausalLanguageModelConfig(
+        vocab_size=VOCAB,
+        max_seq_len=SEQ_LEN,
+        max_latents=LATENTS,
+        num_channels=32,
+        num_heads=4,
+        num_self_attention_layers=2,
+        cross_attention_dropout=0.5,
+    )
+    model = CausalLanguageModel(config)
+    rng = np.random.default_rng(11)
+    input_ids = jnp.asarray(rng.integers(0, VOCAB, size=(2, SEQ_LEN)))
+    labels = jnp.asarray(rng.integers(0, VOCAB, size=(2, LATENTS)))
+    params = model.init(jax.random.PRNGKey(0), input_ids, prefix_len=PREFIX)
+    mesh = make_mesh(data=1, seq=4, devices=jax.devices()[:4])
+    key = jax.random.PRNGKey(42)
+
+    # forward: identical keep set -> identical latent logits
+    fwd = make_seq_parallel_clm_forward(model, mesh, prefix_len=PREFIX)
+    out = fwd(params, input_ids, dropout_rng=key)
+    ref = model.apply(
+        params, input_ids, prefix_len=PREFIX, deterministic=False, rngs={"dropout": key}
+    ).logits
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    # full training-step gradients against the dense clm loss, same key
+    dense_loss = clm_loss_fn(model.apply, max_latents=LATENTS)
+    full_labels = jnp.concatenate(
+        [jnp.full((2, PREFIX), -100, labels.dtype), labels], axis=1
+    )
+    batch = {"labels": full_labels, "input_ids": input_ids, "pad_mask": None}
+
+    def dense(p):
+        loss, _ = dense_loss(p, batch, key)
+        return loss
+
+    ref_loss, ref_grads = jax.value_and_grad(dense)(params)
+    sp_loss = make_seq_parallel_clm_loss(model, mesh, prefix_len=PREFIX)
+    out_loss, out_grads = jax.jit(jax.value_and_grad(sp_loss))(
+        params, input_ids, labels, None, key
+    )
+    np.testing.assert_allclose(float(out_loss), float(ref_loss), rtol=2e-5)
+    for a, b in zip(jax.tree.leaves(out_grads), jax.tree.leaves(ref_grads)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
+
+
+def test_seq_parallel_rejects_post_attention_dropout():
     config = CausalLanguageModelConfig(
         vocab_size=VOCAB,
         max_seq_len=SEQ_LEN,
@@ -133,7 +187,7 @@ def test_seq_parallel_rejects_active_dropout():
         num_channels=32,
         num_heads=4,
         num_self_attention_layers=1,
-        cross_attention_dropout=0.5,
+        post_attention_dropout=0.5,
     )
     model = CausalLanguageModel(config)
     ids = jnp.zeros((1, SEQ_LEN), jnp.int32)
