@@ -1,14 +1,24 @@
 """Model conversion CLI (reference: examples/convert.py:14-89): converts the
-official DeepMind Hugging Face Perceiver models into this framework's
-``save_pretrained`` artifacts, usable by ``perceiver_io_tpu.hf.pipeline``.
+official DeepMind Hugging Face Perceiver models AND the reference's published
+Lightning training checkpoints into this framework's ``save_pretrained``
+artifacts, usable by ``perceiver_io_tpu.hf.pipeline``.
 
-Downloading the source models needs network access to the HF hub; converting
-an already-downloaded model works offline (pass a local path as the repo id).
+Official models (need the HF hub, or a pre-downloaded local path as repo id):
 
     python examples/convert.py language-perceiver --save-dir artifacts/mlm
     python examples/convert.py vision-perceiver-fourier --save-dir artifacts/img
     python examples/convert.py optical-flow-perceiver --save-dir artifacts/flow
     python examples/convert.py all --save-dir artifacts
+
+Training checkpoints (reference: examples/convert.py:38-66 — the
+``training-checkpoints`` group; download the ``.ckpt`` files from
+martin-krasser.com/perceiver/logs-0.8.0/ first, conversion itself is offline):
+
+    python examples/convert.py training-checkpoint \\
+        --kind clm --ckpt epoch=000-val_loss=2.820.ckpt --save-dir artifacts/clm-base
+    python examples/convert.py training-checkpoint \\
+        --kind mlm --ckpt epoch=012-val_loss=1.165.ckpt --save-dir artifacts/mlm-imdb
+    # kinds: clm, mlm, txt_clf, img_clf, sam
 """
 
 from __future__ import annotations
@@ -60,12 +70,41 @@ CONVERTERS = {
 }
 
 
+def convert_training_checkpoint(kind: str, ckpt: str, save_dir: str):
+    """Reference Lightning ``.ckpt`` -> ``save_pretrained`` artifact
+    (reference: examples/convert.py:38-66; importer:
+    perceiver_io_tpu/hf/lightning_ckpt.py)."""
+    from perceiver_io_tpu import hf
+    from perceiver_io_tpu.training.checkpoint import save_pretrained
+
+    importers = {
+        "clm": hf.import_clm_checkpoint,
+        "mlm": hf.import_mlm_checkpoint,
+        "txt_clf": hf.import_text_classifier_checkpoint,
+        "img_clf": hf.import_image_classifier_checkpoint,
+        "sam": hf.import_symbolic_audio_checkpoint,
+    }
+    config, variables = importers[kind](ckpt)
+    save_pretrained(save_dir, variables, config=config)
+    return config
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__)
-    parser.add_argument("model", choices=[*CONVERTERS, "all"])
+    parser.add_argument("model", choices=[*CONVERTERS, "all", "training-checkpoint"])
     parser.add_argument("--save-dir", required=True)
     parser.add_argument("--repo-id", default=None, help="override source repo id or local path")
+    parser.add_argument("--kind", choices=["clm", "mlm", "txt_clf", "img_clf", "sam"],
+                        help="training-checkpoint model family")
+    parser.add_argument("--ckpt", default=None, help="path to the Lightning .ckpt file")
     args = parser.parse_args(argv)
+
+    if args.model == "training-checkpoint":
+        if not args.kind or not args.ckpt:
+            parser.error("training-checkpoint requires --kind and --ckpt")
+        config = convert_training_checkpoint(args.kind, args.ckpt, args.save_dir)
+        print(f"converted {args.kind} checkpoint -> {args.save_dir} ({type(config).__name__})")
+        return
 
     names = list(CONVERTERS) if args.model == "all" else [args.model]
     for name in names:

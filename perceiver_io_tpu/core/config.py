@@ -18,8 +18,18 @@ def _base_kwargs(config, base_class, exclude):
     return {k: v for k, v in asdict(config).items() if k in base_field_names}
 
 
+class _CreateMixin:
+    """``create(**kwargs)`` ignoring unknown keys — the reference's lenient
+    constructor used when rebuilding configs from serialized/hyper-parameter
+    dicts (reference: perceiver/model/core/config.py create)."""
+
+    @classmethod
+    def create(cls, **kwargs):
+        return cls(**{f.name: kwargs[f.name] for f in fields(cls) if f.name in kwargs})
+
+
 @dataclass
-class EncoderConfig:
+class EncoderConfig(_CreateMixin):
     num_cross_attention_heads: int = 8
     num_cross_attention_qk_channels: Optional[int] = None
     num_cross_attention_v_channels: Optional[int] = None
@@ -42,7 +52,7 @@ class EncoderConfig:
 
 
 @dataclass
-class DecoderConfig:
+class DecoderConfig(_CreateMixin):
     num_cross_attention_heads: int = 8
     num_cross_attention_qk_channels: Optional[int] = None
     num_cross_attention_v_channels: Optional[int] = None
@@ -78,7 +88,7 @@ class PerceiverIOConfig(Generic[E, D]):
 
 
 @dataclass
-class PerceiverARConfig:
+class PerceiverARConfig(_CreateMixin):
     num_heads: int = 8
     max_heads_parallel: Optional[int] = None
     num_self_attention_layers: int = 8
@@ -105,7 +115,3 @@ class CausalSequenceModelConfig(PerceiverARConfig):
     output_bias: bool = True
     abs_pos_emb: bool = True
     init_scale: float = 0.02
-
-    @classmethod
-    def create(cls, **kwargs):
-        return cls(**{f.name: kwargs[f.name] for f in fields(cls) if f.name in kwargs})

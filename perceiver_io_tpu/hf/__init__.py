@@ -7,6 +7,16 @@ from perceiver_io_tpu.hf.convert import (  # noqa: F401
     convert_optical_flow,
     convert_optical_flow_config,
 )
+from perceiver_io_tpu.hf.lightning_ckpt import (  # noqa: F401
+    export_causal_sequence_model_state_dict,
+    import_clm_checkpoint,
+    import_image_classifier_checkpoint,
+    import_mlm_checkpoint,
+    import_symbolic_audio_checkpoint,
+    import_text_classifier_checkpoint,
+    load_lightning_checkpoint,
+    save_lightning_checkpoint,
+)
 from perceiver_io_tpu.hf.mask_filler import MaskFiller  # noqa: F401
 from perceiver_io_tpu.hf.pipelines import (  # noqa: F401
     FillMaskPipeline,
@@ -27,6 +37,14 @@ __all__ = [
     "convert_mlm_config",
     "convert_optical_flow",
     "convert_optical_flow_config",
+    "export_causal_sequence_model_state_dict",
+    "import_clm_checkpoint",
+    "import_image_classifier_checkpoint",
+    "import_mlm_checkpoint",
+    "import_symbolic_audio_checkpoint",
+    "import_text_classifier_checkpoint",
+    "load_lightning_checkpoint",
+    "save_lightning_checkpoint",
     "MaskFiller",
     "FillMaskPipeline",
     "ImageClassificationPipeline",
