@@ -374,7 +374,9 @@ def import_mlm_checkpoint(ckpt_or_path) -> Tuple[Any, Dict[str, Any]]:
     }
     untied = "1.output_adapter.linear.weight" in sd
     if untied:
-        params["decoder"]["output_adapter"] = {"linear": _linear(sd, "1.output_adapter.linear")}
+        # the output adapter is bound on the model itself (shared into the
+        # decoder), so its params live at the top level (models/text/mlm.py:69)
+        params["output_adapter"] = {"linear": _linear(sd, "1.output_adapter.linear")}
     elif "1.output_adapter.bias" in sd:
         params["output_adapter"] = {"bias": _np(sd["1.output_adapter.bias"])}
     _check_all_consumed(sd)

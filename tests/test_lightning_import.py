@@ -253,6 +253,35 @@ def test_import_mlm_checkpoint_tied(tmp_path):
     assert logits.shape == (2, 8, V)
 
 
+def test_import_mlm_checkpoint_untied(tmp_path):
+    from perceiver_io_tpu.models.text.mlm import MaskedLanguageModel
+
+    qc = C  # independent (untied) output head; query width == latent width
+    sd = encoder_state_dict()
+    sd.update(_cross_attn_layer("1.cross_attn", C))
+    sd["1.output_query_provider._query"] = t(SEQ, qc)
+    sd.update(_linear("1.output_adapter.linear", qc, V))
+    path = tmp_path / "mlm_untied.ckpt"
+    torch.save(
+        as_ckpt(
+            sd,
+            perceiver_io_hparams(
+                {"vocab_size": V, "max_seq_len": SEQ, "num_output_query_channels": qc}
+            ),
+        ),
+        path,
+    )
+
+    config, variables = import_mlm_checkpoint(str(path))
+    assert config.decoder.num_output_query_channels == qc
+    model = MaskedLanguageModel(config)
+    x = jnp.asarray(rng.integers(0, V, size=(2, 8)))
+    init = model.init(jax.random.PRNGKey(0), x)
+    assert_trees_match(variables, init)
+    logits = model.apply(variables, x)
+    assert logits.shape == (2, 8, V)
+
+
 def test_import_text_classifier_checkpoint(tmp_path):
     from perceiver_io_tpu.models.text.classifier import TextClassifier
 
