@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# Preprocess WikiText-103-raw for causal LM training ahead of train.sh
+# (reference: examples/training/clm/prep.sh).
+python -m perceiver_io_tpu.scripts.text.preproc wikitext \
+  --task=clm \
+  --data.random_train_shift=true \
+  --data.max_seq_len=4096 \
+  "$@"

@@ -1,0 +1,71 @@
+"""Programmatic Perceiver AR CLM training — the library-as-toolkit variant of
+train.sh (reference: examples/training/clm/train.py:1-57): build the
+datamodule, model config and trainer directly instead of going through the
+auto-CLI.
+
+Run from the repo root: ``PYTHONPATH=. python examples/training/clm/train.py``
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from perceiver_io_tpu.models.text import CausalLanguageModel, CausalLanguageModelConfig
+from perceiver_io_tpu.scripts import cli
+from perceiver_io_tpu.scripts.text.clm import CLMTaskArgs, make_sample_callback
+from perceiver_io_tpu.scripts.text.common import TextDataArgs, build_text_datamodule
+from perceiver_io_tpu.training.losses import clm_loss_fn
+
+data_args = TextDataArgs(
+    dataset="wikitext",
+    max_seq_len=4096,
+    batch_size=16,
+    random_train_shift=True,
+)
+
+trainer_args = cli.TrainerArgs(
+    strategy="dp",
+    precision="bf16",
+    gradient_clip_val=0.5,
+    accumulate_grad_batches=2,
+    max_steps=20000,
+    name="clm",
+)
+
+opt_args = cli.OptimizerArgs(lr=2e-4, lr_scheduler="cosine_with_warmup", warmup_steps=200)
+
+
+def main():
+    data = build_text_datamodule(data_args, task="clm")
+    config = CausalLanguageModelConfig(
+        vocab_size=data.vocab_size,
+        max_seq_len=data_args.max_seq_len,
+        max_latents=512,
+        num_channels=512,
+        num_self_attention_layers=8,
+        cross_attention_dropout=0.5,
+    )
+    model = CausalLanguageModel(config, dtype=cli.activation_dtype(trainer_args))
+
+    seq_len = data_args.max_seq_len
+    init_batch = {
+        "x": np.zeros((1, seq_len), np.int32),
+        "prefix_len": seq_len - config.max_latents,
+        "pad_mask": np.zeros((1, seq_len), bool),
+    }
+    task_args = CLMTaskArgs(sample_prompt="A man was reading a book")
+    cli.run_training(
+        model,
+        config,
+        lambda apply_fn: clm_loss_fn(apply_fn, config.max_latents),
+        init_batch,
+        cli.cycle(data.train_batches()),
+        data.valid_batches(),
+        trainer_args,
+        opt_args,
+        callbacks=[make_sample_callback(model, data.tokenizer, task_args)],
+    )
+
+
+if __name__ == "__main__":
+    main()

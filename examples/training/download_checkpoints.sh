@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Download the reference author's published training checkpoints + logs
+# (reference: examples/training/download_checkpoints.sh). The .ckpt files can
+# then be imported with `python examples/convert.py training-checkpoints ...`
+# (Lightning-state-dict -> Flax importer, perceiver_io_tpu/hf/lightning_import.py).
+dir="${1:-logs}"
+ver="${2:-0.8.0}"
+
+mkdir -p "$dir"
+
+wget -r -np -nH --cut-dirs=2 -P "$dir" -R "index.html*" "https://martin-krasser.com/perceiver/logs-$ver/"

@@ -1,0 +1,61 @@
+"""Programmatic MNIST Perceiver IO classifier training — the
+library-as-toolkit variant of train.sh (reference:
+examples/training/img_clf/train.py): build the datamodule, config and
+trainer directly instead of going through the auto-CLI.
+
+Run from the repo root: ``PYTHONPATH=. python examples/training/img_clf/train.py``
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from perceiver_io_tpu.core.config import ClassificationDecoderConfig, PerceiverIOConfig
+from perceiver_io_tpu.models.vision.image_classifier import ImageClassifier, ImageEncoderConfig
+from perceiver_io_tpu.scripts import cli
+from perceiver_io_tpu.scripts.vision.image_classifier import VisionDataArgs, build_vision_datamodule
+from perceiver_io_tpu.training.losses import classification_loss_fn
+
+data_args = VisionDataArgs(dataset="mnist", batch_size=128, random_crop=24)
+
+trainer_args = cli.TrainerArgs(max_steps=20000, name="img_clf")
+
+opt_args = cli.OptimizerArgs(lr=1e-3, warmup_steps=500)
+
+
+def main():
+    data = build_vision_datamodule(data_args)
+    crop = data_args.random_crop
+    image_shape = (crop, crop, data.image_shape[2]) if crop else data.image_shape
+    config = PerceiverIOConfig(
+        encoder=ImageEncoderConfig(
+            image_shape=image_shape,
+            num_frequency_bands=32,
+            num_cross_attention_heads=1,
+            num_self_attention_heads=8,
+        ),
+        decoder=ClassificationDecoderConfig(
+            num_classes=data.num_classes,
+            num_output_query_channels=128,
+            num_cross_attention_heads=1,
+        ),
+        num_latents=32,
+        num_latent_channels=128,
+    )
+    model = ImageClassifier(config, dtype=cli.activation_dtype(trainer_args))
+
+    init_batch = {"x": np.zeros((1, *image_shape), np.float32)}
+    cli.run_training(
+        model,
+        config,
+        lambda apply_fn: classification_loss_fn(apply_fn),
+        init_batch,
+        cli.cycle(data.train_batches()),
+        data.valid_batches(),
+        trainer_args,
+        opt_args,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Validate the latest img_clf checkpoint (reference:
+# examples/training/img_clf/valid.sh — `validate --ckpt_path`; our trainer
+# restores the newest checkpoint under the run dir automatically).
+python -m perceiver_io_tpu.scripts.vision.image_classifier validate \
+  --data.dataset=mnist \
+  --data.batch_size=128 \
+  --model.num_latents=32 \
+  --model.num_latent_channels=128 \
+  --model.encoder.num_frequency_bands=32 \
+  --trainer.name=img_clf \
+  "$@"

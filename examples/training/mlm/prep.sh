@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# Preprocess IMDb (unsupervised split) for masked LM training
+# (reference: examples/training/mlm/prep.sh).
+python -m perceiver_io_tpu.scripts.text.preproc imdb \
+  --task=mlm \
+  --data.static_masking=false \
+  --data.max_seq_len=2048 \
+  "$@"

@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Preprocess GiantMIDI-Piano into the token memmap ahead of train.sh
+# (reference: examples/training/sam/giantmidi/prep.sh).
+python -m perceiver_io_tpu.scripts.audio.preproc giantmidi \
+  --data.dataset_dir=.cache/giantmidi \
+  --data.max_seq_len=6144 \
+  "$@"

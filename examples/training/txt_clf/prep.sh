@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Preprocess IMDb (train/test splits) for sequence classification
+# (reference: examples/training/txt_clf/prep.sh).
+python -m perceiver_io_tpu.scripts.text.preproc imdb \
+  --task=clf \
+  --data.max_seq_len=2048 \
+  "$@"

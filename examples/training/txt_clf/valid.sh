@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Validate a trained IMDb classifier run: STAGE=1 checks the decoder-only
+# stage, STAGE=2 the full fine-tune (reference:
+# examples/training/txt_clf/valid_dec.sh + valid_all.sh).
+STAGE="${STAGE:-1}"
+if [ "$STAGE" = "1" ]; then NAME=txt_clf_dec; else NAME=txt_clf_all; fi
+python -m perceiver_io_tpu.scripts.text.classifier validate \
+  --data.dataset=imdb \
+  --data.max_seq_len=2048 \
+  --data.batch_size=64 \
+  --trainer.name="$NAME" \
+  "$@"

@@ -1,0 +1,120 @@
+"""Dev task runner (reference: tasks.py:7-101). The reference uses `invoke`;
+that package isn't a framework dependency, so this is a dependency-free
+equivalent with the same task names:
+
+    python tasks.py test [--cov]
+    python tasks.py test-fast          # the sub-2-minute subset (-m "not slow")
+    python tasks.py code-check         # ruff lint over the package + tests
+    python tasks.py clean              # caches + test + build artifacts
+    python tasks.py build              # sdist/wheel via pyproject
+    python tasks.py docker [--tag TAG]
+    python tasks.py bench [...args]    # the driver benchmark (real chip)
+"""
+
+from __future__ import annotations
+
+import argparse
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).parent
+
+TASKS = {}
+
+
+def task(fn):
+    TASKS[fn.__name__.replace("_", "-")] = fn
+    return fn
+
+
+def run(*cmd: str) -> None:
+    print("+", " ".join(cmd))
+    subprocess.run(cmd, cwd=ROOT, check=True)
+
+
+@task
+def test(args):
+    cmd = [sys.executable, "-m", "pytest", "tests", "--durations=25", "-q"]
+    if args.cov:
+        cmd += ["--cov=perceiver_io_tpu", "--cov-report=term"]
+    if args.rest:
+        cmd += args.rest
+    run(*cmd)
+
+
+@task
+def test_fast(args):
+    run(sys.executable, "-m", "pytest", "tests", "-q", "-m", "not slow", *args.rest)
+
+
+@task
+def code_check(args):
+    run(sys.executable, "-m", "ruff", "check", "perceiver_io_tpu", "tests", "examples", *args.rest)
+
+
+@task
+def clean_cache(args=None):
+    for pattern in ("**/__pycache__", "**/*.pyc", "**/*.pyo"):
+        for p in ROOT.glob(pattern):
+            if ".git" in p.parts:
+                continue
+            shutil.rmtree(p, ignore_errors=True) if p.is_dir() else p.unlink(missing_ok=True)
+    shutil.rmtree(ROOT / ".mypy_cache", ignore_errors=True)
+
+
+@task
+def clean_test(args=None):
+    for name in (".pytest_cache", "htmlcov"):
+        shutil.rmtree(ROOT / name, ignore_errors=True)
+    (ROOT / ".coverage").unlink(missing_ok=True)
+
+
+@task
+def clean_preproc(args=None):
+    shutil.rmtree(ROOT / ".cache", ignore_errors=True)
+
+
+@task
+def clean_build(args=None):
+    shutil.rmtree(ROOT / "dist", ignore_errors=True)
+
+
+@task
+def clean(args=None):
+    clean_cache()
+    clean_test()
+    clean_build()
+
+
+@task
+def build(args):
+    clean()
+    run(sys.executable, "-m", "build", "--sdist", "--wheel")
+
+
+@task
+def docker(args):
+    run("docker", "build", "-t", "perceiver-io-tpu", ".")
+    if args.tag:
+        run("docker", "tag", "perceiver-io-tpu", f"perceiver-io-tpu:{args.tag}")
+
+
+@task
+def bench(args):
+    run(sys.executable, "bench.py", *args.rest)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("task", choices=sorted(TASKS))
+    parser.add_argument("--cov", action="store_true", help="coverage (test)")
+    parser.add_argument("--tag", help="docker image tag")
+    parser.add_argument("rest", nargs="*", help="extra args passed through")
+    args = parser.parse_args(argv)
+    TASKS[args.task](args)
+
+
+if __name__ == "__main__":
+    main()
