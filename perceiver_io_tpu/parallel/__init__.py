@@ -1,3 +1,10 @@
+from perceiver_io_tpu.parallel.dist import (
+    is_main_process,
+    main_process_only,
+    maybe_initialize_distributed,
+    process_count,
+    process_index,
+)
 from perceiver_io_tpu.parallel.mesh import (
     batch_sharding,
     fsdp_param_shardings,
@@ -14,6 +21,11 @@ from perceiver_io_tpu.parallel.ring_attention import (
 )
 
 __all__ = [
+    "is_main_process",
+    "main_process_only",
+    "maybe_initialize_distributed",
+    "process_count",
+    "process_index",
     "batch_sharding",
     "fsdp_param_shardings",
     "param_shardings",

@@ -1,0 +1,115 @@
+"""Multi-host process-role helpers — the ``@rank_zero_only`` parity layer
+(reference: pytorch_lightning's rank_zero_only used at
+perceiver/model/text/clm/lightning.py:54, mlm/lightning.py:77).
+
+Under SPMD every host runs the same program; host-side *writes* (metric CSVs,
+TensorBoard events, sample dumps, config JSON) must happen on exactly one
+process or a shared filesystem gets racing writers. Device-side work stays
+un-gated: skipping computation on some processes would deadlock the
+collectives that all hosts must enter together (orbax checkpoint saves
+likewise run on every process — orbax coordinates multi-host writes itself).
+
+``jax.distributed.initialize`` is the multi-host entry point: call it once at
+startup (the task CLIs do this when ``JAX_COORDINATOR_ADDRESS`` is set), then
+``is_main_process()`` reflects the global process id.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Callable, Optional, TypeVar
+
+F = TypeVar("F", bound=Callable)
+
+
+def process_index() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def process_count() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def is_main_process() -> bool:
+    """True on exactly one process of a multi-host program (process 0);
+    always True single-host."""
+    return process_index() == 0
+
+
+def main_process_only(fn: F) -> F:
+    """Run ``fn`` only on process 0, returning None elsewhere — for host-side
+    side effects (file writes, stdout). Do NOT wrap device computations that
+    contain collectives (all hosts must participate)."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        if not is_main_process():
+            return None
+        return fn(*args, **kwargs)
+
+    return wrapper  # type: ignore[return-value]
+
+
+def maybe_initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Initialize ``jax.distributed`` when multi-host coordinates are known.
+
+    Two activation paths, both opt-in via environment (or arguments):
+
+    - ``JAX_COORDINATOR_ADDRESS`` (+ ``JAX_NUM_PROCESSES`` and
+      ``JAX_PROCESS_ID``) — explicit coordinates, any platform.
+    - ``JAX_AUTO_DISTRIBUTED=1`` — delegate to
+      ``jax.distributed.initialize()``'s own detection (TPU pods, SLURM, …).
+
+    Returns True when initialization happened, False when single-process.
+    Must run before any backend use. Safe to call twice (the second call is
+    a no-op).
+    """
+    import jax
+
+    coordinator_address = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    auto = os.environ.get("JAX_AUTO_DISTRIBUTED") == "1"
+    if coordinator_address is None and not auto:
+        return False
+    if coordinator_address is not None:
+        if num_processes is None:
+            try:
+                num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+            except KeyError:
+                raise ValueError(
+                    "JAX_COORDINATOR_ADDRESS is set but JAX_NUM_PROCESSES is not; "
+                    "set both (plus JAX_PROCESS_ID), or use JAX_AUTO_DISTRIBUTED=1 "
+                    "on platforms jax can auto-detect"
+                ) from None
+        if process_id is None:
+            try:
+                process_id = int(os.environ["JAX_PROCESS_ID"])
+            except KeyError:
+                raise ValueError(
+                    "JAX_COORDINATOR_ADDRESS is set but JAX_PROCESS_ID is not; "
+                    "set both (plus JAX_NUM_PROCESSES), or use JAX_AUTO_DISTRIBUTED=1 "
+                    "on platforms jax can auto-detect"
+                ) from None
+    kwargs = (
+        {}
+        if coordinator_address is None
+        else dict(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    )
+    try:
+        jax.distributed.initialize(**kwargs)
+    except RuntimeError as e:  # already initialized
+        if "already" not in str(e):
+            raise
+    return True

@@ -92,6 +92,12 @@ def config_from_dict(d: Any):
 
 
 def save_config(directory: str, config) -> None:
+    # single-writer on shared filesystems (orbax coordinates its own
+    # multi-host writes; this JSON sidecar is ours to gate)
+    from perceiver_io_tpu.parallel.dist import is_main_process
+
+    if not is_main_process():
+        return
     os.makedirs(directory, exist_ok=True)
     with open(os.path.join(directory, CONFIG_FILE), "w") as f:
         json.dump(config_to_dict(config), f, indent=2)
@@ -109,7 +115,14 @@ def load_config(directory: str):
 
 def save_pretrained(directory: str, params, config=None) -> None:
     """Weights-only artifact for inference/distribution — msgpack params +
-    config.json, the torch-free analog of HF ``save_pretrained``."""
+    config.json, the torch-free analog of HF ``save_pretrained``.
+
+    Single-writer: on a multi-host program only process 0 writes (params must
+    be process-local/replicated — gather sharded trees first)."""
+    from perceiver_io_tpu.parallel.dist import is_main_process
+
+    if not is_main_process():
+        return
     os.makedirs(directory, exist_ok=True)
     params = jax.device_get(params)
     with open(os.path.join(directory, PARAMS_FILE), "wb") as f:

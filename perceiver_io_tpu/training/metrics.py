@@ -22,14 +22,23 @@ class MetricsLogger:
     new key widens it) and mirrors them to TensorBoard if importable. Text
     logs go to TensorBoard text panels and ``samples.txt``."""
 
-    def __init__(self, log_dir: str, use_tensorboard: bool = True):
+    def __init__(self, log_dir: str, use_tensorboard: bool = True, main_process: bool = None):
+        # single-writer gating (reference @rank_zero_only semantics,
+        # text/clm/lightning.py:54): only process 0 of a multi-host program
+        # touches the filesystem; other processes get a no-op logger.
+        if main_process is None:
+            from perceiver_io_tpu.parallel.dist import is_main_process
+
+            main_process = is_main_process()
+        self._active = bool(main_process)
         self.log_dir = os.path.abspath(log_dir)
-        os.makedirs(self.log_dir, exist_ok=True)
+        if self._active:
+            os.makedirs(self.log_dir, exist_ok=True)
         self._csv_path = os.path.join(self.log_dir, "metrics.csv")
         self._keys = ["step", "time"]
         self._header_written = False
         self._tb = None
-        if use_tensorboard:
+        if use_tensorboard and self._active:
             try:  # torch's tensorboard writer; optional
                 from torch.utils.tensorboard import SummaryWriter
 
@@ -38,6 +47,8 @@ class MetricsLogger:
                 self._tb = None
 
     def log(self, step: int, metrics: Dict[str, float]) -> None:
+        if not self._active:
+            return
         row = {"step": int(step), "time": time.time()}
         for k, v in metrics.items():
             row[k] = float(v)
@@ -66,12 +77,16 @@ class MetricsLogger:
             writer.writerows(rows)
 
     def log_text(self, step: int, tag: str, text: str) -> None:
+        if not self._active:
+            return
         with open(os.path.join(self.log_dir, "samples.txt"), "a") as f:
             f.write(f"--- step {int(step)} [{tag}] ---\n{text}\n")
         if self._tb is not None:
             self._tb.add_text(tag, text, global_step=int(step))
 
     def log_hparams(self, hparams: Dict) -> None:
+        if not self._active:
+            return
         with open(os.path.join(self.log_dir, "hparams.json"), "w") as f:
             json.dump(hparams, f, indent=2, default=str)
 

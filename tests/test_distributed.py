@@ -1,0 +1,102 @@
+"""Two-process ``jax.distributed`` smoke test (CPU): multi-host init, a
+global-mesh collective, and rank-0-only logging/config writes
+(reference: Lightning DDP rank semantics + @rank_zero_only,
+perceiver/model/text/clm/lightning.py:54).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+WORKER = textwrap.dedent(
+    """
+    import os, sys, json
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from perceiver_io_tpu.parallel.dist import (
+        is_main_process, maybe_initialize_distributed, process_count, process_index,
+    )
+
+    coord, n, pid, out_dir = sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4]
+    assert maybe_initialize_distributed(coord, n, pid)
+    assert process_count() == n
+    assert process_index() == pid
+    assert is_main_process() == (pid == 0)
+
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devices = jax.devices()  # global: n processes x 2 local cpu devices
+    assert len(devices) == 2 * n, devices
+    mesh = Mesh(devices, ("data",))
+    # per-process shard -> global array -> global collective sum
+    local = jnp.full((2, 4), float(pid + 1))
+    arr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("data")), local, (2 * n, 4)
+    )
+    total = jax.jit(
+        lambda x: jnp.sum(x), out_shardings=NamedSharding(mesh, P())
+    )(arr)
+    # sum over ranks: 8*1 + 8*2 = 24 for n=2
+    expected = sum(8.0 * (i + 1) for i in range(n))
+    assert float(total) == expected, float(total)
+
+    # rank-0-only writes: every process logs; only one writes files
+    from perceiver_io_tpu.training.metrics import MetricsLogger
+
+    logger = MetricsLogger(out_dir, use_tensorboard=False)
+    logger.log(1, {"train_loss": 1.0 + pid})
+    logger.log_text(1, "sample", f"from rank {pid}")
+    logger.close()
+
+    print(json.dumps({"pid": pid, "wrote": os.path.exists(os.path.join(out_dir, "metrics.csv"))}))
+    """
+)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_distributed(tmp_path):
+    n = 2
+    coord = f"localhost:{_free_port()}"
+    out_dir = tmp_path / "logs"
+    env = {k: v for k, v in os.environ.items() if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["JAX_PLATFORMS"] = ""  # let the worker pick cpu via jax.config
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", WORKER, coord, str(n), str(pid), str(out_dir)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            env=env,
+            text=True,
+        )
+        for pid in range(n)
+    ]
+    results = {}
+    for p in procs:
+        out, err = p.communicate(timeout=300)
+        assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
+        payload = json.loads(out.strip().splitlines()[-1])
+        results[payload["pid"]] = payload
+
+    # exactly one metrics.csv, written by rank 0, containing only rank 0's row
+    import csv as csv_mod
+
+    csv_path = out_dir / "metrics.csv"
+    assert csv_path.exists()
+    rows = list(csv_mod.DictReader(csv_path.open()))
+    assert [float(r["train_loss"]) for r in rows] == [1.0]  # rank 0's value only
+    samples = (out_dir / "samples.txt").read_text()
+    assert "from rank 0" in samples and "from rank 1" not in samples
