@@ -197,6 +197,69 @@ class DirectorySymbolicAudioDataModule(SymbolicAudioDataModule):
         return {"train": self.dataset_dir / "train", "valid": self.dataset_dir / "valid"}
 
 
+class SyntheticSymbolicAudioDataModule(SymbolicAudioDataModule):
+    """Deterministic generated token stream for fully-offline convergence
+    runs: pieces are built from a small bank of note motifs (note_on /
+    time_shift / velocity / note_off events in their valid vocabulary ranges)
+    repeated with variation, so a causal model can genuinely learn the event
+    grammar and motif statistics — far below the uniform log(389) entropy."""
+
+    def __init__(self, *args, num_train_pieces: int = 96, num_valid_pieces: int = 16,
+                 corpus_seed: int = 7, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.num_train_pieces = num_train_pieces
+        self.num_valid_pieces = num_valid_pieces
+        self.corpus_seed = corpus_seed
+
+    @staticmethod
+    def _motifs(rng) -> List[np.ndarray]:
+        # event vocabulary layout (data/audio/midi.py): note_on 0..127,
+        # note_off 128..255, time_shift 256..355, velocity 356..387
+        banks = []
+        for _ in range(8):
+            pitches = rng.integers(40, 88, size=4)
+            events = []
+            for p in pitches:
+                events += [356 + int(rng.integers(8, 24)),  # velocity
+                           int(p),                          # note_on
+                           256 + int(rng.integers(5, 20)),  # time_shift
+                           128 + int(p)]                    # note_off
+            banks.append(np.asarray(events, np.int16))
+        return banks
+
+    def _piece(self, rng, motifs) -> np.ndarray:
+        idx = rng.integers(0, len(motifs), size=int(rng.integers(40, 80)))
+        parts = []
+        for i in idx:
+            m = motifs[i].copy()
+            if rng.random() < 0.25:  # transpose the motif by a small interval
+                shift = int(rng.integers(-3, 4))
+                on = (m < 128)
+                off = (m >= 128) & (m < 256)
+                m[on] = np.clip(m[on] + shift, 0, 127)
+                m[off] = np.clip(m[off] + shift, 128, 255)
+            parts.append(m)
+        return np.concatenate(parts)
+
+    def prepare_data(self) -> None:
+        if os.path.exists(self.preproc_dir):
+            return
+        rng = np.random.default_rng(self.corpus_seed)
+        motifs = self._motifs(rng)
+        pieces = {
+            "train": [self._piece(rng, motifs) for _ in range(self.num_train_pieces)],
+            "valid": [self._piece(rng, motifs) for _ in range(self.num_valid_pieces)],
+        }
+        self.preproc_dir.mkdir(parents=True)
+        for split, target in (("train", self.train_data_file), ("valid", self.valid_data_file)):
+            flat = np.concatenate(
+                [np.append(ids, [EXAMPLE_SEPARATOR]) for ids in pieces[split]]
+            ).astype(np.int16)
+            fp = np.memmap(str(target), dtype=np.int16, mode="w+", shape=flat.shape)
+            fp[:] = flat[:]
+            fp.flush()
+
+
 class _ArchiveSymbolicAudioDataModule(SymbolicAudioDataModule):
     """Base for archive-backed datasets (reference:
     perceiver/data/audio/{giantmidi_piano,maestro_v3}.py — zip download +

@@ -4,7 +4,7 @@ from perceiver_io_tpu.data.text.collators import (
     TokenMaskingCollator,
     WordMaskingCollator,
 )
-from perceiver_io_tpu.data.text.datamodule import TextDataModule
+from perceiver_io_tpu.data.text.datamodule import SyntheticTextDataModule, TextDataModule
 from perceiver_io_tpu.data.text.tokenizer import ByteTokenizer
 
 __all__ = [
@@ -12,6 +12,7 @@ __all__ = [
     "RandomTruncateCollator",
     "TokenMaskingCollator",
     "WordMaskingCollator",
+    "SyntheticTextDataModule",
     "TextDataModule",
     "ByteTokenizer",
 ]

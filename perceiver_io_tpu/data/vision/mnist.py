@@ -47,6 +47,44 @@ def mnist_transform(normalize: bool = True, random_crop: Optional[int] = None, s
     return transform
 
 
+# 5x7 bitmap digit font for the synthetic fallback: class-dependent structure
+# (glyph identity) under nuisance variation (translation, intensity, noise),
+# so offline smoke training can genuinely learn and generalize — random pixels
+# with random labels would only ever memorize.
+_DIGIT_FONT = [
+    "01110 10001 10011 10101 11001 10001 01110",  # 0
+    "00100 01100 00100 00100 00100 00100 01110",  # 1
+    "01110 10001 00001 00010 00100 01000 11111",  # 2
+    "11110 00001 00001 01110 00001 00001 11110",  # 3
+    "00010 00110 01010 10010 11111 00010 00010",  # 4
+    "11111 10000 11110 00001 00001 10001 01110",  # 5
+    "00110 01000 10000 11110 10001 10001 01110",  # 6
+    "11111 00001 00010 00100 01000 01000 01000",  # 7
+    "01110 10001 10001 01110 10001 10001 01110",  # 8
+    "01110 10001 10001 01111 00001 00010 01100",  # 9
+]
+
+
+def synthetic_digits(n: int, seed: int = 0, size: int = 28):
+    """Deterministic learnable digit images: the glyph (label) is rendered at
+    2x scale at a random offset with intensity jitter and background noise."""
+    rng = np.random.default_rng(seed)
+    glyphs = []
+    for spec in _DIGIT_FONT:
+        bitmap = np.array([[int(c) for c in row] for row in spec.split()], np.float32)
+        glyphs.append(np.kron(bitmap, np.ones((2, 2), np.float32)))  # 14 x 10
+    labels = rng.integers(0, 10, n).astype(np.int64)
+    images = np.zeros((n, size, size), np.float32)
+    gh, gw = glyphs[0].shape
+    for i, lab in enumerate(labels):
+        top = int(rng.integers(0, size - gh + 1))
+        left = int(rng.integers(0, size - gw + 1))
+        intensity = float(rng.uniform(0.6, 1.0))
+        images[i, top : top + gh, left : left + gw] = glyphs[lab] * intensity
+    images = images * 255.0 + rng.normal(0.0, 12.0, images.shape)
+    return np.clip(images, 0, 255).astype(np.uint8), labels
+
+
 class MNISTDataModule:
     num_classes = 10
 
@@ -79,11 +117,9 @@ class MNISTDataModule:
         if self._train is not None:
             return
         if self.synthetic:
-            rng = np.random.default_rng(self.seed)
-            images = (rng.random((512, 28, 28)) * 255).astype(np.uint8)
-            labels = rng.integers(0, 10, 512)
-            self._train = (images[:448], labels[:448])
-            self._valid = (images[448:], labels[448:])
+            images, labels = synthetic_digits(4096, seed=self.seed)
+            self._train = (images[:3584], labels[:3584])
+            self._valid = (images[3584:], labels[3584:])
             return
         import datasets
 

@@ -19,7 +19,7 @@ from perceiver_io_tpu.training.losses import clm_loss_fn
 
 @dataclass
 class AudioDataArgs:
-    dataset: str = "directory"  # directory | giantmidi | maestro
+    dataset: str = "directory"  # directory | giantmidi | maestro | synthetic
     dataset_dir: str = ".cache/audio"
     max_seq_len: int = 4096
     min_seq_len: Optional[int] = None
@@ -33,12 +33,14 @@ def build_audio_datamodule(args: AudioDataArgs):
         DirectorySymbolicAudioDataModule,
         GiantMidiPianoDataModule,
         MaestroV3DataModule,
+        SyntheticSymbolicAudioDataModule,
     )
 
     classes = {
         "directory": DirectorySymbolicAudioDataModule,
         "giantmidi": GiantMidiPianoDataModule,
         "maestro": MaestroV3DataModule,
+        "synthetic": SyntheticSymbolicAudioDataModule,
     }
     if args.dataset not in classes:
         raise ValueError(f"unknown dataset {args.dataset!r}; choose from {sorted(classes)}")
@@ -65,6 +67,22 @@ def main(argv: Optional[Sequence[str]] = None):
         {"max_latents": 1024, "num_channels": 512, "num_self_attention_layers": 8},
     )
     cli.add_dataclass_args(parser, AudioDataArgs, "data")
+    cli.add_smoke_preset(
+        parser,
+        {
+            "data.dataset": "synthetic",
+            "data.dataset_dir": ".cache/sam_smoke",
+            "data.max_seq_len": 1024,
+            "data.batch_size": 8,
+            "model.max_latents": 256,
+            "model.num_channels": 192,
+            "model.num_self_attention_layers": 4,
+            "trainer.max_steps": 500,
+            "trainer.val_interval": 100,
+            "trainer.name": "sam_smoke",
+            "optimizer.warmup_steps": 50,
+        },
+    )
     args = cli.parse_args(parser, argv)
 
     trainer_args = cli.build_dataclass(cli.TrainerArgs, args, "trainer")

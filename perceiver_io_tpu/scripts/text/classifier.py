@@ -64,6 +64,17 @@ def main(argv: Optional[Sequence[str]] = None):
         default=False,
     )
     cli.add_dataclass_args(parser, TextDataArgs, "data", {"dataset": "imdb", "max_seq_len": 256, "batch_size": 64})
+    cli.add_smoke_preset(
+        parser,
+        {
+            "data.dataset": "synthetic",
+            "data.max_seq_len": 256,
+            "data.batch_size": 32,
+            "trainer.max_steps": 400,
+            "trainer.val_interval": 100,
+            "trainer.name": "txt_clf_smoke",
+        },
+    )
     args = cli.parse_args(parser, argv)
 
     trainer_args = cli.build_dataclass(cli.TrainerArgs, args, "trainer")

@@ -73,6 +73,21 @@ def main(argv: Optional[Sequence[str]] = None):
     )
     cli.add_dataclass_args(parser, TextDataArgs, "data", {"max_seq_len": 4096, "batch_size": 8})
     cli.add_dataclass_args(parser, CLMTaskArgs, "task")
+    cli.add_smoke_preset(
+        parser,
+        {
+            "data.dataset": "synthetic",
+            "data.max_seq_len": 1024,
+            "data.batch_size": 8,
+            "model.max_latents": 256,
+            "model.num_channels": 192,
+            "model.num_self_attention_layers": 4,
+            "trainer.max_steps": 600,
+            "trainer.val_interval": 100,
+            "trainer.name": "clm_smoke",
+            "optimizer.warmup_steps": 50,
+        },
+    )
     args = cli.parse_args(parser, argv)
 
     trainer_args = cli.build_dataclass(cli.TrainerArgs, args, "trainer")

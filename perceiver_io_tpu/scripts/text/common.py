@@ -12,6 +12,7 @@ from perceiver_io_tpu.data.text.datamodule import (
     BookCorpusOpenDataModule,
     Enwik8DataModule,
     ImdbDataModule,
+    SyntheticTextDataModule,
     TextDataModule,
     TextFileDataModule,
     WikipediaDataModule,
@@ -26,6 +27,7 @@ DATASETS = {
     "bookcorpusopen": BookCorpusOpenDataModule,
     "enwik8": Enwik8DataModule,
     "textfile": TextFileDataModule,
+    "synthetic": SyntheticTextDataModule,
 }
 
 

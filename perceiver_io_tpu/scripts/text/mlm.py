@@ -84,6 +84,18 @@ def main(argv: Optional[Sequence[str]] = None):
         default=None,
         help="'|'-separated sentences with [MASK] tokens, logged each validation",
     )
+    cli.add_smoke_preset(
+        parser,
+        {
+            "data.dataset": "synthetic",
+            "data.max_seq_len": 256,
+            "data.batch_size": 32,
+            "trainer.max_steps": 600,
+            "trainer.val_interval": 100,
+            "trainer.name": "mlm_smoke",
+            "optimizer.warmup_steps": 50,
+        },
+    )
     args = cli.parse_args(parser, argv)
 
     trainer_args = cli.build_dataclass(cli.TrainerArgs, args, "trainer")

@@ -85,6 +85,16 @@ def main(argv: Optional[Sequence[str]] = None):
         default=False,
     )
     cli.add_dataclass_args(parser, VisionDataArgs, "data")
+    cli.add_smoke_preset(
+        parser,
+        {
+            "data.synthetic": True,
+            "data.batch_size": 64,
+            "trainer.max_steps": 500,
+            "trainer.val_interval": 100,
+            "trainer.name": "img_clf_smoke",
+        },
+    )
     args = cli.parse_args(parser, argv)
 
     trainer_args = cli.build_dataclass(cli.TrainerArgs, args, "trainer")
