@@ -19,6 +19,16 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
 
+# The suite is compile-bound (hundreds of distinct jit programs): a
+# persistent compilation cache makes repeat runs hit compiled artifacts
+# instead of XLA. Opt out with JAX_TEST_NO_COMPILE_CACHE=1.
+if not os.environ.get("JAX_TEST_NO_COMPILE_CACHE"):
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(__file__), ".jax_cache"),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

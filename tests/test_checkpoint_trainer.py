@@ -10,6 +10,7 @@ import os
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from perceiver_io_tpu.core.config import ClassificationDecoderConfig
 from perceiver_io_tpu.models.text import (
@@ -79,6 +80,7 @@ def test_config_roundtrip():
     assert config_from_dict(config_to_dict(clm)) == clm
 
 
+@pytest.mark.slow
 def test_checkpoint_save_restore(tmp_path):
     model, config = tiny_classifier()
     state, batch = make_state(model, config)
@@ -114,6 +116,7 @@ def test_pretrained_roundtrip(tmp_path):
     assert jnp.allclose(out1, out2)
 
 
+@pytest.mark.slow
 def test_encoder_warm_start_and_freeze():
     """Classifier encoder warm start from a donor model + freeze parity
     (reference: perceiver/model/text/classifier/lightning.py:28-36)."""
@@ -159,6 +162,7 @@ def _repeat(batch):
         yield batch
 
 
+@pytest.mark.slow
 def test_trainer_fit_and_resume(tmp_path):
     model, config = tiny_classifier()
     state, batch = make_state(model, config)

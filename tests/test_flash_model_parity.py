@@ -9,6 +9,8 @@ import pytest
 from perceiver_io_tpu.models.text import CausalLanguageModel, CausalLanguageModelConfig
 from perceiver_io_tpu.ops.flash_attention import set_default_flash
 
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture
 def model_and_batch(rng):

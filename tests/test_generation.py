@@ -67,6 +67,7 @@ def test_generate_output_shape(model_and_params):
     np.testing.assert_array_equal(np.asarray(out[:, :10]), np.asarray(ids))
 
 
+@pytest.mark.slow
 def test_generate_cached_equals_uncached_sliding_window(model_and_params):
     """Greedy cached generation must match re-running the full uncached
     forward per step with the reference's window bookkeeping: latents grow to
@@ -96,6 +97,7 @@ def test_generate_cached_equals_uncached_sliding_window(model_and_params):
     np.testing.assert_array_equal(np.asarray(out_cached), seq)
 
 
+@pytest.mark.slow
 def test_generate_with_left_padding(model_and_params):
     """Left-padded prompts: pad positions are masked and positions shifted."""
     model, params = model_and_params
@@ -125,6 +127,7 @@ def test_generate_with_left_padding(model_and_params):
     np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(out_single[0]))
 
 
+@pytest.mark.slow
 def test_sampling_strategies(model_and_params):
     model, params = model_and_params
     ids = prompt()

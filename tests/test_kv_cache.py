@@ -177,6 +177,7 @@ def test_cross_attn_cache(cross_attn):
     np.testing.assert_allclose(np.asarray(cache.v), np.asarray(cache_ref.v), atol=ATOL)
 
 
+@pytest.mark.slow
 def test_csm_cache(csm):
     model, params, config = csm
     total = NUM_PREFIX + NUM_LATENTS

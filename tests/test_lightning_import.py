@@ -129,6 +129,7 @@ def assert_trees_match(imported, model_init):
 # -------------------------------------------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_import_clm_checkpoint(tmp_path):
     from perceiver_io_tpu.models.text import CausalLanguageModel
 
@@ -163,6 +164,7 @@ def test_import_rejects_unconsumed_parameters(tmp_path):
         import_clm_checkpoint(str(path))
 
 
+@pytest.mark.slow
 def test_clm_export_import_round_trip(tmp_path):
     """Our trained params → reference-named .ckpt → re-import: identical."""
     from perceiver_io_tpu.models.text import CausalLanguageModel, CausalLanguageModelConfig
@@ -234,6 +236,7 @@ def perceiver_io_hparams(decoder_extra=None):
     }
 
 
+@pytest.mark.slow
 def test_import_mlm_checkpoint_tied(tmp_path):
     from perceiver_io_tpu.models.text.mlm import MaskedLanguageModel
 
@@ -253,6 +256,7 @@ def test_import_mlm_checkpoint_tied(tmp_path):
     assert logits.shape == (2, 8, V)
 
 
+@pytest.mark.slow
 def test_import_mlm_checkpoint_untied(tmp_path):
     from perceiver_io_tpu.models.text.mlm import MaskedLanguageModel
 
@@ -302,6 +306,7 @@ def test_import_text_classifier_checkpoint(tmp_path):
     assert logits.shape == (2, 2)
 
 
+@pytest.mark.slow
 def test_import_image_classifier_checkpoint(tmp_path):
     from perceiver_io_tpu.models.vision.image_classifier import ImageClassifier
 

@@ -20,6 +20,8 @@ from perceiver_io_tpu.parallel.long_context import (
     make_seq_parallel_clm_loss,
 )
 
+pytestmark = pytest.mark.slow
+
 SEQ_LEN, LATENTS, VOCAB = 64, 16, 64
 PREFIX = SEQ_LEN - LATENTS
 

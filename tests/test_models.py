@@ -44,6 +44,7 @@ def small_text_encoder_config():
     )
 
 
+@pytest.mark.slow
 def test_text_classifier_shapes():
     config = TextClassifierConfig(
         encoder=small_text_encoder_config(),
@@ -62,6 +63,7 @@ def test_text_classifier_shapes():
 
 
 @pytest.mark.parametrize("tied", [True, False])
+@pytest.mark.slow
 def test_masked_language_model_shapes(tied):
     config = MaskedLanguageModelConfig(
         encoder=small_text_encoder_config(),
@@ -110,6 +112,7 @@ def test_symbolic_audio_model_vocab():
     assert out.logits.shape == (B, 16, 389)
 
 
+@pytest.mark.slow
 def test_image_classifier_shapes():
     config = ImageClassifierConfig(
         encoder=ImageEncoderConfig(
@@ -144,6 +147,7 @@ def test_image_classifier_rejects_wrong_shape():
         model.init(jax.random.PRNGKey(0), jnp.zeros((B, 16, 16, 1)))
 
 
+@pytest.mark.slow
 def test_optical_flow_shapes():
     h, w = 16, 24
     config = OpticalFlowConfig(
@@ -268,6 +272,7 @@ class TestActivationCheckpointing:
         return CausalLanguageModel(config)
 
     @pytest.mark.parametrize("flag", ["activation_checkpointing", "activation_offloading"])
+    @pytest.mark.slow
     def test_clm_values_and_grads_unchanged(self, flag):
         base = self._clm()
         wrapped = self._clm(**{flag: True})
@@ -285,6 +290,7 @@ class TestActivationCheckpointing:
         for a, b in zip(jax.tree.leaves(out_g), jax.tree.leaves(ref_g)):
             assert jnp.allclose(a, b, atol=1e-6)
 
+    @pytest.mark.slow
     def test_image_classifier_offloading_builds_and_runs(self):
         config = ImageClassifierConfig(
             encoder=ImageEncoderConfig(

@@ -55,6 +55,7 @@ class TestTextGeneration:
         assert len(out) == 2
         assert out[1].startswith("longer prompt")
 
+    @pytest.mark.slow
     def test_beam_search_option(self, clm):
         model, params = clm
         p = TextGenerationPipeline(model, params)
@@ -63,6 +64,7 @@ class TestTextGeneration:
         with pytest.raises(ValueError, match="do_sample=False"):
             p("hello", num_beams=2, do_sample=True)
 
+    @pytest.mark.slow
     def test_beam_search_mixed_length_prompts(self, clm):
         """Left-padded beam search through the pipeline: each prompt's beam
         continuation equals the prompt run alone."""
@@ -74,6 +76,7 @@ class TestTextGeneration:
             alone = p(s, max_new_tokens=5, do_sample=False, num_beams=3)
             assert batched[i] == alone
 
+    @pytest.mark.slow
     def test_factory_from_pretrained(self, clm, tmp_path):
         model, params = clm
         from perceiver_io_tpu.training.checkpoint import save_pretrained
@@ -143,6 +146,7 @@ class TestFillMask:
 
 
 class TestTextClassification:
+    @pytest.mark.slow
     def test_scores_and_labels(self):
         from perceiver_io_tpu.models.text import TextClassifier
         from perceiver_io_tpu.models.text.common import TextEncoderConfig
@@ -172,6 +176,7 @@ class TestTextClassification:
 
 
 class TestImageClassification:
+    @pytest.mark.slow
     def test_channels_first_uint8(self):
         from perceiver_io_tpu.models.vision.image_classifier import (
             ImageClassifier,
@@ -234,6 +239,7 @@ class TestImageClassification:
 
 
 class TestOpticalFlow:
+    @pytest.mark.slow
     def test_flow_shape_and_render(self):
         from perceiver_io_tpu.data.vision.optical_flow import OpticalFlowProcessor
         from perceiver_io_tpu.models.vision.optical_flow import (

@@ -11,12 +11,15 @@ kernels would otherwise hand-roll."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from perceiver_io_tpu.models.text import CausalLanguageModel, CausalLanguageModelConfig
 from perceiver_io_tpu.parallel import make_mesh
 from perceiver_io_tpu.training import TrainState, clm_loss_fn, make_optimizer
 from perceiver_io_tpu.training.loop import make_train_step
+
+pytestmark = pytest.mark.slow
 
 
 def build(seq_len=64, latents=16):

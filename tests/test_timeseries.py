@@ -34,6 +34,7 @@ def tiny_config(in_len=32, out_len=16, channels=3):
 
 
 class TestModel:
+    @pytest.mark.slow
     def test_forward_shape(self):
         config = tiny_config()
         model = TimeSeriesPerceiver(config)
@@ -48,6 +49,7 @@ class TestModel:
         with pytest.raises(ValueError, match="incompatible"):
             model.init(jax.random.PRNGKey(0), jnp.zeros((1, 20, 3)))
 
+    @pytest.mark.slow
     def test_auto_registry_roundtrip(self, tmp_path):
         from perceiver_io_tpu.hf import from_pretrained
         from perceiver_io_tpu.training.checkpoint import save_pretrained

@@ -59,6 +59,7 @@ def test_schedules():
     assert float(const(50)) == pytest.approx(2.0)
 
 
+@pytest.mark.slow
 def test_classifier_learns():
     model = small_classifier()
     batch = toy_batch()
@@ -77,6 +78,7 @@ def test_classifier_learns():
     assert int(state.step) == 40
 
 
+@pytest.mark.slow
 def test_clm_train_step_runs():
     config = CausalLanguageModelConfig(
         vocab_size=50, max_seq_len=24, max_latents=8, num_channels=32,
@@ -117,6 +119,7 @@ def test_clm_rejects_short_sequences():
 
 
 @pytest.mark.parametrize("mesh_shape", [{"data": 8}, {"data": 2, "fsdp": 4}, {"fsdp": 8}])
+@pytest.mark.slow
 def test_sharded_training(mesh_shape):
     """DDP / FSDP / hybrid parity: one SPMD program over an 8-device mesh
     (replaces reference DDPStrategy + FSDPStrategy, SURVEY §2.7 P1-P2)."""
@@ -145,6 +148,7 @@ def test_sharded_training(mesh_shape):
         assert any("fsdp" in str(s.spec) for s in placed if hasattr(s, "spec"))
 
 
+@pytest.mark.slow
 def test_gradient_accumulation():
     model = small_classifier()
     batch = toy_batch(n=8)

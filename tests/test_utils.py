@@ -32,6 +32,7 @@ class TestComputeEstimator:
         none = est.cross_attn(num_channels=8, prefix_dropout=1.0)
         assert full > half > none > 0  # embedding part survives full dropout
 
+    @pytest.mark.slow
     def test_param_count_matches_real_init(self):
         """eval_shape-based count equals an actual initialization's count."""
         import jax
