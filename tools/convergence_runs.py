@@ -1,0 +1,105 @@
+"""Run every task family's --smoke preset to completion (fully offline,
+synthetic/local data) and export the loss curves — the convergence evidence
+standing in for BASELINE.md's network-blocked real-data runs.
+
+    python tools/convergence_runs.py [--out docs/results] [--tasks clm mlm ...]
+
+Each run uses the task CLI's own --smoke preset (same entry a user runs);
+metrics.csv is copied to <out>/<task>.csv and a summary line is printed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import json
+import math
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TASKS = {
+    # module, extra args, metric of interest, target ("lt" = less-than)
+    "clm": ("perceiver_io_tpu.scripts.text.clm", [], "val_loss", None),
+    "mlm": ("perceiver_io_tpu.scripts.text.mlm", [], "val_loss", None),
+    "txt_clf": ("perceiver_io_tpu.scripts.text.classifier", [], "val_acc", None),
+    "img_clf": ("perceiver_io_tpu.scripts.vision.image_classifier", [], "val_acc", None),
+    "sam": ("perceiver_io_tpu.scripts.audio.symbolic", [], "val_loss", None),
+    "timeseries": ("perceiver_io_tpu.scripts.timeseries", [], "val_loss", None),
+}
+
+RUNNER = """
+import jax, sys
+jax.config.update("jax_platforms", "{platform}")
+import importlib
+mod = importlib.import_module("{module}")
+mod.main({argv!r})
+"""
+
+
+def run_task(name: str, out_dir: str, platform: str) -> dict:
+    module, extra, metric, _ = TASKS[name]
+    root = tempfile.mkdtemp(prefix=f"smoke_{name}_")
+    argv = [
+        "fit",
+        "--smoke",
+        f"--trainer.default_root_dir={root}",
+        f"--trainer.name={name}",
+        "--trainer.checkpoint=false",
+    ] + extra
+    t0 = time.time()
+    proc = subprocess.run(
+        [sys.executable, "-c", RUNNER.format(platform=platform, module=module, argv=argv)],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+    )
+    wall = time.time() - t0
+    if proc.returncode != 0:
+        raise RuntimeError(f"{name} failed:\n{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}")
+    src = os.path.join(root, name, "metrics.csv")
+    dst = os.path.join(out_dir, f"{name}.csv")
+    shutil.copy(src, dst)
+
+    rows = list(csv.DictReader(open(dst)))
+    series = [(int(r["step"]), float(r[metric])) for r in rows if r.get(metric)]
+    first, last = series[0], series[-1]
+    summary = {
+        "task": name,
+        "metric": metric,
+        "first": {"step": first[0], "value": round(first[1], 4)},
+        "final": {"step": last[0], "value": round(last[1], 4)},
+        "minutes": round(wall / 60, 1),
+    }
+    if metric == "val_loss" and name in ("clm", "mlm", "sam"):
+        summary["final_bits_per_token"] = round(last[1] / math.log(2), 3)
+    shutil.rmtree(root, ignore_errors=True)
+    return summary
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--out", default="docs/results")
+    p.add_argument("--tasks", nargs="*", default=list(TASKS))
+    p.add_argument("--platform", default="cpu", help="cpu keeps the TPU free; smoke sizes are CPU-sized")
+    args = p.parse_args()
+
+    out_dir = os.path.join(REPO, args.out)
+    os.makedirs(out_dir, exist_ok=True)
+    summaries = []
+    for name in args.tasks:
+        print(f"=== {name} ===", flush=True)
+        s = run_task(name, out_dir, args.platform)
+        print(json.dumps(s), flush=True)
+        summaries.append(s)
+    with open(os.path.join(out_dir, "summary.json"), "w") as f:
+        json.dump(summaries, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()
