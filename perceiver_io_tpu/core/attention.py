@@ -253,6 +253,9 @@ class MultiHeadAttention(nn.Module):
         # Fused blockwise path (Pallas flash attention): no cache, no active
         # attention-prob dropout. The kernel's right-aligned causal mask is
         # identical to the mask construction below when the cache is absent.
+        # (A size-based "einsum for short kv" policy was measured and
+        # rejected: interleaved same-process A/B at the 16k flagship showed
+        # all-flash fastest at batch 4 — see docs/performance.md.)
         dropout_active = self.dropout > 0.0 and not deterministic
         if (
             kv_cache is None

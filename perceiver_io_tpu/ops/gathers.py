@@ -1,0 +1,104 @@
+"""Gather/scatter-free building blocks for the training hot path.
+
+XLA lowers the backward of an embedding lookup / row gather to a
+``scatter-add``, which serializes on TPU. Profile of the 16k-context
+Perceiver AR train step (batch 4, v5e, tools/xplane.py over a
+``jax.profiler.trace`` capture):
+
+- token-embedding gradient (65536 rows -> 262-row table): 1.03 ms/step
+- prefix-dropout gather backward (30720 rows -> 61440 slots): 0.81 ms/step
+
+Both rewrites below keep the forward untouched and replace only the VJP:
+
+- ``small_vocab_embed``: d_table as a one-hot matmul (the MXU eats it;
+  contraction size = number of looked-up rows). Only profitable for small
+  vocabularies — flops scale with vocab — so callers gate on table height.
+- ``gather_unique_rows``: for *unique* row indices (the dropout keep-set),
+  the scatter-add backward is really a permutation: invert the index map
+  once (a tiny int scatter) and the gradient becomes a row *gather* plus a
+  zero mask.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import dtypes
+
+
+def _int_zero(x):
+    return np.zeros(x.shape, dtypes.float0)
+
+
+# ---------------------------------------------------------------- embedding
+
+
+@jax.custom_vjp
+def small_vocab_embed(table: jnp.ndarray, ids: jnp.ndarray) -> jnp.ndarray:
+    """``table[ids]`` whose gradient is ``one_hot(ids)^T @ g`` (a matmul)
+    instead of a scatter-add. ``table`` (V, C), ``ids`` any int shape."""
+    return jnp.take(table, ids, axis=0)
+
+
+def _sve_fwd(table, ids):
+    # dtype carried as a zero-size array: plain dtype objects are not JAX
+    # types and cannot ride in custom_vjp residuals
+    proto = jnp.zeros((0,), table.dtype)
+    return jnp.take(table, ids, axis=0), (ids, table.shape[0], proto)
+
+
+def _sve_bwd(res, g):
+    ids, vocab, proto = res
+    flat = ids.reshape(-1)
+    gf = g.reshape(-1, g.shape[-1])
+    onehot = jax.nn.one_hot(flat, vocab, dtype=gf.dtype)
+    d_table = jnp.einsum(
+        "nv,nc->vc", onehot, gf, preferred_element_type=jnp.float32
+    ).astype(proto.dtype)
+    return d_table, _int_zero(ids)
+
+
+small_vocab_embed.defvjp(_sve_fwd, _sve_bwd)
+
+# small enough that the one-hot contraction beats the scatter (flops ~ N*V*C)
+SMALL_VOCAB_MAX = 2048
+
+
+def embed_lookup(table: jnp.ndarray, ids: jnp.ndarray) -> jnp.ndarray:
+    """Embedding lookup choosing the matmul-backward path for small tables."""
+    if table.shape[0] <= SMALL_VOCAB_MAX:
+        return small_vocab_embed(table, ids)
+    return jnp.take(table, ids, axis=0)
+
+
+# ------------------------------------------------------------- row gathers
+
+
+@jax.custom_vjp
+def gather_unique_rows(x: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """``take_along_axis(x, idx[..., None], axis=1)`` for (B, N, C) ``x`` and
+    (B, K) **unique-per-row** indices, with a gather-based backward."""
+    return jnp.take_along_axis(x, idx[..., None], axis=1)
+
+
+def _gur_fwd(x, idx):
+    return jnp.take_along_axis(x, idx[..., None], axis=1), (idx, x.shape)
+
+
+def _gur_bwd(res, g):
+    idx, x_shape = res
+    b, n, _ = x_shape
+    k = idx.shape[1]
+    # invert the (unique) index map: inv[j] = position of row j in the keep
+    # set (tiny int32 scatter), kept[j] = whether row j was selected
+    inv = jnp.zeros((b, n), jnp.int32)
+    inv = jax.vmap(lambda i, v: i.at[v].set(jnp.arange(k, dtype=jnp.int32)))(inv, idx)
+    kept = jnp.zeros((b, n), bool)
+    kept = jax.vmap(lambda m, v: m.at[v].set(True))(kept, idx)
+    d_x = jnp.take_along_axis(g, inv[..., None], axis=1)
+    d_x = jnp.where(kept[..., None], d_x, 0)
+    return d_x, _int_zero(idx)
+
+
+gather_unique_rows.defvjp(_gur_fwd, _gur_bwd)
