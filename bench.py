@@ -230,12 +230,28 @@ def decode_bench(args):
         return float(fns[k](params, prompt)[0, -1])
 
     per_token = robust_slope(run, n_short, n_long)
+
+    # analytic A100 decode baseline: the decode hot loop is HBM-bandwidth
+    # bound (reference loop: core/huggingface.py:158-185) — per-token traffic
+    # is one full read of the bf16 weights plus the KV windows, at 60% of
+    # A100-40GB peak bandwidth (1.555 TB/s; the train baseline's analog of
+    # "peak x 40% MFU", but for a bandwidth-bound phase)
+    n_params = sum(p.size for p in jax.tree.leaves(params))
+    dsize = jnp.dtype(dtype).itemsize
+    ca_window = config.max_seq_len * 2 * config.num_channels * dsize
+    sa_windows = (
+        config.num_self_attention_layers * config.max_latents * 2 * config.num_channels * dsize
+    )
+    step_bytes = n_params * dsize + b * (ca_window + sa_windows)
+    a100_step_time = step_bytes / (1.555e12 * 0.60)
+
     result = {
         "metric": f"perceiver-ar-clm decode tokens/sec @{args.seq_len} ctx "
         f"(full sliding-window KV cache, {args.dtype}, batch {b})",
         "value": round(b / per_token, 1),
         "unit": "tokens/sec",
-        "vs_baseline": None,
+        # both sides are one decode step (b tokens)
+        "vs_baseline": round(a100_step_time / per_token, 3),
     }
     print(json.dumps(result))
 
@@ -244,12 +260,20 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--seq-len", type=int, default=16384)
     p.add_argument("--latents", type=int, default=1024)
-    p.add_argument("--batch-size", type=int, default=1)
+    # batch 4 is the single-chip throughput sweet spot for the train mode
+    # (per-sample fwd+bwd grows slightly with batch while the fixed
+    # optimizer/loss cost amortizes — measured b=1: 2.38M, b=4: 2.76M,
+    # b=8: ~2.5M tok/s; docs/performance.md). The A100 analytic baseline
+    # scales with batch, so vs_baseline stays batch-fair.
+    p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--steps", type=int, default=50)
     p.add_argument("--dtype", default="bfloat16")
     p.add_argument("--remat", action="store_true", help="activation checkpointing (needed for large seq/batch)")
     p.add_argument("--mode", choices=["train", "decode", "img"], default="train")
     args = p.parse_args()
+
+    if args.batch_size is None:
+        args.batch_size = 4 if args.mode == "train" else 1
 
     if args.mode == "decode":
         return decode_bench(args)
@@ -296,7 +320,7 @@ def main():
 
     result = {
         "metric": f"perceiver-ar-clm train tokens/sec/chip @{args.seq_len} ctx "
-        f"({n_params/1e6:.1f}M params, {args.dtype}, prefix_len={prefix_len})",
+        f"({n_params/1e6:.1f}M params, {args.dtype}, batch {b}, prefix_len={prefix_len})",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/sec/chip",
         "vs_baseline": round(vs_baseline, 3),

@@ -91,7 +91,9 @@ def main(argv: Optional[Sequence[str]] = None):
             "data.max_seq_len": 256,
             "data.batch_size": 32,
             "trainer.max_steps": 600,
-            "trainer.val_interval": 100,
+            # dense early validation: the big descent (uniform ~5.6 nats to
+            # the output-marginal ~2.8) happens inside the first 100 steps
+            "trainer.val_interval": 50,
             "trainer.name": "mlm_smoke",
             "optimizer.warmup_steps": 50,
         },

@@ -93,6 +93,15 @@ def main(argv: Optional[Sequence[str]] = None):
             "trainer.max_steps": 500,
             "trainer.val_interval": 100,
             "trainer.name": "img_clf_smoke",
+            # the CLI's 500-step warmup default would span the whole smoke run
+            "optimizer.warmup_steps": 50,
+            # at init_scale 0.02 the single-head encoder cross-attention stays
+            # uniform for thousands of steps and the logits are effectively
+            # input-independent — measured on the reference torch backend too
+            # (same freeze at the label-prior loss). 0.1 unlocks learning in
+            # smoke-run time; the non-smoke default keeps reference parity.
+            "model.encoder.init_scale": 0.1,
+            "model.decoder.init_scale": 0.1,
         },
     )
     args = cli.parse_args(parser, argv)

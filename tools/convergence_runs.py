@@ -24,13 +24,13 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 TASKS = {
-    # module, extra args, metric of interest, target ("lt" = less-than)
-    "clm": ("perceiver_io_tpu.scripts.text.clm", [], "val_loss", None),
-    "mlm": ("perceiver_io_tpu.scripts.text.mlm", [], "val_loss", None),
-    "txt_clf": ("perceiver_io_tpu.scripts.text.classifier", [], "val_acc", None),
-    "img_clf": ("perceiver_io_tpu.scripts.vision.image_classifier", [], "val_acc", None),
-    "sam": ("perceiver_io_tpu.scripts.audio.symbolic", [], "val_loss", None),
-    "timeseries": ("perceiver_io_tpu.scripts.timeseries", [], "val_loss", None),
+    # module, extra args, metric of interest
+    "clm": ("perceiver_io_tpu.scripts.text.clm", [], "val_loss"),
+    "mlm": ("perceiver_io_tpu.scripts.text.mlm", [], "val_loss"),
+    "txt_clf": ("perceiver_io_tpu.scripts.text.classifier", [], "val_acc"),
+    "img_clf": ("perceiver_io_tpu.scripts.vision.image_classifier", [], "val_acc"),
+    "sam": ("perceiver_io_tpu.scripts.audio.symbolic", [], "val_loss"),
+    "timeseries": ("perceiver_io_tpu.scripts.timeseries", [], "val_loss"),
 }
 
 RUNNER = """
@@ -43,43 +43,51 @@ mod.main({argv!r})
 
 
 def run_task(name: str, out_dir: str, platform: str) -> dict:
-    module, extra, metric, _ = TASKS[name]
+    module, extra, metric = TASKS[name]
     root = tempfile.mkdtemp(prefix=f"smoke_{name}_")
-    argv = [
-        "fit",
-        "--smoke",
-        f"--trainer.default_root_dir={root}",
-        f"--trainer.name={name}",
-        "--trainer.checkpoint=false",
-    ] + extra
-    t0 = time.time()
-    proc = subprocess.run(
-        [sys.executable, "-c", RUNNER.format(platform=platform, module=module, argv=argv)],
-        cwd=REPO,
-        capture_output=True,
-        text=True,
-    )
-    wall = time.time() - t0
-    if proc.returncode != 0:
-        raise RuntimeError(f"{name} failed:\n{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}")
-    src = os.path.join(root, name, "metrics.csv")
-    dst = os.path.join(out_dir, f"{name}.csv")
-    shutil.copy(src, dst)
+    try:
+        argv = [
+            "fit",
+            "--smoke",
+            f"--trainer.default_root_dir={root}",
+            f"--trainer.name={name}",
+            "--trainer.checkpoint=false",
+        ] + extra
+        t0 = time.time()
+        proc = subprocess.run(
+            [sys.executable, "-c", RUNNER.format(platform=platform, module=module, argv=argv)],
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+        )
+        wall = time.time() - t0
+        if proc.returncode != 0:
+            raise RuntimeError(f"{name} failed:\n{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}")
+        src = os.path.join(root, name, "metrics.csv")
+        dst = os.path.join(out_dir, f"{name}.csv")
+        shutil.copy(src, dst)
 
-    rows = list(csv.DictReader(open(dst)))
-    series = [(int(r["step"]), float(r[metric])) for r in rows if r.get(metric)]
-    first, last = series[0], series[-1]
-    summary = {
-        "task": name,
-        "metric": metric,
-        "first": {"step": first[0], "value": round(first[1], 4)},
-        "final": {"step": last[0], "value": round(last[1], 4)},
-        "minutes": round(wall / 60, 1),
-    }
-    if metric == "val_loss" and name in ("clm", "mlm", "sam"):
-        summary["final_bits_per_token"] = round(last[1] / math.log(2), 3)
-    shutil.rmtree(root, ignore_errors=True)
-    return summary
+        with open(dst) as f:
+            rows = list(csv.DictReader(f))
+        series = [(int(r["step"]), float(r[metric])) for r in rows if r.get(metric)]
+        if not series:
+            raise RuntimeError(
+                f"{name}: no '{metric}' values in metrics.csv "
+                f"(columns: {list(rows[0]) if rows else 'none'}) — did validation run?"
+            )
+        first, last = series[0], series[-1]
+        summary = {
+            "task": name,
+            "metric": metric,
+            "first": {"step": first[0], "value": round(first[1], 4)},
+            "final": {"step": last[0], "value": round(last[1], 4)},
+            "minutes": round(wall / 60, 1),
+        }
+        if metric == "val_loss" and name in ("clm", "mlm", "sam"):
+            summary["final_bits_per_token"] = round(last[1] / math.log(2), 3)
+        return summary
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
 
 
 def main():

@@ -1,0 +1,117 @@
+"""Same-process interleaved A/B of attention-path policies on the flagship
+train step. Cross-process comparisons are untrustworthy on this chip (clock
+drifts 1.5-1.8x between burst and sustained); here every variant is traced in
+ONE process and the slope measurements interleave A/B/C round-robin so drift
+hits all variants equally.
+
+Variants: all-flash, auto policy (SA einsum + CA flash), all-einsum.
+
+    python tools/flash_ab.py [--batch-size 1] [--steps 20]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bench import flagship_config
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_probe_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--seq-len", type=int, default=16384)
+    p.add_argument("--latents", type=int, default=1024)
+    p.add_argument("--batch-size", type=int, default=1)
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--reps", type=int, default=4)
+    p.add_argument("--variants", nargs="*", default=["flash", "auto", "einsum"])
+    args = p.parse_args()
+
+    from perceiver_io_tpu.models.text import CausalLanguageModel
+    from perceiver_io_tpu.ops.flash_attention import set_default_flash
+    from perceiver_io_tpu.training import TrainState, clm_loss_fn, make_optimizer
+    from perceiver_io_tpu.training.loop import make_train_step
+
+    config = flagship_config(args.seq_len, args.latents)
+    model = CausalLanguageModel(config, dtype=jnp.bfloat16)
+
+    b, n = args.batch_size, args.seq_len
+    rng = np.random.default_rng(0)
+    t = rng.integers(0, config.vocab_size, size=(b, n + 1))
+    batch = {
+        "labels": jnp.asarray(t[:, 1:]),
+        "input_ids": jnp.asarray(t[:, :-1]),
+        "pad_mask": None,
+    }
+    params = model.init(jax.random.PRNGKey(0), batch["input_ids"][:, : args.latents + 1], prefix_len=1)
+    tx = make_optimizer(1e-3, gradient_clip=1.0)
+    state = TrainState.create(model.apply, params, tx, jax.random.PRNGKey(1))
+    step = make_train_step(clm_loss_fn(model.apply, max_latents=args.latents), jit=False)
+
+    def make_run():
+        # fresh jit wrapper per variant: the flash default is read at trace
+        # time, so each variant's traces are pinned at compile below
+        @functools.partial(jax.jit, static_argnums=2)
+        def run(state, batch, k):
+            def body(c, i):
+                l, s = c
+                s, metrics = step(s, batch)
+                return (l + metrics["loss"], s), ()
+
+            (l, _), _ = jax.lax.scan(body, (jnp.float32(0), state), jnp.arange(k))
+            return l
+
+        return lambda k: float(run(state, batch, k))
+
+    modes = {"flash": True, "auto": None, "einsum": False}
+    n_short, n_long = 2, 2 + args.steps
+    runs = {}
+    for name in args.variants:
+        set_default_flash(modes[name])
+        runs[name] = make_run()
+        t0 = time.perf_counter()
+        runs[name](n_short)  # compile short
+        runs[name](n_long)  # compile long
+        print(f"{name}: compiled in {time.perf_counter() - t0:.0f}s", flush=True)
+    set_default_flash(None)
+
+    # interleaved slope estimates: visit variants round-robin inside each rep
+    times = {v: {"s": float("inf"), "l": float("inf")} for v in args.variants}
+    slopes = {v: [] for v in args.variants}
+    for est in range(3):
+        for v in args.variants:
+            times[v] = {"s": float("inf"), "l": float("inf")}
+        for _ in range(args.reps):
+            for v in args.variants:
+                t0 = time.perf_counter()
+                runs[v](n_short)
+                times[v]["s"] = min(times[v]["s"], time.perf_counter() - t0)
+                t0 = time.perf_counter()
+                runs[v](n_long)
+                times[v]["l"] = min(times[v]["l"], time.perf_counter() - t0)
+        for v in args.variants:
+            s = (times[v]["l"] - times[v]["s"]) / (n_long - n_short)
+            if s > 0:
+                slopes[v].append(s)
+
+    print(f"{'variant':<8} {'ms/step':>8} {'tok/s':>12}")
+    for v in args.variants:
+        ss = sorted(slopes[v])
+        med = (ss[(len(ss) - 1) // 2] + ss[len(ss) // 2]) / 2
+        print(f"{v:<8} {med * 1e3:8.3f} {b * n / med:12.0f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -64,7 +64,40 @@ def main():
     p.add_argument("--batch-size", type=int, default=1)
     p.add_argument("--steps", type=int, default=40)
     p.add_argument("--only", nargs="*", default=None)
+    p.add_argument(
+        "--sa-einsum",
+        action="store_true",
+        help="A/B: self-attention (nq==nkv) on the einsum path, CA stays flash",
+    )
+    p.add_argument("--no-flash", action="store_true", help="A/B: einsum everywhere")
+    p.add_argument("--block-q", type=int, default=None, help="A/B: flash block_q override")
+    p.add_argument("--block-kv", type=int, default=None, help="A/B: flash block_kv override")
     args = p.parse_args()
+
+    if args.block_q or args.block_kv:
+        import functools as _ft
+
+        from perceiver_io_tpu.core import attention as _attn2
+        from perceiver_io_tpu.ops.flash_attention import flash_attention as _fa
+
+        kw = {}
+        if args.block_q:
+            kw["block_q"] = args.block_q
+        if args.block_kv:
+            kw["block_kv"] = args.block_kv
+        _attn2.flash_attention = _ft.partial(_fa, **kw)
+
+    if args.sa_einsum:
+        from perceiver_io_tpu.core import attention as _attn
+
+        orig_supported = _attn.flash_supported
+        _attn.flash_supported = (
+            lambda nq, nkv, dqk, dv, drop: False if nq == nkv else orig_supported(nq, nkv, dqk, dv, drop)
+        )
+    if args.no_flash:
+        from perceiver_io_tpu.ops.flash_attention import set_default_flash
+
+        set_default_flash(False)
 
     from perceiver_io_tpu.models.text import CausalLanguageModel
     from perceiver_io_tpu.training import TrainState, clm_loss_fn, make_optimizer
