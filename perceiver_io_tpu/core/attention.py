@@ -42,7 +42,13 @@ from flax import struct
 from jax import lax
 
 from perceiver_io_tpu.core.position import apply_rotary_pos_emb
-from perceiver_io_tpu.ops.flash_attention import flash_attention, flash_enabled, flash_supported
+from perceiver_io_tpu.ops.flash_attention import (
+    flash_attention,
+    flash_attention_packed,
+    flash_enabled,
+    flash_supported,
+    packed_supported,
+)
 
 
 @struct.dataclass
@@ -203,6 +209,37 @@ class MultiHeadAttention(nn.Module):
         k = self.k_proj(x_kv)
         v = self.v_proj(x_kv)
 
+        # Packed slots-major fused path: operands stay in the (B, N, H*D)
+        # projection layout — the heads-major kernels below force a
+        # materialized head transpose of every input/output (~3 ms/step of
+        # layout copies at the 16k flagship, batch 4, profiled).
+        dropout_active = self.dropout > 0.0 and not deterministic
+        if (
+            kv_cache is None
+            and flash_enabled(self.use_flash)
+            and packed_supported(h, qk_per_head, self.v_channels // h)
+            and flash_supported(
+                n_q, x_kv.shape[1], qk_per_head, self.v_channels // h, dropout_active
+            )
+        ):
+            q4 = q.reshape(q.shape[0], n_q, h, qk_per_head) * qk_per_head**-0.5
+            if rope_q is not None:
+                q4 = apply_rotary_pos_emb(q4, rope_q[:, :, None, :])
+            if rope_k is not None:
+                k4 = k.reshape(k.shape[0], k.shape[1], h, qk_per_head)
+                k4 = apply_rotary_pos_emb(k4, rope_k[:, :, None, :])
+                k = k4.reshape(k.shape)
+            o = flash_attention_packed(
+                q4.reshape(q.shape),
+                k,
+                v,
+                num_heads=h,
+                pad_mask=pad_mask,
+                causal=self.causal_attention,
+                sm_scale=1.0,
+            )
+            return AttentionOutput(last_hidden_state=self.o_proj(o), kv_cache=None)
+
         if kv_cache is not None:
             # rotate-at-write (see module docstring): new keys carry their
             # absolute-position rotation into the cache; cached keys are
@@ -250,13 +287,13 @@ class MultiHeadAttention(nn.Module):
         if rope_k is not None and kv_cache is None:
             k_h = apply_rotary_pos_emb(k_h, rope_k[:, None, :, :])
 
-        # Fused blockwise path (Pallas flash attention): no cache, no active
-        # attention-prob dropout. The kernel's right-aligned causal mask is
-        # identical to the mask construction below when the cache is absent.
-        # (A size-based "einsum for short kv" policy was measured and
-        # rejected: interleaved same-process A/B at the 16k flagship showed
-        # all-flash fastest at batch 4 — see docs/performance.md.)
-        dropout_active = self.dropout > 0.0 and not deterministic
+        # Heads-major fused path — the fallback for shapes the packed layout
+        # cannot tile (odd head dims): no cache, no active attention-prob
+        # dropout. The kernel's right-aligned causal mask is identical to the
+        # mask construction below when the cache is absent. (A size-based
+        # "einsum for short kv" policy was measured and rejected: interleaved
+        # same-process A/B at the 16k flagship showed all-flash fastest at
+        # batch 4 — see docs/performance.md.)
         if (
             kv_cache is None
             and flash_enabled(self.use_flash)

@@ -432,6 +432,415 @@ def _flash_bwd(causal, offset, sm_scale, block_q, block_kv, num_heads, residuals
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+# ---------------------------------------------------------------------------
+# packed (slots-major) path
+# ---------------------------------------------------------------------------
+#
+# The heads-major kernels above receive (B*H, N, D) operands, which forces a
+# materialized (B, N, H, D) -> (B, H, N, D) transpose of every input and
+# output around each kernel (profiled ~3 ms/step of layout copies at the 16k
+# flagship, batch 4). The packed kernels instead take tensors in their
+# NATURAL projection layout (B, N, H*D) — block rows are contiguous, so the
+# DMA needs no transpose at all — and iterate heads inside the kernel over
+# cheap VMEM minor-dim slices. Head dims must be multiples of 8 (no per-head
+# zero padding is possible in a packed minor dim); other shapes use the
+# heads-major path.
+
+
+def _fwd_packed_kernel(
+    bias_ref,  # (1, 1, block_kv) f32
+    q_ref,  # (1, block_q, h*d_qk)
+    k_ref,  # (1, block_kv, h*d_qk)
+    v_ref,  # (1, block_kv, h*d_v)
+    o_ref,  # (1, block_q, h*d_v)
+    lse_ref,  # (1, block_q, h*LANES) f32
+    m_scr,  # (h, block_q, LANES) f32
+    l_scr,  # (h, block_q, LANES) f32
+    acc_scr,  # (h, block_q, d_v) f32
+    *,
+    causal: bool,
+    offset: int,
+    sm_scale: float,
+    num_kv_blocks: int,
+    num_heads: int,
+    d_qk: int,
+    d_v: int,
+):
+    iq, ikv = pl.program_id(1), pl.program_id(2)
+    h = num_heads
+    block_q = q_ref.shape[1]
+    block_kv = k_ref.shape[1]
+
+    @pl.when(ikv == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    def _body():
+        # per-head minor-dim slices: Mosaic supports static lane slices but
+        # not the (block, h*d) -> (block, h, d) vector reshape
+        bias = bias_ref[0]
+        keep = None
+        if causal:
+            keep = _right_aligned_mask(block_q, block_kv, iq, ikv, block_q, block_kv, offset)
+        for hh in range(h):
+            qh = q_ref[0, :, hh * d_qk : (hh + 1) * d_qk]
+            kh = k_ref[0, :, hh * d_qk : (hh + 1) * d_qk]
+            vh = v_ref[0, :, hh * d_v : (hh + 1) * d_v]
+            s = _dot(qh, kh, ((1,), (1,)))
+            s = s * sm_scale + bias
+            if causal:
+                s = jnp.where(keep, s, MASK_VALUE)
+            m_prev = m_scr[hh]
+            l_prev = l_scr[hh]
+            m_curr = jnp.max(s, axis=1)[:, None]
+            m_next = jnp.maximum(m_prev, m_curr)
+            p = jnp.exp(s - m_next[:, :1])
+            alpha = jnp.exp(m_prev - m_next)
+            l_scr[hh] = alpha * l_prev + jnp.sum(p, axis=1)[:, None]
+            m_scr[hh] = m_next
+            o_curr = _dot(p.astype(vh.dtype), vh, ((1,), (0,)))
+            acc_scr[hh] = acc_scr[hh] * alpha[:, :1] + o_curr
+
+    if causal:
+        pl.when(_block_visible(iq, ikv, block_q, block_kv, offset))(_body)
+    else:
+        _body()
+
+    @pl.when(ikv == num_kv_blocks - 1)
+    def _store():
+        for hh in range(h):
+            l = l_scr[hh]
+            l_inv = jnp.where(l == 0.0, 1.0, 1.0 / l)
+            o_ref[0, :, hh * d_v : (hh + 1) * d_v] = (
+                acc_scr[hh] * l_inv[:, :1]
+            ).astype(o_ref.dtype)
+            lse_ref[0, :, hh * LANES : (hh + 1) * LANES] = m_scr[hh] + jnp.log(
+                jnp.where(l == 0.0, 1.0, l)
+            )
+
+
+def _dkv_packed_kernel(
+    bias_ref,  # (1, 1, block_kv)
+    q_ref,  # (1, block_q, h*d_qk)
+    k_ref,  # (1, block_kv, h*d_qk)
+    v_ref,  # (1, block_kv, h*d_v)
+    do_ref,  # (1, block_q, h*d_v)
+    lse_ref,  # (1, block_q, h*LANES)
+    delta_ref,  # (1, block_q, h*LANES)
+    dk_ref,  # (1, block_kv, h*d_qk)
+    dv_ref,  # (1, block_kv, h*d_v)
+    dk_scr,  # (h, block_kv, d_qk) f32
+    dv_scr,  # (h, block_kv, d_v) f32
+    *,
+    causal: bool,
+    offset: int,
+    sm_scale: float,
+    num_q_blocks: int,
+    num_heads: int,
+    d_qk: int,
+    d_v: int,
+):
+    ikv, iq = pl.program_id(1), pl.program_id(2)
+    h = num_heads
+    block_kv = k_ref.shape[1]
+    block_q = q_ref.shape[1]
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    def _body():
+        for hh in range(h):
+            qh = q_ref[0, :, hh * d_qk : (hh + 1) * d_qk]
+            kh = k_ref[0, :, hh * d_qk : (hh + 1) * d_qk]
+            vh = v_ref[0, :, hh * d_v : (hh + 1) * d_v]
+            doh = do_ref[0, :, hh * d_v : (hh + 1) * d_v]
+            lse = lse_ref[0, :, hh * LANES : hh * LANES + 1]
+            delta = delta_ref[0, :, hh * LANES : hh * LANES + 1]
+            p = _recompute_p(
+                qh, kh, bias_ref[0], lse, iq, ikv,
+                block_q, block_kv, offset, sm_scale, causal,
+            )
+            dv_scr[hh] += _dot(p.astype(doh.dtype), doh, ((0,), (0,)))
+            dp = _dot(doh, vh, ((1,), (1,)))
+            ds = p * (dp - delta) * sm_scale
+            dk_scr[hh] += _dot(ds.astype(qh.dtype), qh, ((0,), (0,)))
+
+    if causal:
+        pl.when(_block_visible(iq, ikv, block_q, block_kv, offset))(_body)
+    else:
+        _body()
+
+    @pl.when(iq == num_q_blocks - 1)
+    def _store():
+        for hh in range(h):
+            dk_ref[0, :, hh * d_qk : (hh + 1) * d_qk] = dk_scr[hh].astype(dk_ref.dtype)
+            dv_ref[0, :, hh * d_v : (hh + 1) * d_v] = dv_scr[hh].astype(dv_ref.dtype)
+
+
+def _dq_packed_kernel(
+    bias_ref,  # (1, 1, block_kv)
+    q_ref,  # (1, block_q, h*d_qk)
+    k_ref,  # (1, block_kv, h*d_qk)
+    v_ref,  # (1, block_kv, h*d_v)
+    do_ref,  # (1, block_q, h*d_v)
+    lse_ref,  # (1, block_q, h*LANES)
+    delta_ref,  # (1, block_q, h*LANES)
+    dq_ref,  # (1, block_q, h*d_qk)
+    dq_scr,  # (h, block_q, d_qk) f32
+    *,
+    causal: bool,
+    offset: int,
+    sm_scale: float,
+    num_kv_blocks: int,
+    num_heads: int,
+    d_qk: int,
+    d_v: int,
+):
+    iq, ikv = pl.program_id(1), pl.program_id(2)
+    h = num_heads
+    block_q = q_ref.shape[1]
+    block_kv = k_ref.shape[1]
+
+    @pl.when(ikv == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    def _body():
+        for hh in range(h):
+            qh = q_ref[0, :, hh * d_qk : (hh + 1) * d_qk]
+            kh = k_ref[0, :, hh * d_qk : (hh + 1) * d_qk]
+            vh = v_ref[0, :, hh * d_v : (hh + 1) * d_v]
+            doh = do_ref[0, :, hh * d_v : (hh + 1) * d_v]
+            lse = lse_ref[0, :, hh * LANES : hh * LANES + 1]
+            delta = delta_ref[0, :, hh * LANES : hh * LANES + 1]
+            p = _recompute_p(
+                qh, kh, bias_ref[0], lse, iq, ikv,
+                block_q, block_kv, offset, sm_scale, causal,
+            )
+            dp = _dot(doh, vh, ((1,), (1,)))
+            ds = (p * (dp - delta) * sm_scale).astype(kh.dtype)
+            dq_scr[hh] += _dot(ds, kh, ((1,), (0,)))
+
+    if causal:
+        pl.when(_block_visible(iq, ikv, block_q, block_kv, offset))(_body)
+    else:
+        _body()
+
+    @pl.when(ikv == num_kv_blocks - 1)
+    def _store():
+        for hh in range(h):
+            dq_ref[0, :, hh * d_qk : (hh + 1) * d_qk] = dq_scr[hh].astype(dq_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11))
+def _flash_packed(q, k, v, bias, causal, offset, sm_scale, block_q, block_kv, h, d_qk, d_v):
+    out, _ = _flash_packed_fwd_impl(
+        q, k, v, bias, causal, offset, sm_scale, block_q, block_kv, h, d_qk, d_v
+    )
+    return out
+
+
+def _flash_packed_fwd_impl(q, k, v, bias, causal, offset, sm_scale, block_q, block_kv, h, d_qk, d_v):
+    b, nq, _ = q.shape
+    nkv = k.shape[1]
+    grid = (b, nq // block_q, nkv // block_kv)
+
+    out, lse = pl.pallas_call(
+        functools.partial(
+            _fwd_packed_kernel,
+            causal=causal,
+            offset=offset,
+            sm_scale=sm_scale,
+            num_kv_blocks=grid[2],
+            num_heads=h,
+            d_qk=d_qk,
+            d_v=d_v,
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_kv), lambda b_, i, j: (b_, 0, j)),
+            pl.BlockSpec((1, block_q, h * d_qk), lambda b_, i, j: (b_, i, 0)),
+            pl.BlockSpec((1, block_kv, h * d_qk), lambda b_, i, j: (b_, j, 0)),
+            pl.BlockSpec((1, block_kv, h * d_v), lambda b_, i, j: (b_, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, h * d_v), lambda b_, i, j: (b_, i, 0)),
+            pl.BlockSpec((1, block_q, h * LANES), lambda b_, i, j: (b_, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, nq, h * d_v), q.dtype),
+            jax.ShapeDtypeStruct((b, nq, h * LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((h, block_q, LANES), jnp.float32),
+            pltpu.VMEM((h, block_q, LANES), jnp.float32),
+            pltpu.VMEM((h, block_q, d_v), jnp.float32),
+        ],
+        compiler_params=_compiler_params("parallel", "parallel", "arbitrary"),
+        interpret=_interpret_default(),
+    )(bias, q, k, v)
+    return out, lse
+
+
+def _flash_packed_fwd(q, k, v, bias, causal, offset, sm_scale, block_q, block_kv, h, d_qk, d_v):
+    out, lse = _flash_packed_fwd_impl(
+        q, k, v, bias, causal, offset, sm_scale, block_q, block_kv, h, d_qk, d_v
+    )
+    # slim residual: one lane per head (see the heads-major path note)
+    lse_slim = lse.reshape(lse.shape[0], lse.shape[1], h, LANES)[..., :1]
+    return out, (q, k, v, bias, out, lse_slim)
+
+
+def _flash_packed_bwd(causal, offset, sm_scale, block_q, block_kv, h, d_qk, d_v, residuals, g):
+    q, k, v, bias, out, lse_slim = residuals
+    b, nq, _ = q.shape
+    nkv = k.shape[1]
+    if BWD_BLOCK_Q is not None:
+        block_q = min(block_q, BWD_BLOCK_Q)
+    if BWD_BLOCK_KV is not None:
+        block_kv = min(block_kv, BWD_BLOCK_KV)
+
+    lse = jnp.broadcast_to(lse_slim, (b, nq, h, LANES)).reshape(b, nq, h * LANES)
+    # delta_i = sum_c dO_ic O_ic per head; minor-dim reshapes are bitcasts
+    g4 = g.astype(jnp.float32).reshape(b, nq, h, d_v)
+    out4 = out.astype(jnp.float32).reshape(b, nq, h, d_v)
+    delta = jnp.sum(g4 * out4, axis=-1)  # (b, nq, h)
+    delta = jnp.broadcast_to(delta[..., None], (b, nq, h, LANES)).reshape(b, nq, h * LANES)
+
+    nqb, nkvb = nq // block_q, nkv // block_kv
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_packed_kernel,
+            causal=causal,
+            offset=offset,
+            sm_scale=sm_scale,
+            num_q_blocks=nqb,
+            num_heads=h,
+            d_qk=d_qk,
+            d_v=d_v,
+        ),
+        grid=(b, nkvb, nqb),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_kv), lambda b_, j, i: (b_, 0, j)),
+            pl.BlockSpec((1, block_q, h * d_qk), lambda b_, j, i: (b_, i, 0)),
+            pl.BlockSpec((1, block_kv, h * d_qk), lambda b_, j, i: (b_, j, 0)),
+            pl.BlockSpec((1, block_kv, h * d_v), lambda b_, j, i: (b_, j, 0)),
+            pl.BlockSpec((1, block_q, h * d_v), lambda b_, j, i: (b_, i, 0)),
+            pl.BlockSpec((1, block_q, h * LANES), lambda b_, j, i: (b_, i, 0)),
+            pl.BlockSpec((1, block_q, h * LANES), lambda b_, j, i: (b_, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_kv, h * d_qk), lambda b_, j, i: (b_, j, 0)),
+            pl.BlockSpec((1, block_kv, h * d_v), lambda b_, j, i: (b_, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, nkv, h * d_qk), k.dtype),
+            jax.ShapeDtypeStruct((b, nkv, h * d_v), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((h, block_kv, d_qk), jnp.float32),
+            pltpu.VMEM((h, block_kv, d_v), jnp.float32),
+        ],
+        compiler_params=_compiler_params("parallel", "parallel", "arbitrary"),
+        interpret=_interpret_default(),
+    )(bias, q, k, v, g, lse, delta)
+
+    (dq,) = pl.pallas_call(
+        functools.partial(
+            _dq_packed_kernel,
+            causal=causal,
+            offset=offset,
+            sm_scale=sm_scale,
+            num_kv_blocks=nkvb,
+            num_heads=h,
+            d_qk=d_qk,
+            d_v=d_v,
+        ),
+        grid=(b, nqb, nkvb),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_kv), lambda b_, i, j: (b_, 0, j)),
+            pl.BlockSpec((1, block_q, h * d_qk), lambda b_, i, j: (b_, i, 0)),
+            pl.BlockSpec((1, block_kv, h * d_qk), lambda b_, i, j: (b_, j, 0)),
+            pl.BlockSpec((1, block_kv, h * d_v), lambda b_, i, j: (b_, j, 0)),
+            pl.BlockSpec((1, block_q, h * d_v), lambda b_, i, j: (b_, i, 0)),
+            pl.BlockSpec((1, block_q, h * LANES), lambda b_, i, j: (b_, i, 0)),
+            pl.BlockSpec((1, block_q, h * LANES), lambda b_, i, j: (b_, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, h * d_qk), lambda b_, i, j: (b_, i, 0)),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((b, nq, h * d_qk), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((h, block_q, d_qk), jnp.float32)],
+        compiler_params=_compiler_params("parallel", "parallel", "arbitrary"),
+        interpret=_interpret_default(),
+    )(bias, q, k, v, g, lse, delta)
+
+    return dq, dk, dv, jnp.zeros_like(bias)
+
+
+_flash_packed.defvjp(_flash_packed_fwd, _flash_packed_bwd)
+
+
+def packed_supported(num_heads: int, d_qk: int, d_v: int) -> bool:
+    """Head dims must tile cleanly in a packed minor dim (no per-head zero
+    padding is possible there). Size caps live in :func:`flash_supported`,
+    which callers check alongside this."""
+    return d_qk % 8 == 0 and d_v % 8 == 0
+
+
+def flash_attention_packed(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    num_heads: int,
+    pad_mask: Optional[jnp.ndarray] = None,
+    causal: bool = False,
+    sm_scale: float = 1.0,
+    block_q: int = 1024,
+    block_kv: int = 2048,
+) -> jnp.ndarray:
+    """Blockwise fused attention over packed slots-major tensors.
+
+    :param q: queries (B, Nq, H*Dqk), already scaled/rotated.
+    :param k: keys (B, Nkv, H*Dqk), already rotated.
+    :param v: values (B, Nkv, H*Dv).
+    :returns: (B, Nq, H*Dv) in q's dtype — the natural o_proj input layout.
+
+    Semantics identical to :func:`flash_attention`; operands and results stay
+    in the projection layout, so no transpose copies materialize around the
+    kernels.
+    """
+    b, nq, cq = q.shape
+    nkv = k.shape[1]
+    h = num_heads
+    d_qk = cq // h
+    d_v = v.shape[2] // h
+    offset = nkv - nq
+
+    block_q = _choose_block(nq, block_q)
+    block_kv = _choose_block(nkv, block_kv)
+
+    qf = _pad_to(q, 1, block_q)
+    kf = _pad_to(k, 1, block_kv)
+    vf = _pad_to(v, 1, block_kv)
+
+    nkv_p = kf.shape[1]
+    bias = jnp.zeros((b, nkv_p), jnp.float32)
+    if pad_mask is not None:
+        bias = bias.at[:, :nkv].set(jnp.where(pad_mask, MASK_VALUE, 0.0))
+    if nkv_p != nkv:
+        bias = bias.at[:, nkv:].set(MASK_VALUE)
+    bias = bias[:, None, :]
+
+    out = _flash_packed(qf, kf, vf, bias, causal, offset, sm_scale, block_q, block_kv, h, d_qk, d_v)
+    return out[:, :nq, :]
+
+
 def flash_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,

@@ -109,6 +109,9 @@ def main():
     print(f"{'variant':<8} {'ms/step':>8} {'tok/s':>12}")
     for v in args.variants:
         ss = sorted(slopes[v])
+        if not ss:
+            print(f"{v:<8}  all slope estimates non-positive (tunnel stall?) — rerun")
+            continue
         med = (ss[(len(ss) - 1) // 2] + ss[len(ss) // 2]) / 2
         print(f"{v:<8} {med * 1e3:8.3f} {b * n / med:12.0f}")
 

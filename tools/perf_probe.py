@@ -79,13 +79,16 @@ def main():
 
         from perceiver_io_tpu.core import attention as _attn2
         from perceiver_io_tpu.ops.flash_attention import flash_attention as _fa
+        from perceiver_io_tpu.ops.flash_attention import flash_attention_packed as _fap
 
         kw = {}
         if args.block_q:
             kw["block_q"] = args.block_q
         if args.block_kv:
             kw["block_kv"] = args.block_kv
+        # patch BOTH entries: supported shapes route through the packed path
         _attn2.flash_attention = _ft.partial(_fa, **kw)
+        _attn2.flash_attention_packed = _ft.partial(_fap, **kw)
 
     if args.sa_einsum:
         from perceiver_io_tpu.core import attention as _attn
