@@ -769,9 +769,9 @@ class PerceiverAR(nn.Module):
             keep_idx = jnp.sort(keep_idx, axis=-1)
             # gather-backward gather (ops/gathers.py): the scatter-add VJP of
             # this row gather costs ~0.8 ms/step at the 16k flagship (profiled)
-            from perceiver_io_tpu.ops.gathers import gather_unique_rows
+            from perceiver_io_tpu.ops.gathers import gather_rows
 
-            x_prefix = gather_unique_rows(x_prefix, keep_idx)
+            x_prefix = gather_rows(x_prefix, keep_idx)
             frq_prefix = jnp.take_along_axis(frq_prefix, keep_idx[..., None], axis=1)
             if pad_mask is not None:
                 pad_prefix = jnp.take_along_axis(pad_prefix, keep_idx, axis=1)

@@ -788,9 +788,16 @@ _flash_packed.defvjp(_flash_packed_fwd, _flash_packed_bwd)
 
 def packed_supported(num_heads: int, d_qk: int, d_v: int) -> bool:
     """Head dims must tile cleanly in a packed minor dim (no per-head zero
-    padding is possible there). Size caps live in :func:`flash_supported`,
-    which callers check alongside this."""
-    return d_qk % 8 == 0 and d_v % 8 == 0
+    padding is possible there), and the TOTAL packed width is VMEM-bounded:
+    blocks and scratches scale with h*d, so wide many-head configs that are
+    fine per-head on the heads-major path would blow the Mosaic budget
+    packed. (Per-head size caps live in :func:`flash_supported`.)"""
+    return (
+        d_qk % 8 == 0
+        and d_v % 8 == 0
+        and num_heads * d_qk <= 1024
+        and num_heads * d_v <= 1024
+    )
 
 
 def flash_attention_packed(

@@ -21,10 +21,30 @@ Both rewrites below keep the forward untouched and replace only the VJP:
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import dtypes
+
+# shard_map's static varying-mesh-axes inference cannot see through
+# custom_vjp and rejects otherwise-correct out_specs; explicitly sharded
+# paths (parallel/long_context.py) trace with the plain ops instead so the
+# static check stays on.
+_PLAIN_MODE = contextvars.ContextVar("gathers_plain_mode", default=False)
+
+
+@contextlib.contextmanager
+def plain_gathers():
+    """Trace-time escape hatch: fall back to the plain XLA ops (scatter-add
+    backwards) inside the with-block."""
+    token = _PLAIN_MODE.set(True)
+    try:
+        yield
+    finally:
+        _PLAIN_MODE.reset(token)
 
 
 def _int_zero(x):
@@ -67,7 +87,7 @@ SMALL_VOCAB_MAX = 2048
 
 def embed_lookup(table: jnp.ndarray, ids: jnp.ndarray) -> jnp.ndarray:
     """Embedding lookup choosing the matmul-backward path for small tables."""
-    if table.shape[0] <= SMALL_VOCAB_MAX:
+    if table.shape[0] <= SMALL_VOCAB_MAX and not _PLAIN_MODE.get():
         return small_vocab_embed(table, ids)
     return jnp.take(table, ids, axis=0)
 
@@ -102,3 +122,10 @@ def _gur_bwd(res, g):
 
 
 gather_unique_rows.defvjp(_gur_fwd, _gur_bwd)
+
+
+def gather_rows(x: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """`gather_unique_rows` unless tracing inside :func:`plain_gathers`."""
+    if _PLAIN_MODE.get():
+        return jnp.take_along_axis(x, idx[..., None], axis=1)
+    return gather_unique_rows(x, idx)

@@ -92,8 +92,19 @@ def make_seq_parallel_clm_forward(model, mesh: Mesh, *, prefix_len: int, axis_na
                 rng = rest[-1] if has_rng else None
                 return per_device(params, latent_ids, prefix_local, pad, rng)
 
+            # Trace with the plain gather/embed ops (ops/gathers.py): the
+            # custom-VJP rewrites defeat shard_map's static varying-mesh-axes
+            # inference ("possibly varying over {seq}" on replicated grads),
+            # and keeping the static check on is worth more here than the
+            # single-chip scatter optimization.
+            from perceiver_io_tpu.ops.gathers import plain_gathers
+
+            def f_plain(*args, _f=f):
+                with plain_gathers():
+                    return _f(*args)
+
             variants[key] = jax.jit(
-                jax.shard_map(f, mesh=mesh, in_specs=tuple(specs), out_specs=P())
+                jax.shard_map(f_plain, mesh=mesh, in_specs=tuple(specs), out_specs=P())
             )
         return variants[key]
 

@@ -89,7 +89,7 @@ def test_packed_single_head_wide():
     k = jnp.asarray(rng.normal(size=(1, 256, 136)), jnp.float32)
     v = jnp.asarray(rng.normal(size=(1, 256, 136)), jnp.float32)
     got = flash_attention_packed(q, k, v, num_heads=1, block_q=128, block_kv=128)
-    ref = flash_attention(q[:, None][:, :, :, :].reshape(1, 1, 128, 136),
+    ref = flash_attention(q.reshape(1, 1, 128, 136),
                           k.reshape(1, 1, 256, 136), v.reshape(1, 1, 256, 136),
                           block_q=128, block_kv=128)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref[0].transpose(1, 0, 2).reshape(1, 128, 136)), atol=2e-5)
