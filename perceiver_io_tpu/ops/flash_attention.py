@@ -42,6 +42,11 @@ from jax.experimental.pallas import tpu as pltpu
 
 MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
 LANES = 128
+# Residual lane width for the packed kernels' lse/delta side-channels: only
+# one lane per head carries information, but a few lanes keep the tiles
+# loadable; 8 instead of 128 cuts ~250 MB/step of backward residual traffic
+# at the 16k flagship (batch 4).
+RES_LANES = 8
 
 # Mosaic scoped-VMEM budget. The default 16MB rejects the block sizes that
 # actually run fastest on v5e (measured: block_kv=2048 is ~3x faster than
@@ -453,7 +458,7 @@ def _fwd_packed_kernel(
     k_ref,  # (1, block_kv, h*d_qk)
     v_ref,  # (1, block_kv, h*d_v)
     o_ref,  # (1, block_q, h*d_v)
-    lse_ref,  # (1, block_q, h*LANES) f32
+    lse_ref,  # (1, block_q, h*RES_LANES) f32
     m_scr,  # (h, block_q, LANES) f32
     l_scr,  # (h, block_q, LANES) f32
     acc_scr,  # (h, block_q, d_v) f32
@@ -516,9 +521,9 @@ def _fwd_packed_kernel(
             o_ref[0, :, hh * d_v : (hh + 1) * d_v] = (
                 acc_scr[hh] * l_inv[:, :1]
             ).astype(o_ref.dtype)
-            lse_ref[0, :, hh * LANES : (hh + 1) * LANES] = m_scr[hh] + jnp.log(
-                jnp.where(l == 0.0, 1.0, l)
-            )
+            lse_ref[0, :, hh * RES_LANES : (hh + 1) * RES_LANES] = (
+                m_scr[hh] + jnp.log(jnp.where(l == 0.0, 1.0, l))
+            )[:, :RES_LANES]
 
 
 def _dkv_packed_kernel(
@@ -527,8 +532,8 @@ def _dkv_packed_kernel(
     k_ref,  # (1, block_kv, h*d_qk)
     v_ref,  # (1, block_kv, h*d_v)
     do_ref,  # (1, block_q, h*d_v)
-    lse_ref,  # (1, block_q, h*LANES)
-    delta_ref,  # (1, block_q, h*LANES)
+    lse_ref,  # (1, block_q, h*RES_LANES)
+    delta_ref,  # (1, block_q, h*RES_LANES)
     dk_ref,  # (1, block_kv, h*d_qk)
     dv_ref,  # (1, block_kv, h*d_v)
     dk_scr,  # (h, block_kv, d_qk) f32
@@ -558,8 +563,8 @@ def _dkv_packed_kernel(
             kh = k_ref[0, :, hh * d_qk : (hh + 1) * d_qk]
             vh = v_ref[0, :, hh * d_v : (hh + 1) * d_v]
             doh = do_ref[0, :, hh * d_v : (hh + 1) * d_v]
-            lse = lse_ref[0, :, hh * LANES : hh * LANES + 1]
-            delta = delta_ref[0, :, hh * LANES : hh * LANES + 1]
+            lse = lse_ref[0, :, hh * RES_LANES : hh * RES_LANES + 1]
+            delta = delta_ref[0, :, hh * RES_LANES : hh * RES_LANES + 1]
             p = _recompute_p(
                 qh, kh, bias_ref[0], lse, iq, ikv,
                 block_q, block_kv, offset, sm_scale, causal,
@@ -587,8 +592,8 @@ def _dq_packed_kernel(
     k_ref,  # (1, block_kv, h*d_qk)
     v_ref,  # (1, block_kv, h*d_v)
     do_ref,  # (1, block_q, h*d_v)
-    lse_ref,  # (1, block_q, h*LANES)
-    delta_ref,  # (1, block_q, h*LANES)
+    lse_ref,  # (1, block_q, h*RES_LANES)
+    delta_ref,  # (1, block_q, h*RES_LANES)
     dq_ref,  # (1, block_q, h*d_qk)
     dq_scr,  # (h, block_q, d_qk) f32
     *,
@@ -615,8 +620,8 @@ def _dq_packed_kernel(
             kh = k_ref[0, :, hh * d_qk : (hh + 1) * d_qk]
             vh = v_ref[0, :, hh * d_v : (hh + 1) * d_v]
             doh = do_ref[0, :, hh * d_v : (hh + 1) * d_v]
-            lse = lse_ref[0, :, hh * LANES : hh * LANES + 1]
-            delta = delta_ref[0, :, hh * LANES : hh * LANES + 1]
+            lse = lse_ref[0, :, hh * RES_LANES : hh * RES_LANES + 1]
+            delta = delta_ref[0, :, hh * RES_LANES : hh * RES_LANES + 1]
             p = _recompute_p(
                 qh, kh, bias_ref[0], lse, iq, ikv,
                 block_q, block_kv, offset, sm_scale, causal,
@@ -669,11 +674,11 @@ def _flash_packed_fwd_impl(q, k, v, bias, causal, offset, sm_scale, block_q, blo
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, h * d_v), lambda b_, i, j: (b_, i, 0)),
-            pl.BlockSpec((1, block_q, h * LANES), lambda b_, i, j: (b_, i, 0)),
+            pl.BlockSpec((1, block_q, h * RES_LANES), lambda b_, i, j: (b_, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, nq, h * d_v), q.dtype),
-            jax.ShapeDtypeStruct((b, nq, h * LANES), jnp.float32),
+            jax.ShapeDtypeStruct((b, nq, h * RES_LANES), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((h, block_q, LANES), jnp.float32),
@@ -691,7 +696,7 @@ def _flash_packed_fwd(q, k, v, bias, causal, offset, sm_scale, block_q, block_kv
         q, k, v, bias, causal, offset, sm_scale, block_q, block_kv, h, d_qk, d_v
     )
     # slim residual: one lane per head (see the heads-major path note)
-    lse_slim = lse.reshape(lse.shape[0], lse.shape[1], h, LANES)[..., :1]
+    lse_slim = lse.reshape(lse.shape[0], lse.shape[1], h, RES_LANES)[..., :1]
     return out, (q, k, v, bias, out, lse_slim)
 
 
@@ -704,12 +709,12 @@ def _flash_packed_bwd(causal, offset, sm_scale, block_q, block_kv, h, d_qk, d_v,
     if BWD_BLOCK_KV is not None:
         block_kv = min(block_kv, BWD_BLOCK_KV)
 
-    lse = jnp.broadcast_to(lse_slim, (b, nq, h, LANES)).reshape(b, nq, h * LANES)
+    lse = jnp.broadcast_to(lse_slim, (b, nq, h, RES_LANES)).reshape(b, nq, h * RES_LANES)
     # delta_i = sum_c dO_ic O_ic per head; minor-dim reshapes are bitcasts
     g4 = g.astype(jnp.float32).reshape(b, nq, h, d_v)
     out4 = out.astype(jnp.float32).reshape(b, nq, h, d_v)
     delta = jnp.sum(g4 * out4, axis=-1)  # (b, nq, h)
-    delta = jnp.broadcast_to(delta[..., None], (b, nq, h, LANES)).reshape(b, nq, h * LANES)
+    delta = jnp.broadcast_to(delta[..., None], (b, nq, h, RES_LANES)).reshape(b, nq, h * RES_LANES)
 
     nqb, nkvb = nq // block_q, nkv // block_kv
 
@@ -731,8 +736,8 @@ def _flash_packed_bwd(causal, offset, sm_scale, block_q, block_kv, h, d_qk, d_v,
             pl.BlockSpec((1, block_kv, h * d_qk), lambda b_, j, i: (b_, j, 0)),
             pl.BlockSpec((1, block_kv, h * d_v), lambda b_, j, i: (b_, j, 0)),
             pl.BlockSpec((1, block_q, h * d_v), lambda b_, j, i: (b_, i, 0)),
-            pl.BlockSpec((1, block_q, h * LANES), lambda b_, j, i: (b_, i, 0)),
-            pl.BlockSpec((1, block_q, h * LANES), lambda b_, j, i: (b_, i, 0)),
+            pl.BlockSpec((1, block_q, h * RES_LANES), lambda b_, j, i: (b_, i, 0)),
+            pl.BlockSpec((1, block_q, h * RES_LANES), lambda b_, j, i: (b_, i, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_kv, h * d_qk), lambda b_, j, i: (b_, j, 0)),
@@ -768,8 +773,8 @@ def _flash_packed_bwd(causal, offset, sm_scale, block_q, block_kv, h, d_qk, d_v,
             pl.BlockSpec((1, block_kv, h * d_qk), lambda b_, i, j: (b_, j, 0)),
             pl.BlockSpec((1, block_kv, h * d_v), lambda b_, i, j: (b_, j, 0)),
             pl.BlockSpec((1, block_q, h * d_v), lambda b_, i, j: (b_, i, 0)),
-            pl.BlockSpec((1, block_q, h * LANES), lambda b_, i, j: (b_, i, 0)),
-            pl.BlockSpec((1, block_q, h * LANES), lambda b_, i, j: (b_, i, 0)),
+            pl.BlockSpec((1, block_q, h * RES_LANES), lambda b_, i, j: (b_, i, 0)),
+            pl.BlockSpec((1, block_q, h * RES_LANES), lambda b_, i, j: (b_, i, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, h * d_qk), lambda b_, i, j: (b_, i, 0)),
