@@ -1,0 +1,252 @@
+"""Golden numerical-parity tests against the reference's ACTUAL torch code.
+
+The reference package at /root/reference is imported directly (its only
+unavailable dependency, fairscale, is stubbed — the single used symbol
+``checkpoint_wrapper`` (reference: perceiver/model/core/modules.py:5,933-956)
+is an identity outside activation checkpointing, which these tests do not
+enable). A tiny reference ``CausalSequenceModel`` is instantiated in torch,
+its ``state_dict`` imported through ``hf/lightning_ckpt.py``, and logits and
+gradients are compared across the semantics SURVEY §7.3 calls "easy to get
+silently wrong":
+
+- plain forward (several prefix lengths)
+- left-padded batch (position shift, reference: position.py:9-17)
+- prefix-dropout forward under a FIXED keep-set
+  (reference: modules.py:809-830)
+- cached decode (reference decode loop: core/huggingface.py:158-185)
+- full gradient tree (every parameter leaf, compared in torch naming via the
+  export mapping)
+
+Unlike tests/test_lightning_import.py (a naming contract over synthesized
+state dicts), these run the reference's own forward/backward — a shared
+misreading of the reference's semantics cannot pass here.
+"""
+
+from __future__ import annotations
+
+import sys
+import types
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax
+import jax.numpy as jnp
+
+pytestmark = pytest.mark.slow
+
+REFERENCE_PATH = "/root/reference"
+
+
+@pytest.fixture(scope="module")
+def ref():
+    """Import the reference package with a fairscale identity stub."""
+    if "fairscale" not in sys.modules:
+        fairscale = types.ModuleType("fairscale")
+        fairscale_nn = types.ModuleType("fairscale.nn")
+        fairscale_nn.checkpoint_wrapper = lambda module, *a, **k: module
+        fairscale.nn = fairscale_nn
+        sys.modules["fairscale"] = fairscale
+        sys.modules["fairscale.nn"] = fairscale_nn
+    if REFERENCE_PATH not in sys.path:
+        sys.path.insert(0, REFERENCE_PATH)
+    import perceiver.model.core as pmc
+
+    return pmc
+
+
+GEOM = dict(
+    vocab_size=262,
+    max_seq_len=64,
+    max_latents=16,
+    num_channels=32,
+    num_heads=4,
+    num_self_attention_layers=2,
+    num_self_attention_rotary_layers=1,
+    cross_attention_dropout=0.5,
+    output_norm=True,
+    output_bias=True,
+    abs_pos_emb=True,
+)
+
+
+@pytest.fixture(scope="module")
+def golden_pair(ref):
+    """(reference torch model, our model, our variables) with identical
+    weights, imported through the production ``.ckpt`` mapping."""
+    from perceiver_io_tpu.hf.lightning_ckpt import causal_sequence_model_params
+    from perceiver_io_tpu.models.text import CausalLanguageModel, CausalLanguageModelConfig
+
+    torch.manual_seed(0)
+    ref_config = ref.CausalSequenceModelConfig.create(**GEOM)
+    ref_model = ref.CausalSequenceModel(ref_config).eval()
+
+    sd = {k: v for k, v in ref_model.state_dict().items()}
+    variables = {"params": causal_sequence_model_params(sd)}
+
+    config = CausalLanguageModelConfig.create(**GEOM)
+    model = CausalLanguageModel(config, dtype=jnp.float32)
+    return ref_model, model, variables
+
+
+def _tokens(b, n, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, GEOM["vocab_size"], size=(b, n))
+
+
+@pytest.mark.parametrize("prefix_len", [0, 17, 48])
+def test_plain_forward_logits_match(golden_pair, prefix_len):
+    ref_model, model, variables = golden_pair
+    x = _tokens(2, 64)
+
+    with torch.no_grad():
+        ref_out = ref_model(torch.from_numpy(x), prefix_len=prefix_len)
+    got = model.apply(variables, jnp.asarray(x), prefix_len=prefix_len)
+
+    ref_logits = ref_out.logits.numpy()
+    assert got.logits.shape == ref_logits.shape  # (2, 64 - prefix_len, 262)
+    np.testing.assert_allclose(np.asarray(got.logits), ref_logits, atol=2e-4, rtol=2e-4)
+
+
+def test_left_padded_batch_matches(golden_pair):
+    ref_model, model, variables = golden_pair
+    b, n, prefix_len = 2, 64, 40
+    x = _tokens(b, n, seed=1)
+    # row 0: 7 pad slots, row 1: none — both left-aligned as the reference
+    # requires ("caller must ensure that x is left-padded", modules.py:780)
+    pad = np.zeros((b, n), bool)
+    pad[0, :7] = True
+
+    with torch.no_grad():
+        ref_out = ref_model(
+            torch.from_numpy(x), prefix_len=prefix_len, pad_mask=torch.from_numpy(pad)
+        )
+    got = model.apply(
+        variables, jnp.asarray(x), prefix_len=prefix_len, pad_mask=jnp.asarray(pad)
+    )
+    np.testing.assert_allclose(
+        np.asarray(got.logits), ref_out.logits.numpy(), atol=2e-4, rtol=2e-4
+    )
+
+
+def test_prefix_dropout_fixed_keepset_matches(golden_pair, monkeypatch):
+    """Training-mode prefix dropout with both frameworks forced onto the SAME
+    uniform draw: the reference's topk/scatter gather (modules.py:809-830)
+    and our static-count top_k + sorted gather must select the same kept
+    prefix in the same order and produce identical logits."""
+    ref_model, model, variables = golden_pair
+    b, n, prefix_len = 2, 64, 48
+    x = _tokens(b, n, seed=2)
+    rand = np.random.default_rng(3).random((b, prefix_len)).astype(np.float32)
+
+    monkeypatch.setattr(torch, "rand", lambda *a, **k: torch.from_numpy(rand))
+    ref_model.train()
+    try:
+        with torch.no_grad():
+            ref_out = ref_model(torch.from_numpy(x), prefix_len=prefix_len)
+    finally:
+        ref_model.eval()
+
+    def fixed_uniform(key, shape=(), *a, **k):
+        assert tuple(shape) == rand.shape
+        return jnp.asarray(rand)
+
+    monkeypatch.setattr(jax.random, "uniform", fixed_uniform)
+    got = model.apply(
+        variables,
+        jnp.asarray(x),
+        prefix_len=prefix_len,
+        deterministic=False,
+        rngs={"dropout": jax.random.PRNGKey(0)},
+    )
+    np.testing.assert_allclose(
+        np.asarray(got.logits), ref_out.logits.numpy(), atol=2e-4, rtol=2e-4
+    )
+
+
+def test_cached_decode_matches(golden_pair):
+    """Prime both caches with a prompt, then decode token-by-token: our
+    fixed-capacity rotate-at-write cache must reproduce the reference's
+    growing-cat cache logits at every step."""
+    from perceiver_io_tpu.models.text import CausalLanguageModel
+
+    ref_model, model, variables = golden_pair
+    b, prompt_len, prefix_len, steps = 2, 12, 4, 4
+    toks = _tokens(b, prompt_len + steps, seed=4)
+    prompt = toks[:, :prompt_len]
+
+    with torch.no_grad():
+        ref_out = ref_model(torch.from_numpy(prompt), prefix_len=prefix_len, kv_cache=[])
+    ref_cache = ref_out.kv_cache
+
+    cache = CausalLanguageModel.init_cache(model.config, b, dtype=jnp.float32)
+    got = model.apply(
+        variables, jnp.asarray(prompt), prefix_len=prefix_len, kv_cache=cache
+    )
+    np.testing.assert_allclose(
+        np.asarray(got.logits), ref_out.logits.numpy(), atol=2e-4, rtol=2e-4
+    )
+
+    for i in range(steps):
+        tok = toks[:, prompt_len + i : prompt_len + i + 1]
+        with torch.no_grad():
+            ref_out = ref_model(
+                torch.from_numpy(tok), prefix_len=prefix_len, kv_cache=ref_cache
+            )
+        ref_cache = ref_out.kv_cache
+        got = model.apply(
+            variables,
+            jnp.asarray(tok),
+            prefix_len=prefix_len,
+            kv_cache=got.kv_cache,
+            decode=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got.logits),
+            ref_out.logits.numpy(),
+            atol=3e-4,
+            rtol=3e-4,
+            err_msg=f"decode step {i}",
+        )
+
+
+def test_gradient_tree_matches(golden_pair):
+    """Backward parity on EVERY parameter: a fixed random projection of the
+    latent logits is reduced to a scalar in both frameworks and the full
+    gradient tree is compared in torch naming via the export mapping."""
+    from perceiver_io_tpu.hf.lightning_ckpt import export_causal_sequence_model_state_dict
+
+    ref_model, model, variables = golden_pair
+    b, n, prefix_len = 2, 64, 48
+    x = _tokens(b, n, seed=5)
+    w = np.random.default_rng(6).normal(
+        size=(b, n - prefix_len, GEOM["vocab_size"])
+    ).astype(np.float32)
+
+    ref_model.zero_grad()
+    ref_out = ref_model(torch.from_numpy(x), prefix_len=prefix_len)
+    (ref_out.logits * torch.from_numpy(w)).mean().backward()
+    ref_grads = {
+        name: p.grad.detach().numpy()
+        for name, p in ref_model.named_parameters()
+        if p.grad is not None
+    }
+
+    def loss_fn(variables):
+        out = model.apply(variables, jnp.asarray(x), prefix_len=prefix_len)
+        return jnp.mean(out.logits * jnp.asarray(w))
+
+    grads = jax.grad(loss_fn)(variables)
+    got_grads = export_causal_sequence_model_state_dict(grads)
+
+    assert set(got_grads) == set(ref_grads)
+    for name in sorted(ref_grads):
+        np.testing.assert_allclose(
+            got_grads[name],
+            ref_grads[name],
+            atol=5e-5,
+            rtol=5e-4,
+            err_msg=f"gradient mismatch: {name}",
+        )
