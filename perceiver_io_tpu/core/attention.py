@@ -329,7 +329,13 @@ class MultiHeadAttention(nn.Module):
         # GEMM's per-head rows are recovered from the block diagonal. The h x
         # extra MXU flops are ~3 GFLOP/step at the 16k flagship — noise next
         # to the convert it removes.
-        if kv_cache is not None and n_q == 1 and h > 1:
+        # Budget gate: the block-diagonal query is (B, H, H*Dk) and the value
+        # GEMM intermediate (B, H, H*Dv) — O(h^2 * d). The flagship (h=8,
+        # C=512 -> width 4096) measured faster; many-head/wide configs beyond
+        # the budget fall through to the einsum path below instead of
+        # regressing on the h^2 blowup.
+        bd_fits = h * self.qk_channels <= 8192 and h * self.v_channels <= 8192
+        if kv_cache is not None and n_q == 1 and h > 1 and bd_fits:
             d_v = self.v_channels // h
             qh = q[:, :, 0, :]  # (B, H, Dk)
             eye = jnp.eye(h, dtype=qh.dtype)

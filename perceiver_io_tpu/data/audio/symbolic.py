@@ -139,26 +139,32 @@ class SymbolicAudioDataModule:
         )
 
     def prepare_data(self) -> None:
-        if os.path.exists(self.preproc_dir):
-            return
-        dataset = self.load_source_dataset()
-        encoded = {}
-        for split in ("train", "valid"):
-            d = Path(dataset[split])
-            if not d.exists():
-                raise ValueError(f"Invalid directory supplied. Directory '{d}' does not exist.")
-            files = list(d.rglob("**/*.mid")) + list(d.rglob("**/*.midi"))
-            encoded[split] = encode_midi_files(files, num_workers=self.preproc_workers)
+        # atomic rename-into-place (parallel/dist.py prepare_once): racing
+        # processes never observe a half-flushed memmap or crash on mkdir
+        from perceiver_io_tpu.parallel.dist import prepare_once
 
-        random.Random(self.seed).shuffle(encoded["train"])
-        self.preproc_dir.mkdir(parents=True)
-        for split, target in (("train", self.train_data_file), ("valid", self.valid_data_file)):
-            flat = np.concatenate(
-                [np.append(ids, [EXAMPLE_SEPARATOR]) for ids in encoded[split]]
-            ).astype(np.int16)
-            fp = np.memmap(str(target), dtype=np.int16, mode="w+", shape=flat.shape)
-            fp[:] = flat[:]
-            fp.flush()
+        def build(tmp_dir) -> None:
+            dataset = self.load_source_dataset()
+            encoded = {}
+            for split in ("train", "valid"):
+                d = Path(dataset[split])
+                if not d.exists():
+                    raise ValueError(f"Invalid directory supplied. Directory '{d}' does not exist.")
+                files = list(d.rglob("**/*.mid")) + list(d.rglob("**/*.midi"))
+                encoded[split] = encode_midi_files(files, num_workers=self.preproc_workers)
+
+            random.Random(self.seed).shuffle(encoded["train"])
+            tmp_dir.mkdir(parents=True)
+            names = (("train", self.train_data_file.name), ("valid", self.valid_data_file.name))
+            for split, name in names:
+                flat = np.concatenate(
+                    [np.append(ids, [EXAMPLE_SEPARATOR]) for ids in encoded[split]]
+                ).astype(np.int16)
+                fp = np.memmap(str(tmp_dir / name), dtype=np.int16, mode="w+", shape=flat.shape)
+                fp[:] = flat[:]
+                fp.flush()
+
+        prepare_once(self.preproc_dir, build)
 
     def _dataset(self, data_file: Path, train: bool) -> SymbolicAudioNumpyDataset:
         data = np.memmap(str(data_file), dtype=np.int16, mode="r")
@@ -242,22 +248,30 @@ class SyntheticSymbolicAudioDataModule(SymbolicAudioDataModule):
         return np.concatenate(parts)
 
     def prepare_data(self) -> None:
-        if os.path.exists(self.preproc_dir):
-            return
-        rng = np.random.default_rng(self.corpus_seed)
-        motifs = self._motifs(rng)
-        pieces = {
-            "train": [self._piece(rng, motifs) for _ in range(self.num_train_pieces)],
-            "valid": [self._piece(rng, motifs) for _ in range(self.num_valid_pieces)],
-        }
-        self.preproc_dir.mkdir(parents=True)
-        for split, target in (("train", self.train_data_file), ("valid", self.valid_data_file)):
-            flat = np.concatenate(
-                [np.append(ids, [EXAMPLE_SEPARATOR]) for ids in pieces[split]]
-            ).astype(np.int16)
-            fp = np.memmap(str(target), dtype=np.int16, mode="w+", shape=flat.shape)
-            fp[:] = flat[:]
-            fp.flush()
+        # atomic rename-into-place: concurrent processes (multi-host shared
+        # filesystem, racing local workers) never observe a half-written
+        # cache; redundant builds are harmless — content is deterministic
+        # (parallel/dist.py prepare_once)
+        from perceiver_io_tpu.parallel.dist import prepare_once
+
+        def build(tmp_dir) -> None:
+            rng = np.random.default_rng(self.corpus_seed)
+            motifs = self._motifs(rng)
+            pieces = {
+                "train": [self._piece(rng, motifs) for _ in range(self.num_train_pieces)],
+                "valid": [self._piece(rng, motifs) for _ in range(self.num_valid_pieces)],
+            }
+            tmp_dir.mkdir(parents=True)
+            names = (("train", self.train_data_file.name), ("valid", self.valid_data_file.name))
+            for split, name in names:
+                flat = np.concatenate(
+                    [np.append(ids, [EXAMPLE_SEPARATOR]) for ids in pieces[split]]
+                ).astype(np.int16)
+                fp = np.memmap(str(tmp_dir / name), dtype=np.int16, mode="w+", shape=flat.shape)
+                fp[:] = flat[:]
+                fp.flush()
+
+        prepare_once(self.preproc_dir, build)
 
 
 class _ArchiveSymbolicAudioDataModule(SymbolicAudioDataModule):

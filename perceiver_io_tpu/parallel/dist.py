@@ -55,6 +55,61 @@ def main_process_only(fn: F) -> F:
     return wrapper  # type: ignore[return-value]
 
 
+def prepare_once(target, build: Callable[[object], None]) -> None:
+    """Race-free build-if-missing for a DETERMINISTIC cached file or
+    directory: build into a process-private temp sibling, then atomically
+    rename into place. Concurrent processes (multi-host on a shared
+    filesystem, or racing local workers) may build redundantly, but the
+    atomic rename means readers never observe a half-written cache and
+    last-writer-wins is harmless because the content is identical. Hosts
+    with per-host local disks (no shared cache path) each build their own
+    copy, exactly like plain build-if-missing.
+
+    ``build(tmp_path)`` must write the artifact at ``tmp_path`` (creating it
+    as a file or directory itself).
+    """
+    import shutil
+    from pathlib import Path
+
+    target = Path(target)
+    if target.exists():
+        return
+    target.parent.mkdir(parents=True, exist_ok=True)
+    # sweep stale temps from crashed builds (their pid-suffixed names never
+    # match a later process, so nothing else ever reclaims them)
+    for stale in target.parent.glob(f".{target.name}.tmp-*"):
+        if stale.is_dir():
+            shutil.rmtree(stale, ignore_errors=True)
+        else:
+            try:
+                stale.unlink()
+            except OSError:
+                pass
+
+    tmp = target.with_name(f".{target.name}.tmp-{os.getpid()}")
+
+    def cleanup_tmp():
+        if tmp.is_dir():
+            shutil.rmtree(tmp, ignore_errors=True)
+        elif tmp.exists():
+            try:
+                tmp.unlink()
+            except OSError:
+                pass
+
+    try:
+        build(tmp)
+        try:
+            tmp.replace(target)
+        except OSError:
+            if not target.exists():  # concurrent creation is fine; else re-raise
+                raise
+            cleanup_tmp()
+    except BaseException:
+        cleanup_tmp()
+        raise
+
+
 def maybe_initialize_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,

@@ -234,12 +234,11 @@ def parse_args(parser: argparse.ArgumentParser, argv: Optional[Sequence[str]] = 
     Also the multi-host entry point: ``jax.distributed`` must initialize
     before ANY backend use, and building a datamodule may already query
     ``jax.process_count()`` (pad-free auto-detection) — so init happens here,
-    before any task code runs (reference: Lightning's DDP env bootstrap,
-    SURVEY §5.8). No-op unless multi-host env coordinates are set.
+    after arguments parse successfully but before any task code runs
+    (reference: Lightning's DDP env bootstrap, SURVEY §5.8). Parsing first
+    keeps ``--help``/usage errors from blocking on a coordinator that may
+    not be up. No-op unless multi-host env coordinates are set.
     """
-    from perceiver_io_tpu.parallel.dist import maybe_initialize_distributed
-
-    maybe_initialize_distributed()
     pre, _ = parser.parse_known_args(argv)
     for cfg in pre.config:
         apply_yaml_defaults(parser, cfg)
@@ -250,7 +249,12 @@ def parse_args(parser: argparse.ArgumentParser, argv: Optional[Sequence[str]] = 
         if unknown:
             raise ValueError(f"smoke preset has unknown keys: {sorted(unknown)}")
         parser.set_defaults(**preset)
-    return parser.parse_args(argv)
+    args = parser.parse_args(argv)
+
+    from perceiver_io_tpu.parallel.dist import maybe_initialize_distributed
+
+    maybe_initialize_distributed()
+    return args
 
 
 def activation_dtype(trainer: TrainerArgs):

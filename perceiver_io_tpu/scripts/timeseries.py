@@ -40,12 +40,13 @@ class TimeSeriesDataArgs:
 
 def _synthetic_csv(num_channels: int, rows: int = 20000, seed: int = 7) -> str:
     """Deterministic multivariate series (sine mixtures + trend + noise) for
-    fully-offline convergence runs; written once under .cache/timeseries."""
-    import os
+    fully-offline convergence runs; written once under .cache/timeseries
+    (atomic rename-into-place — see parallel/dist.py prepare_once)."""
+    from perceiver_io_tpu.parallel.dist import prepare_once
 
     path = f".cache/timeseries/synthetic_{num_channels}x{rows}_{seed}.csv"
-    if not os.path.exists(path):
-        os.makedirs(os.path.dirname(path), exist_ok=True)
+
+    def build(tmp_path) -> None:
         rng = np.random.default_rng(seed)
         t = np.arange(rows)[:, None]
         freqs = rng.uniform(0.002, 0.05, size=(1, num_channels))
@@ -57,7 +58,9 @@ def _synthetic_csv(num_channels: int, rows: int = 20000, seed: int = 7) -> str:
         )
         header = "date," + ",".join(f"ch{i}" for i in range(num_channels))
         body = np.concatenate([t, series], axis=1)
-        np.savetxt(path, body, delimiter=",", header=header, comments="", fmt="%.5f")
+        np.savetxt(tmp_path, body, delimiter=",", header=header, comments="", fmt="%.5f")
+
+    prepare_once(path, build)
     return path
 
 
