@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Validate the latest clm run checkpoint against WikiText-103-raw val
+# (companion of train.sh; the trainer restores the newest checkpoint under
+# the run dir automatically).
+python -m perceiver_io_tpu.scripts.text.clm validate \
+  --data.dataset=wikitext \
+  --data.max_seq_len=4096 \
+  --data.batch_size=16 \
+  --model.max_latents=512 \
+  --model.num_channels=512 \
+  --model.num_self_attention_layers=8 \
+  --trainer.precision=bf16 \
+  --trainer.name=clm \
+  "$@"

@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Validate the latest mlm run checkpoint on the IMDb validation split
+# (companion of train.sh; the trainer restores the newest checkpoint under
+# the run dir automatically).
+python -m perceiver_io_tpu.scripts.text.mlm validate \
+  --data.dataset=imdb \
+  --data.max_seq_len=2048 \
+  --data.batch_size=32 \
+  --model.num_latents=64 \
+  --model.num_latent_channels=64 \
+  --model.encoder.num_input_channels=64 \
+  --trainer.precision=bf16 \
+  --trainer.name=mlm \
+  "$@"

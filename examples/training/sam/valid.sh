@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Validate the latest sam_giantmidi run checkpoint (companion of train.sh;
+# the trainer restores the newest checkpoint under the run dir
+# automatically).
+python -m perceiver_io_tpu.scripts.audio.symbolic validate \
+  --data.dataset=giantmidi \
+  --data.dataset_dir=.cache/giantmidi \
+  --data.max_seq_len=6144 \
+  --data.batch_size=16 \
+  --model.max_latents=2048 \
+  --model.num_channels=768 \
+  --model.num_self_attention_layers=12 \
+  --trainer.precision=bf16 \
+  --trainer.name=sam_giantmidi \
+  "$@"

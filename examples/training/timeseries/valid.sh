@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Validate the latest timeseries run checkpoint (companion of train.sh; the
+# trainer restores the newest checkpoint under the run dir automatically).
+python -m perceiver_io_tpu.scripts.timeseries validate \
+  --data.train_path="${TRAIN_CSV:?set TRAIN_CSV}" \
+  --data.val_path="${VAL_CSV:-$TRAIN_CSV}" \
+  --data.in_len=4096 --data.out_len=5000 \
+  --model.num_latents=256 --model.num_latent_channels=256 \
+  --trainer.name=timeseries \
+  "$@"

@@ -25,8 +25,8 @@ class OpticalFlowProcessor:
     ):
         if patch_min_overlap >= patch_size[0] or patch_min_overlap >= patch_size[1]:
             raise ValueError(
-                f"Overlap should be smaller than the patch size "
-                f"(patch-size='{patch_size}', patch_min_overlap='{patch_min_overlap}')."
+                f"patch_min_overlap={patch_min_overlap} must be smaller than "
+                f"both patch dimensions {patch_size}"
             )
         self.patch_size = patch_size
         self.patch_min_overlap = patch_min_overlap
@@ -66,18 +66,18 @@ class OpticalFlowProcessor:
         img1, img2 = np.asarray(image_pair[0]), np.asarray(image_pair[1])
         if img1.shape != img2.shape:
             raise ValueError(
-                f"Shapes of images must match. (shape image1='{img1.shape}', shape image2='{img2.shape}')"
+                f"image pair has mismatched shapes: {img1.shape} vs {img2.shape}"
             )
         h, w = img1.shape[:2]
         if h < self.patch_size[0]:
             raise ValueError(
-                f"Height of image (height='{h}') must be at least {self.patch_size[0]}."
-                "Please pad or resize your image to the minimum dimension."
+                f"image height {h} is below the {self.patch_size[0]}-pixel patch "
+                "height; pad or resize the image first"
             )
         if w < self.patch_size[1]:
             raise ValueError(
-                f"Width of image (width='{w}') must be at least {self.patch_size[1]}."
-                "Please pad or resize your image to the minimum dimension."
+                f"image width {w} is below the {self.patch_size[1]}-pixel patch "
+                "width; pad or resize the image first"
             )
 
         feats = np.stack(
@@ -96,7 +96,7 @@ class OpticalFlowProcessor:
     def preprocess_batch(self, image_pairs: Sequence[Sequence[np.ndarray]]) -> np.ndarray:
         shapes = {np.asarray(im).shape for pair in image_pairs for im in pair}
         if len(shapes) != 1:
-            raise ValueError("Shapes of images must match. Not all input images have the same shape.")
+            raise ValueError(f"image pairs have mismatched shapes: {sorted(map(str, shapes))}")
         return np.stack([self.preprocess(pair) for pair in image_pairs], axis=0)
 
     # ----------------------------------------------------------- postprocess

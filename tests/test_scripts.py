@@ -375,7 +375,9 @@ def test_img_clf_default_heads_build(tmp_path):
             "--model.num_latents=4",
             "--model.num_latent_channels=16",
             # keep the default 28x28x1 / 32-band adapter (width 131) and the
-            # default head counts — the point of the test
+            # default head counts — the point of the test; layer count is NOT
+            # under test, so shrink it (8-layer default costs ~30s of compile)
+            "--model.encoder.num_self_attention_layers_per_block=1",
             "--trainer.devices=1",
             "--trainer.max_steps=1",
             "--trainer.log_interval=1",
