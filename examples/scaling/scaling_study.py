@@ -135,6 +135,46 @@ def cmd_export(args):
     print(f"wrote {path}")
 
 
+def cmd_fit_demo(args):
+    """End-to-end MECHANICS demo of the fit workflow on an offline
+    convergence curve (docs/results/clm.csv, the --smoke preset run): each
+    validation point on the curve becomes a (compute, params, tokens) triple
+    — every point of a single training curve lies on its own compute
+    envelope, the degenerate single-model case of the reference's approach-1
+    minima-over-curves extraction (reference:
+    examples/scaling/clm/scaling/laws.py:7-36). This proves the
+    curve→triples→fit pipeline runs; the fitted coefficients are NOT physics
+    (one model size cannot constrain a power law's exponent — that needs the
+    real multi-model study, which is network-blocked here)."""
+    rows = [r for r in csv.DictReader(open(args.csv)) if r.get("val_loss")]
+    if not rows:
+        raise SystemExit(f"no val_loss rows in {args.csv}")
+
+    est = ComputeEstimator(
+        vocab_size=args.vocab_size, max_seq_len=args.max_seq_len, num_latents=args.num_latents
+    )
+    info = ModelInfo(args.num_channels, args.num_layers, est)
+    n_params = info.num_self_attn_params() + info.num_cross_attn_params()
+    f_tok = info.self_attn_flops() + info.cross_attn_flops()
+
+    flops, params, tokens = [], [], []
+    print(f"{'step':>6} {'val_loss':>9} {'tokens':>12} {'FLOPs':>12}")
+    for r in rows:
+        d = float(r["step"]) * args.batch_size * args.num_latents  # latent tokens seen
+        c = f_tok * d
+        print(f"{int(float(r['step'])):>6} {float(r['val_loss']):>9.4f} {d:>12.3e} {c:>12.3e}")
+        flops.append(c)
+        params.append(n_params)
+        tokens.append(d)
+
+    law = fit_scaling_law(flops, params, tokens, a=args.a, b=args.b)
+    print(f"\nfitted law over {len(rows)} curve points "
+          f"({args.num_channels}ch x {args.num_layers}L, {n_params/1e6:.1f}M params):")
+    print(law)
+    for c in (1e15, 1e16, 1e17):
+        print(f"C={c:.0e}: N_opt={law.n_opt(c)/1e6:.1f}M  D_opt={law.d_opt(c)/1e6:.1f}M tokens")
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__)
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -160,6 +200,22 @@ def main(argv=None):
     exp.add_argument("--batch-size", type=int, default=16)
     exp.add_argument("--budget", type=float, default=1e18, help="reference compute per grid point")
     exp.set_defaults(fn=cmd_export)
+
+    # defaults match the clm --smoke preset that produced docs/results/clm.csv
+    # (scripts/text/clm.py add_smoke_preset)
+    demo = sub.add_parser(
+        "fit-demo", help="run the fit workflow end-to-end on an offline convergence curve"
+    )
+    demo.add_argument("csv", nargs="?", default="docs/results/clm.csv")
+    demo.add_argument("--vocab-size", type=int, default=262)
+    demo.add_argument("--max-seq-len", type=int, default=1024)
+    demo.add_argument("--num-latents", type=int, default=256)
+    demo.add_argument("--num-channels", type=int, default=192)
+    demo.add_argument("--num-layers", type=int, default=4)
+    demo.add_argument("--batch-size", type=int, default=8)
+    demo.add_argument("--a", type=float, default=0.5)
+    demo.add_argument("--b", type=float, default=0.5)
+    demo.set_defaults(fn=cmd_fit_demo)
 
     args = parser.parse_args(argv)
     args.fn(args)
