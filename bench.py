@@ -197,6 +197,7 @@ def image_bench(args):
         "vs_baseline": vs_baseline,
     }
     print(json.dumps(result))
+    return result
 
 
 def decode_bench(args):
@@ -254,6 +255,29 @@ def decode_bench(args):
         "vs_baseline": round(a100_step_time / per_token, 3),
     }
     print(json.dumps(result))
+    return result
+
+
+def extra_bench(args):
+    """Run the non-headline benches (decode b=1, decode b=8, image training)
+    and write them to one JSON artifact (``--out BENCH_extra_r<k>.json``) so
+    decode/image regressions are visible round-over-round — the headline
+    train metric is what the driver's plain ``python bench.py`` records."""
+    import copy
+
+    results = {}
+    for b in (1, 8):
+        a = copy.copy(args)
+        a.batch_size, a.mode = b, "decode"
+        results[f"decode_b{b}"] = decode_bench(a)
+    a = copy.copy(args)
+    # batch 16 is the largest the 224x224 Fourier config fits on one chip
+    a.batch_size, a.mode = 16, "img"
+    results["image_b16"] = image_bench(a)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(results, f, indent=1)
+        print(f"wrote {args.out}")
 
 
 def main():
@@ -269,12 +293,15 @@ def main():
     p.add_argument("--steps", type=int, default=50)
     p.add_argument("--dtype", default="bfloat16")
     p.add_argument("--remat", action="store_true", help="activation checkpointing (needed for large seq/batch)")
-    p.add_argument("--mode", choices=["train", "decode", "img"], default="train")
+    p.add_argument("--mode", choices=["train", "decode", "img", "extra"], default="train")
+    p.add_argument("--out", default=None, help="extra mode: JSON artifact path (e.g. BENCH_extra_r3.json)")
     args = p.parse_args()
 
     if args.batch_size is None:
         args.batch_size = 4 if args.mode == "train" else 1
 
+    if args.mode == "extra":
+        return extra_bench(args)
     if args.mode == "decode":
         return decode_bench(args)
     if args.mode == "img":
