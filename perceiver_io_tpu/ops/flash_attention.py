@@ -42,6 +42,59 @@ from jax.experimental.pallas import tpu as pltpu
 
 MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
 LANES = 128
+LOG2E = 1.4426950408889634  # log2(e)
+
+# v2 kernel optimizations (measured A/B on v5e, see docs/performance.md):
+#   - base-2 softmax: the score tile is scaled by sm_scale*log2(e) (a multiply
+#     the kernel already paid for sm_scale) and probabilities use the VPU's
+#     native exp2 instead of exp; the lse residual is kept in base-2 units and
+#     the backward recompute mirrors it. The softmax-backward ds formula is
+#     UNCHANGED: d/ds of p = 2^(s*c*log2e - lse2) is p*c — the log2e*ln2
+#     factors cancel.
+#   - zero-bias skip: the flagship packed path (no pad_mask, divisor blocks =
+#     no kv padding) carries an all-zero bias row; the wrappers pass
+#     ``bias=None`` and the kernels drop the stream + add entirely.
+#   - full-tile fast path: causal tiles strictly below the masked diagonal
+#     skip iota/compare/select generation (3 of 4 CA kv blocks at the 16k
+#     flagship are fully visible).
+#   - slim running stats: the packed kernels' m/l scratch carries RES_LANES
+#     lanes instead of 128 (only lane 0 is information).
+# MEASURED AND REJECTED as defaults (same-process interleaved full-step A/B
+# on the 16k flagship, batch 4, v5e — tools/kernel_ab.py): none of these
+# "obvious" VPU trims beats the round-2 kernels; every one is neutral to
+# slightly NEGATIVE (fastmask +0.5%, slimstats +1.4%, base2 +2.0%,
+# nobias +3.5%, all-four +3.9% step time). The kernels are evidently near
+# their schedule optimum — Mosaic hides the elementwise work these flags
+# remove, and the code perturbations only disturb its pipelining. The
+# features stay implemented and toggleable for future re-probing (e.g. on a
+# different TPU generation); the default is the empty set, which reproduces
+# the round-2 kernels bit-for-bit. Read at TRACE time, like
+# set_default_flash. Full table in docs/performance.md.
+ALL_FEATURES = frozenset({"base2", "nobias", "fastmask", "slimstats"})
+FAST_FEATURES: frozenset = frozenset()
+
+
+def set_fast_kernels(mode) -> None:
+    """Select kernel optimizations (trace-time, for A/B probes): True = all,
+    False = none (round-2 kernels), or an iterable of feature names."""
+    global FAST_FEATURES
+    if mode is True:
+        FAST_FEATURES = ALL_FEATURES
+    elif mode is False:
+        FAST_FEATURES = frozenset()
+    else:
+        unknown = frozenset(mode) - ALL_FEATURES
+        if unknown:
+            raise ValueError(f"unknown kernel features: {sorted(unknown)}")
+        FAST_FEATURES = frozenset(mode)
+
+
+def _exp(x, base2: bool):
+    return jnp.exp2(x) if base2 else jnp.exp(x)
+
+
+def _log(x, base2: bool):
+    return jnp.log2(x) if base2 else jnp.log(x)
 # Residual lane width for the packed kernels' lse/delta side-channels: only
 # one lane per head carries information, but a few lanes keep the tiles
 # loadable; 8 instead of 128 cuts ~250 MB/step of backward residual traffic
@@ -79,30 +132,56 @@ def _block_visible(iq, ikv, block_q: int, block_kv: int, offset: int):
     return ikv * block_kv <= (iq + 1) * block_q - 1 + offset
 
 
+def _block_fully_visible(iq, ikv, block_q: int, block_kv: int, offset: int):
+    """True iff EVERY entry of score tile (iq, ikv) is unmasked — the tile's
+    last kv column is within the first query row's limit."""
+    return (ikv + 1) * block_kv - 1 <= iq * block_q + offset
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
 
 
+def _causal_dispatch(body, causal: bool, fastmask: bool, iq, ikv, block_q, block_kv, offset):
+    """Run ``body(apply_mask)`` once per visible tile. Under ``fastmask``,
+    fully-visible causal tiles take a mask-free branch (no iota/compare/
+    select generation); only diagonal-straddling tiles pay for the mask."""
+    if causal and fastmask:
+        full = _block_fully_visible(iq, ikv, block_q, block_kv, offset)
+        vis = _block_visible(iq, ikv, block_q, block_kv, offset)
+        pl.when(jnp.logical_and(vis, full))(lambda: body(False))
+        pl.when(jnp.logical_and(vis, jnp.logical_not(full)))(lambda: body(True))
+    elif causal:
+        pl.when(_block_visible(iq, ikv, block_q, block_kv, offset))(lambda: body(True))
+    else:
+        body(False)
+
+
 def _fwd_kernel(
-    bias_ref,  # (1, 1, block_kv) f32
-    q_ref,  # (1, block_q, d_qk)
-    k_ref,  # (1, block_kv, d_qk)
-    v_ref,  # (1, block_kv, d_v)
-    o_ref,  # (1, block_q, d_v)
-    lse_ref,  # (1, block_q, LANES) f32
-    m_scr,  # (block_q, LANES) f32
-    l_scr,  # (block_q, LANES) f32
-    acc_scr,  # (block_q, d_v) f32
-    *,
+    *refs,  # [bias?], q, k, v, o, lse, m_scr, l_scr, acc_scr
     causal: bool,
     offset: int,
     sm_scale: float,
     num_kv_blocks: int,
+    has_bias: bool,
+    v2: frozenset,
 ):
+    # refs: bias (1, 1, block_kv) f32 when has_bias; q (1, block_q, d_qk);
+    # k (1, block_kv, d_qk); v (1, block_kv, d_v); outs o (1, block_q, d_v),
+    # lse (1, block_q, LANES) f32; scratch m/l (block_q, LANES) f32,
+    # acc (block_q, d_v) f32
+    if has_bias:
+        bias_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        bias_ref = None
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
     iq, ikv = pl.program_id(1), pl.program_id(2)
     block_q, d_v = acc_scr.shape
     block_kv = k_ref.shape[1]
+    # v2: fold the base-2 conversion into the score multiply the kernel
+    # already pays for sm_scale (see module notes on FAST_FEATURES)
+    score_scale = sm_scale * (LOG2E if "base2" in v2 else 1.0)
 
     @pl.when(ikv == 0)
     def _init():
@@ -110,12 +189,14 @@ def _fwd_kernel(
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    def _body():
+    def _body(apply_mask: bool):
         q = q_ref[0]
         k = k_ref[0]
         s = _dot(q, k, ((1,), (1,)))  # (block_q, block_kv)
-        s = s * sm_scale + bias_ref[0]
-        if causal:
+        s = s * score_scale
+        if has_bias:
+            s = s + bias_ref[0]
+        if apply_mask:
             keep = _right_aligned_mask(block_q, block_kv, iq, ikv, block_q, block_kv, offset)
             s = jnp.where(keep, s, MASK_VALUE)
 
@@ -123,8 +204,8 @@ def _fwd_kernel(
         l_prev = l_scr[...]
         m_curr = jnp.max(s, axis=1)[:, None]  # (block_q, 1)
         m_next = jnp.maximum(m_prev, m_curr)  # (block_q, LANES)
-        p = jnp.exp(s - m_next[:, :1])  # lane-broadcast subtract
-        alpha = jnp.exp(m_prev - m_next)
+        p = _exp(s - m_next[:, :1], "base2" in v2)  # lane-broadcast subtract
+        alpha = _exp(m_prev - m_next, "base2" in v2)
         # flash-v2 style: keep the accumulator unnormalized; only rescale by
         # alpha when the running max moves. Normalization happens at store.
         l_scr[...] = alpha * l_prev + jnp.sum(p, axis=1)[:, None]
@@ -134,20 +215,18 @@ def _fwd_kernel(
         o_curr = _dot(p.astype(v.dtype), v, ((1,), (0,)))
         acc_scr[...] = acc_scr[...] * alpha[:, :1] + o_curr
 
-    if causal:
-        pl.when(_block_visible(iq, ikv, block_q, block_kv, offset))(_body)
-    else:
-        _body()
+    _causal_dispatch(_body, causal, "fastmask" in v2, iq, ikv, block_q, block_kv, offset)
 
     @pl.when(ikv == num_kv_blocks - 1)
     def _store():
         l = l_scr[...]
         l_inv = jnp.where(l == 0.0, 1.0, 1.0 / l)
         o_ref[0] = (acc_scr[...] * l_inv[:, :1]).astype(o_ref.dtype)
-        # lse = m + log(l). Rows with l == 0 only occur when every kv block
-        # was causally invisible for the whole q block; the backward pass
-        # skips exactly those blocks, so their lse is never read.
-        lse_ref[0] = m_scr[...] + jnp.log(jnp.where(l == 0.0, 1.0, l))
+        # lse = m + log(l) (base-2 under v2, matching the backward recompute).
+        # Rows with l == 0 only occur when every kv block was causally
+        # invisible for the whole q block; the backward pass skips exactly
+        # those blocks, so their lse is never read.
+        lse_ref[0] = m_scr[...] + _log(jnp.where(l == 0.0, 1.0, l), "base2" in v2)
 
 
 # ---------------------------------------------------------------------------
@@ -155,34 +234,37 @@ def _fwd_kernel(
 # ---------------------------------------------------------------------------
 
 
-def _recompute_p(q, k, bias_row, lse_col, iq, ikv, block_q, block_kv, offset, sm_scale, causal):
-    """Recompute the probability tile p = exp(s_masked - lse)."""
+def _recompute_p(q, k, bias_row, lse_col, iq, ikv, block_q, block_kv, offset, sm_scale, apply_mask, base2):
+    """Recompute the probability tile p = exp(s_masked - lse) (base-2 under
+    v2 — the lse residual is in matching units)."""
     s = _dot(q, k, ((1,), (1,)))
-    s = s * sm_scale + bias_row
-    if causal:
+    s = s * (sm_scale * (LOG2E if base2 else 1.0))
+    if bias_row is not None:
+        s = s + bias_row
+    if apply_mask:
         keep = _right_aligned_mask(s.shape[0], s.shape[1], iq, ikv, block_q, block_kv, offset)
         s = jnp.where(keep, s, MASK_VALUE)
-    return jnp.exp(s - lse_col)
+    return _exp(s - lse_col, base2)
 
 
 def _dkv_kernel(
-    bias_ref,  # (1, 1, block_kv)
-    q_ref,  # (1, block_q, d_qk)
-    k_ref,  # (1, block_kv, d_qk)
-    v_ref,  # (1, block_kv, d_v)
-    do_ref,  # (1, block_q, d_v)
-    lse_ref,  # (1, block_q, LANES)
-    delta_ref,  # (1, block_q, LANES)
-    dk_ref,  # (1, block_kv, d_qk)
-    dv_ref,  # (1, block_kv, d_v)
-    dk_scr,  # (block_kv, d_qk) f32
-    dv_scr,  # (block_kv, d_v) f32
-    *,
+    *refs,  # [bias?], q, k, v, do, lse, delta, dk, dv, dk_scr, dv_scr
     causal: bool,
     offset: int,
     sm_scale: float,
     num_q_blocks: int,
+    has_bias: bool,
+    v2: frozenset,
 ):
+    # refs: bias (1, 1, block_kv) when has_bias; q (1, block_q, d_qk);
+    # k (1, block_kv, d_qk); v (1, block_kv, d_v); do (1, block_q, d_v);
+    # lse/delta (1, block_q, LANES); outs dk (1, block_kv, d_qk),
+    # dv (1, block_kv, d_v); scratch dk/dv f32
+    if has_bias:
+        bias_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_scr, dv_scr = refs
+    else:
+        bias_ref = None
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_scr, dv_scr = refs
     ikv, iq = pl.program_id(1), pl.program_id(2)
     block_kv, _ = dk_scr.shape
     block_q = q_ref.shape[1]
@@ -192,7 +274,7 @@ def _dkv_kernel(
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    def _body():
+    def _body(apply_mask: bool):
         q = q_ref[0]
         k = k_ref[0]
         v = v_ref[0]
@@ -200,19 +282,18 @@ def _dkv_kernel(
         lse = lse_ref[0][:, :1]  # (block_q, 1)
         delta = delta_ref[0][:, :1]
 
-        p = _recompute_p(q, k, bias_ref[0], lse, iq, ikv, block_q, block_kv, offset, sm_scale, causal)
+        bias = bias_ref[0] if has_bias else None
+        p = _recompute_p(q, k, bias, lse, iq, ikv, block_q, block_kv, offset, sm_scale, apply_mask, "base2" in v2)
         # dv += p^T do
         dv_scr[...] += _dot(p.astype(do.dtype), do, ((0,), (0,)))
-        # dp = do v^T ; ds = p * (dp - delta) * sm_scale
+        # dp = do v^T ; ds = p * (dp - delta) * sm_scale (the base-2 factors
+        # cancel: d/ds of 2^(s*c*log2e - lse2) is p*c, same as the exp form)
         dp = _dot(do, v, ((1,), (1,)))
         ds = p * (dp - delta) * sm_scale
         # dk += ds^T q
         dk_scr[...] += _dot(ds.astype(q.dtype), q, ((0,), (0,)))
 
-    if causal:
-        pl.when(_block_visible(iq, ikv, block_q, block_kv, offset))(_body)
-    else:
-        _body()
+    _causal_dispatch(_body, causal, "fastmask" in v2, iq, ikv, block_q, block_kv, offset)
 
     @pl.when(iq == num_q_blocks - 1)
     def _store():
@@ -221,21 +302,22 @@ def _dkv_kernel(
 
 
 def _dq_kernel(
-    bias_ref,  # (1, 1, block_kv)
-    q_ref,  # (1, block_q, d_qk)
-    k_ref,  # (1, block_kv, d_qk)
-    v_ref,  # (1, block_kv, d_v)
-    do_ref,  # (1, block_q, d_v)
-    lse_ref,  # (1, block_q, LANES)
-    delta_ref,  # (1, block_q, LANES)
-    dq_ref,  # (1, block_q, d_qk)
-    dq_scr,  # (block_q, d_qk) f32
-    *,
+    *refs,  # [bias?], q, k, v, do, lse, delta, dq, dq_scr
     causal: bool,
     offset: int,
     sm_scale: float,
     num_kv_blocks: int,
+    has_bias: bool,
+    v2: frozenset,
 ):
+    # refs: bias (1, 1, block_kv) when has_bias; q (1, block_q, d_qk);
+    # k (1, block_kv, d_qk); v (1, block_kv, d_v); do (1, block_q, d_v);
+    # lse/delta (1, block_q, LANES); out dq (1, block_q, d_qk); scratch f32
+    if has_bias:
+        bias_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr = refs
+    else:
+        bias_ref = None
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr = refs
     iq, ikv = pl.program_id(1), pl.program_id(2)
     block_q, _ = dq_scr.shape
     block_kv = k_ref.shape[1]
@@ -244,7 +326,7 @@ def _dq_kernel(
     def _init():
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
-    def _body():
+    def _body(apply_mask: bool):
         q = q_ref[0]
         k = k_ref[0]
         v = v_ref[0]
@@ -252,15 +334,13 @@ def _dq_kernel(
         lse = lse_ref[0][:, :1]
         delta = delta_ref[0][:, :1]
 
-        p = _recompute_p(q, k, bias_ref[0], lse, iq, ikv, block_q, block_kv, offset, sm_scale, causal)
+        bias = bias_ref[0] if has_bias else None
+        p = _recompute_p(q, k, bias, lse, iq, ikv, block_q, block_kv, offset, sm_scale, apply_mask, "base2" in v2)
         dp = _dot(do, v, ((1,), (1,)))
         ds = (p * (dp - delta) * sm_scale).astype(k.dtype)
         dq_scr[...] += _dot(ds, k, ((1,), (0,)))
 
-    if causal:
-        pl.when(_block_visible(iq, ikv, block_q, block_kv, offset))(_body)
-    else:
-        _body()
+    _causal_dispatch(_body, causal, "fastmask" in v2, iq, ikv, block_q, block_kv, offset)
 
     @pl.when(ikv == num_kv_blocks - 1)
     def _store():
@@ -287,19 +367,31 @@ def _interpret_default() -> bool:
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9)
+    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10)
 )
-def _flash(q, k, v, bias, causal, offset, sm_scale, block_q, block_kv, num_heads):
-    out, _ = _flash_fwd_impl(q, k, v, bias, causal, offset, sm_scale, block_q, block_kv, num_heads)
+def _flash(q, k, v, bias, causal, offset, sm_scale, block_q, block_kv, num_heads, v2):
+    out, _ = _flash_fwd_impl(q, k, v, bias, causal, offset, sm_scale, block_q, block_kv, num_heads, v2)
     return out
 
 
-def _flash_fwd_impl(q, k, v, bias, causal, offset, sm_scale, block_q, block_kv, num_heads):
+def _flash_fwd_impl(q, k, v, bias, causal, offset, sm_scale, block_q, block_kv, num_heads, v2):
     bh, nq, d_qk = q.shape
     nkv = k.shape[1]
     d_v = v.shape[2]
     h = num_heads
     grid = (bh, nq // block_q, nkv // block_kv)
+
+    in_specs = []
+    inputs = []
+    if bias is not None:
+        in_specs.append(pl.BlockSpec((1, 1, block_kv), lambda b, i, j: (b // h, 0, j)))
+        inputs.append(bias)
+    in_specs += [
+        pl.BlockSpec((1, block_q, d_qk), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_kv, d_qk), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_kv, d_v), lambda b, i, j: (b, j, 0)),
+    ]
+    inputs += [q, k, v]
 
     out, lse = pl.pallas_call(
         functools.partial(
@@ -308,14 +400,11 @@ def _flash_fwd_impl(q, k, v, bias, causal, offset, sm_scale, block_q, block_kv, 
             offset=offset,
             sm_scale=sm_scale,
             num_kv_blocks=grid[2],
+            has_bias=bias is not None,
+            v2=v2,
         ),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, block_kv), lambda b, i, j: (b // h, 0, j)),
-            pl.BlockSpec((1, block_q, d_qk), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_kv, d_qk), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_kv, d_v), lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d_v), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0)),
@@ -331,12 +420,12 @@ def _flash_fwd_impl(q, k, v, bias, causal, offset, sm_scale, block_q, block_kv, 
         ],
         compiler_params=_compiler_params("parallel", "parallel", "arbitrary"),
         interpret=_interpret_default(),
-    )(bias, q, k, v)
+    )(*inputs)
     return out, lse
 
 
-def _flash_fwd(q, k, v, bias, causal, offset, sm_scale, block_q, block_kv, num_heads):
-    out, lse = _flash_fwd_impl(q, k, v, bias, causal, offset, sm_scale, block_q, block_kv, num_heads)
+def _flash_fwd(q, k, v, bias, causal, offset, sm_scale, block_q, block_kv, num_heads, v2):
+    out, lse = _flash_fwd_impl(q, k, v, bias, causal, offset, sm_scale, block_q, block_kv, num_heads, v2)
     # the kernel emits lse broadcast across all 128 lanes (tiled loads);
     # keep ONE lane as the residual — at 48 attention calls per step the
     # full-lane buffers alone were ~3GB at batch 32 (measured, image
@@ -352,7 +441,7 @@ BWD_BLOCK_Q: Optional[int] = None
 BWD_BLOCK_KV: Optional[int] = None
 
 
-def _flash_bwd(causal, offset, sm_scale, block_q, block_kv, num_heads, residuals, g):
+def _flash_bwd(causal, offset, sm_scale, block_q, block_kv, num_heads, v2, residuals, g):
     q, k, v, bias, out, lse_col = residuals
     lse = jnp.broadcast_to(lse_col, lse_col.shape[:2] + (LANES,))
     bh, nq, d_qk = q.shape
@@ -369,6 +458,27 @@ def _flash_bwd(causal, offset, sm_scale, block_q, block_kv, num_heads, residuals
     delta = jnp.broadcast_to(delta[..., None], (bh, nq, LANES))
 
     nqb, nkvb = nq // block_q, nkv // block_kv
+    has_bias = bias is not None
+
+    def specs(order):
+        # order maps kernel grid dims -> (block index fns); shared between
+        # the dkv grid (b, j, i) and the dq grid (b, i, j)
+        bias_spec, qi, kj, vj, doi, li = order
+        s = []
+        if has_bias:
+            s.append(bias_spec)
+        s += [qi, kj, vj, doi, li, li]
+        return s
+
+    dkv_in_specs = specs((
+        pl.BlockSpec((1, 1, block_kv), lambda b, j, i: (b // h, 0, j)),
+        pl.BlockSpec((1, block_q, d_qk), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, block_kv, d_qk), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, block_kv, d_v), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, block_q, d_v), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, block_q, LANES), lambda b, j, i: (b, i, 0)),
+    ))
+    inputs = ([bias] if has_bias else []) + [q, k, v, g, lse, delta]
 
     dk, dv = pl.pallas_call(
         functools.partial(
@@ -377,17 +487,11 @@ def _flash_bwd(causal, offset, sm_scale, block_q, block_kv, num_heads, residuals
             offset=offset,
             sm_scale=sm_scale,
             num_q_blocks=nqb,
+            has_bias=has_bias,
+            v2=v2,
         ),
         grid=(bh, nkvb, nqb),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_kv), lambda b, j, i: (b // h, 0, j)),
-            pl.BlockSpec((1, block_q, d_qk), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_kv, d_qk), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_kv, d_v), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_q, d_v), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, LANES), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, LANES), lambda b, j, i: (b, i, 0)),
-        ],
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, block_kv, d_qk), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_kv, d_v), lambda b, j, i: (b, j, 0)),
@@ -402,7 +506,16 @@ def _flash_bwd(causal, offset, sm_scale, block_q, block_kv, num_heads, residuals
         ],
         compiler_params=_compiler_params("parallel", "parallel", "arbitrary"),
         interpret=_interpret_default(),
-    )(bias, q, k, v, g, lse, delta)
+    )(*inputs)
+
+    dq_in_specs = specs((
+        pl.BlockSpec((1, 1, block_kv), lambda b, i, j: (b // h, 0, j)),
+        pl.BlockSpec((1, block_q, d_qk), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_kv, d_qk), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_kv, d_v), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_q, d_v), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0)),
+    ))
 
     (dq,) = pl.pallas_call(
         functools.partial(
@@ -411,17 +524,11 @@ def _flash_bwd(causal, offset, sm_scale, block_q, block_kv, num_heads, residuals
             offset=offset,
             sm_scale=sm_scale,
             num_kv_blocks=nkvb,
+            has_bias=has_bias,
+            v2=v2,
         ),
         grid=(bh, nqb, nkvb),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_kv), lambda b, i, j: (b // h, 0, j)),
-            pl.BlockSpec((1, block_q, d_qk), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_kv, d_qk), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_kv, d_v), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_q, d_v), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0)),
-        ],
+        in_specs=dq_in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d_qk), lambda b, i, j: (b, i, 0)),
         ],
@@ -429,9 +536,9 @@ def _flash_bwd(causal, offset, sm_scale, block_q, block_kv, num_heads, residuals
         scratch_shapes=[pltpu.VMEM((block_q, d_qk), jnp.float32)],
         compiler_params=_compiler_params("parallel", "parallel", "arbitrary"),
         interpret=_interpret_default(),
-    )(bias, q, k, v, g, lse, delta)
+    )(*inputs)
 
-    return dq, dk, dv, jnp.zeros_like(bias)
+    return dq, dk, dv, jnp.zeros_like(bias) if has_bias else None
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -453,16 +560,7 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def _fwd_packed_kernel(
-    bias_ref,  # (1, 1, block_kv) f32
-    q_ref,  # (1, block_q, h*d_qk)
-    k_ref,  # (1, block_kv, h*d_qk)
-    v_ref,  # (1, block_kv, h*d_v)
-    o_ref,  # (1, block_q, h*d_v)
-    lse_ref,  # (1, block_q, h*RES_LANES) f32
-    m_scr,  # (h, block_q, LANES) f32
-    l_scr,  # (h, block_q, LANES) f32
-    acc_scr,  # (h, block_q, d_v) f32
-    *,
+    *refs,  # [bias?], q, k, v, o, lse, m_scr, l_scr, acc_scr
     causal: bool,
     offset: int,
     sm_scale: float,
@@ -470,11 +568,23 @@ def _fwd_packed_kernel(
     num_heads: int,
     d_qk: int,
     d_v: int,
+    has_bias: bool,
+    v2: frozenset,
 ):
+    # refs: bias (1, 1, block_kv) f32 when has_bias; q (1, block_q, h*d_qk);
+    # k (1, block_kv, h*d_qk); v (1, block_kv, h*d_v); outs
+    # o (1, block_q, h*d_v), lse (1, block_q, h*RES_LANES) f32; scratch
+    # m/l (h, block_q, RES_LANES if v2 else LANES) f32, acc (h, block_q, d_v)
+    if has_bias:
+        bias_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        bias_ref = None
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
     iq, ikv = pl.program_id(1), pl.program_id(2)
     h = num_heads
     block_q = q_ref.shape[1]
     block_kv = k_ref.shape[1]
+    score_scale = sm_scale * (LOG2E if "base2" in v2 else 1.0)
 
     @pl.when(ikv == 0)
     def _init():
@@ -482,36 +592,35 @@ def _fwd_packed_kernel(
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    def _body():
+    def _body(apply_mask: bool):
         # per-head minor-dim slices: Mosaic supports static lane slices but
         # not the (block, h*d) -> (block, h, d) vector reshape
-        bias = bias_ref[0]
+        bias = bias_ref[0] if has_bias else None
         keep = None
-        if causal:
+        if apply_mask:
             keep = _right_aligned_mask(block_q, block_kv, iq, ikv, block_q, block_kv, offset)
         for hh in range(h):
             qh = q_ref[0, :, hh * d_qk : (hh + 1) * d_qk]
             kh = k_ref[0, :, hh * d_qk : (hh + 1) * d_qk]
             vh = v_ref[0, :, hh * d_v : (hh + 1) * d_v]
             s = _dot(qh, kh, ((1,), (1,)))
-            s = s * sm_scale + bias
-            if causal:
+            s = s * score_scale
+            if has_bias:
+                s = s + bias
+            if apply_mask:
                 s = jnp.where(keep, s, MASK_VALUE)
             m_prev = m_scr[hh]
             l_prev = l_scr[hh]
             m_curr = jnp.max(s, axis=1)[:, None]
             m_next = jnp.maximum(m_prev, m_curr)
-            p = jnp.exp(s - m_next[:, :1])
-            alpha = jnp.exp(m_prev - m_next)
+            p = _exp(s - m_next[:, :1], "base2" in v2)
+            alpha = _exp(m_prev - m_next, "base2" in v2)
             l_scr[hh] = alpha * l_prev + jnp.sum(p, axis=1)[:, None]
             m_scr[hh] = m_next
             o_curr = _dot(p.astype(vh.dtype), vh, ((1,), (0,)))
             acc_scr[hh] = acc_scr[hh] * alpha[:, :1] + o_curr
 
-    if causal:
-        pl.when(_block_visible(iq, ikv, block_q, block_kv, offset))(_body)
-    else:
-        _body()
+    _causal_dispatch(_body, causal, "fastmask" in v2, iq, ikv, block_q, block_kv, offset)
 
     @pl.when(ikv == num_kv_blocks - 1)
     def _store():
@@ -521,24 +630,14 @@ def _fwd_packed_kernel(
             o_ref[0, :, hh * d_v : (hh + 1) * d_v] = (
                 acc_scr[hh] * l_inv[:, :1]
             ).astype(o_ref.dtype)
-            lse_ref[0, :, hh * RES_LANES : (hh + 1) * RES_LANES] = (
-                m_scr[hh] + jnp.log(jnp.where(l == 0.0, 1.0, l))
-            )[:, :RES_LANES]
+            lse = m_scr[hh] + _log(jnp.where(l == 0.0, 1.0, l), "base2" in v2)
+            if lse.shape[1] != RES_LANES:
+                lse = lse[:, :RES_LANES]
+            lse_ref[0, :, hh * RES_LANES : (hh + 1) * RES_LANES] = lse
 
 
 def _dkv_packed_kernel(
-    bias_ref,  # (1, 1, block_kv)
-    q_ref,  # (1, block_q, h*d_qk)
-    k_ref,  # (1, block_kv, h*d_qk)
-    v_ref,  # (1, block_kv, h*d_v)
-    do_ref,  # (1, block_q, h*d_v)
-    lse_ref,  # (1, block_q, h*RES_LANES)
-    delta_ref,  # (1, block_q, h*RES_LANES)
-    dk_ref,  # (1, block_kv, h*d_qk)
-    dv_ref,  # (1, block_kv, h*d_v)
-    dk_scr,  # (h, block_kv, d_qk) f32
-    dv_scr,  # (h, block_kv, d_v) f32
-    *,
+    *refs,  # [bias?], q, k, v, do, lse, delta, dk, dv, dk_scr, dv_scr
     causal: bool,
     offset: int,
     sm_scale: float,
@@ -546,7 +645,18 @@ def _dkv_packed_kernel(
     num_heads: int,
     d_qk: int,
     d_v: int,
+    has_bias: bool,
+    v2: frozenset,
 ):
+    # refs: bias (1, 1, block_kv) when has_bias; q (1, block_q, h*d_qk);
+    # k (1, block_kv, h*d_qk); v (1, block_kv, h*d_v); do (1, block_q, h*d_v);
+    # lse/delta (1, block_q, h*RES_LANES); outs dk (1, block_kv, h*d_qk),
+    # dv (1, block_kv, h*d_v); scratch dk/dv (h, block, d) f32
+    if has_bias:
+        bias_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_scr, dv_scr = refs
+    else:
+        bias_ref = None
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_scr, dv_scr = refs
     ikv, iq = pl.program_id(1), pl.program_id(2)
     h = num_heads
     block_kv = k_ref.shape[1]
@@ -557,7 +667,8 @@ def _dkv_packed_kernel(
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    def _body():
+    def _body(apply_mask: bool):
+        bias = bias_ref[0] if has_bias else None
         for hh in range(h):
             qh = q_ref[0, :, hh * d_qk : (hh + 1) * d_qk]
             kh = k_ref[0, :, hh * d_qk : (hh + 1) * d_qk]
@@ -566,18 +677,15 @@ def _dkv_packed_kernel(
             lse = lse_ref[0, :, hh * RES_LANES : hh * RES_LANES + 1]
             delta = delta_ref[0, :, hh * RES_LANES : hh * RES_LANES + 1]
             p = _recompute_p(
-                qh, kh, bias_ref[0], lse, iq, ikv,
-                block_q, block_kv, offset, sm_scale, causal,
+                qh, kh, bias, lse, iq, ikv,
+                block_q, block_kv, offset, sm_scale, apply_mask, "base2" in v2,
             )
             dv_scr[hh] += _dot(p.astype(doh.dtype), doh, ((0,), (0,)))
             dp = _dot(doh, vh, ((1,), (1,)))
             ds = p * (dp - delta) * sm_scale
             dk_scr[hh] += _dot(ds.astype(qh.dtype), qh, ((0,), (0,)))
 
-    if causal:
-        pl.when(_block_visible(iq, ikv, block_q, block_kv, offset))(_body)
-    else:
-        _body()
+    _causal_dispatch(_body, causal, "fastmask" in v2, iq, ikv, block_q, block_kv, offset)
 
     @pl.when(iq == num_q_blocks - 1)
     def _store():
@@ -587,16 +695,7 @@ def _dkv_packed_kernel(
 
 
 def _dq_packed_kernel(
-    bias_ref,  # (1, 1, block_kv)
-    q_ref,  # (1, block_q, h*d_qk)
-    k_ref,  # (1, block_kv, h*d_qk)
-    v_ref,  # (1, block_kv, h*d_v)
-    do_ref,  # (1, block_q, h*d_v)
-    lse_ref,  # (1, block_q, h*RES_LANES)
-    delta_ref,  # (1, block_q, h*RES_LANES)
-    dq_ref,  # (1, block_q, h*d_qk)
-    dq_scr,  # (h, block_q, d_qk) f32
-    *,
+    *refs,  # [bias?], q, k, v, do, lse, delta, dq, dq_scr
     causal: bool,
     offset: int,
     sm_scale: float,
@@ -604,7 +703,18 @@ def _dq_packed_kernel(
     num_heads: int,
     d_qk: int,
     d_v: int,
+    has_bias: bool,
+    v2: frozenset,
 ):
+    # refs: bias (1, 1, block_kv) when has_bias; q (1, block_q, h*d_qk);
+    # k (1, block_kv, h*d_qk); v (1, block_kv, h*d_v); do (1, block_q, h*d_v);
+    # lse/delta (1, block_q, h*RES_LANES); out dq (1, block_q, h*d_qk);
+    # scratch dq (h, block_q, d_qk) f32
+    if has_bias:
+        bias_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr = refs
+    else:
+        bias_ref = None
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr = refs
     iq, ikv = pl.program_id(1), pl.program_id(2)
     h = num_heads
     block_q = q_ref.shape[1]
@@ -614,7 +724,8 @@ def _dq_packed_kernel(
     def _init():
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
-    def _body():
+    def _body(apply_mask: bool):
+        bias = bias_ref[0] if has_bias else None
         for hh in range(h):
             qh = q_ref[0, :, hh * d_qk : (hh + 1) * d_qk]
             kh = k_ref[0, :, hh * d_qk : (hh + 1) * d_qk]
@@ -623,17 +734,14 @@ def _dq_packed_kernel(
             lse = lse_ref[0, :, hh * RES_LANES : hh * RES_LANES + 1]
             delta = delta_ref[0, :, hh * RES_LANES : hh * RES_LANES + 1]
             p = _recompute_p(
-                qh, kh, bias_ref[0], lse, iq, ikv,
-                block_q, block_kv, offset, sm_scale, causal,
+                qh, kh, bias, lse, iq, ikv,
+                block_q, block_kv, offset, sm_scale, apply_mask, "base2" in v2,
             )
             dp = _dot(doh, vh, ((1,), (1,)))
             ds = (p * (dp - delta) * sm_scale).astype(kh.dtype)
             dq_scr[hh] += _dot(ds, kh, ((1,), (0,)))
 
-    if causal:
-        pl.when(_block_visible(iq, ikv, block_q, block_kv, offset))(_body)
-    else:
-        _body()
+    _causal_dispatch(_body, causal, "fastmask" in v2, iq, ikv, block_q, block_kv, offset)
 
     @pl.when(ikv == num_kv_blocks - 1)
     def _store():
@@ -641,18 +749,31 @@ def _dq_packed_kernel(
             dq_ref[0, :, hh * d_qk : (hh + 1) * d_qk] = dq_scr[hh].astype(dq_ref.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11))
-def _flash_packed(q, k, v, bias, causal, offset, sm_scale, block_q, block_kv, h, d_qk, d_v):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11, 12))
+def _flash_packed(q, k, v, bias, causal, offset, sm_scale, block_q, block_kv, h, d_qk, d_v, v2):
     out, _ = _flash_packed_fwd_impl(
-        q, k, v, bias, causal, offset, sm_scale, block_q, block_kv, h, d_qk, d_v
+        q, k, v, bias, causal, offset, sm_scale, block_q, block_kv, h, d_qk, d_v, v2
     )
     return out
 
 
-def _flash_packed_fwd_impl(q, k, v, bias, causal, offset, sm_scale, block_q, block_kv, h, d_qk, d_v):
+def _flash_packed_fwd_impl(q, k, v, bias, causal, offset, sm_scale, block_q, block_kv, h, d_qk, d_v, v2):
     b, nq, _ = q.shape
     nkv = k.shape[1]
     grid = (b, nq // block_q, nkv // block_kv)
+    stat_lanes = RES_LANES if "slimstats" in v2 else LANES
+
+    in_specs = []
+    inputs = []
+    if bias is not None:
+        in_specs.append(pl.BlockSpec((1, 1, block_kv), lambda b_, i, j: (b_, 0, j)))
+        inputs.append(bias)
+    in_specs += [
+        pl.BlockSpec((1, block_q, h * d_qk), lambda b_, i, j: (b_, i, 0)),
+        pl.BlockSpec((1, block_kv, h * d_qk), lambda b_, i, j: (b_, j, 0)),
+        pl.BlockSpec((1, block_kv, h * d_v), lambda b_, i, j: (b_, j, 0)),
+    ]
+    inputs += [q, k, v]
 
     out, lse = pl.pallas_call(
         functools.partial(
@@ -664,14 +785,11 @@ def _flash_packed_fwd_impl(q, k, v, bias, causal, offset, sm_scale, block_q, blo
             num_heads=h,
             d_qk=d_qk,
             d_v=d_v,
+            has_bias=bias is not None,
+            v2=v2,
         ),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, block_kv), lambda b_, i, j: (b_, 0, j)),
-            pl.BlockSpec((1, block_q, h * d_qk), lambda b_, i, j: (b_, i, 0)),
-            pl.BlockSpec((1, block_kv, h * d_qk), lambda b_, i, j: (b_, j, 0)),
-            pl.BlockSpec((1, block_kv, h * d_v), lambda b_, i, j: (b_, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, h * d_v), lambda b_, i, j: (b_, i, 0)),
             pl.BlockSpec((1, block_q, h * RES_LANES), lambda b_, i, j: (b_, i, 0)),
@@ -681,26 +799,26 @@ def _flash_packed_fwd_impl(q, k, v, bias, causal, offset, sm_scale, block_q, blo
             jax.ShapeDtypeStruct((b, nq, h * RES_LANES), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((h, block_q, LANES), jnp.float32),
-            pltpu.VMEM((h, block_q, LANES), jnp.float32),
+            pltpu.VMEM((h, block_q, stat_lanes), jnp.float32),
+            pltpu.VMEM((h, block_q, stat_lanes), jnp.float32),
             pltpu.VMEM((h, block_q, d_v), jnp.float32),
         ],
         compiler_params=_compiler_params("parallel", "parallel", "arbitrary"),
         interpret=_interpret_default(),
-    )(bias, q, k, v)
+    )(*inputs)
     return out, lse
 
 
-def _flash_packed_fwd(q, k, v, bias, causal, offset, sm_scale, block_q, block_kv, h, d_qk, d_v):
+def _flash_packed_fwd(q, k, v, bias, causal, offset, sm_scale, block_q, block_kv, h, d_qk, d_v, v2):
     out, lse = _flash_packed_fwd_impl(
-        q, k, v, bias, causal, offset, sm_scale, block_q, block_kv, h, d_qk, d_v
+        q, k, v, bias, causal, offset, sm_scale, block_q, block_kv, h, d_qk, d_v, v2
     )
     # slim residual: one lane per head (see the heads-major path note)
     lse_slim = lse.reshape(lse.shape[0], lse.shape[1], h, RES_LANES)[..., :1]
     return out, (q, k, v, bias, out, lse_slim)
 
 
-def _flash_packed_bwd(causal, offset, sm_scale, block_q, block_kv, h, d_qk, d_v, residuals, g):
+def _flash_packed_bwd(causal, offset, sm_scale, block_q, block_kv, h, d_qk, d_v, v2, residuals, g):
     q, k, v, bias, out, lse_slim = residuals
     b, nq, _ = q.shape
     nkv = k.shape[1]
@@ -717,6 +835,30 @@ def _flash_packed_bwd(causal, offset, sm_scale, block_q, block_kv, h, d_qk, d_v,
     delta = jnp.broadcast_to(delta[..., None], (b, nq, h, RES_LANES)).reshape(b, nq, h * RES_LANES)
 
     nqb, nkvb = nq // block_q, nkv // block_kv
+    has_bias = bias is not None
+
+    dkv_in_specs = []
+    dq_in_specs = []
+    if has_bias:
+        dkv_in_specs.append(pl.BlockSpec((1, 1, block_kv), lambda b_, j, i: (b_, 0, j)))
+        dq_in_specs.append(pl.BlockSpec((1, 1, block_kv), lambda b_, i, j: (b_, 0, j)))
+    dkv_in_specs += [
+        pl.BlockSpec((1, block_q, h * d_qk), lambda b_, j, i: (b_, i, 0)),
+        pl.BlockSpec((1, block_kv, h * d_qk), lambda b_, j, i: (b_, j, 0)),
+        pl.BlockSpec((1, block_kv, h * d_v), lambda b_, j, i: (b_, j, 0)),
+        pl.BlockSpec((1, block_q, h * d_v), lambda b_, j, i: (b_, i, 0)),
+        pl.BlockSpec((1, block_q, h * RES_LANES), lambda b_, j, i: (b_, i, 0)),
+        pl.BlockSpec((1, block_q, h * RES_LANES), lambda b_, j, i: (b_, i, 0)),
+    ]
+    dq_in_specs += [
+        pl.BlockSpec((1, block_q, h * d_qk), lambda b_, i, j: (b_, i, 0)),
+        pl.BlockSpec((1, block_kv, h * d_qk), lambda b_, i, j: (b_, j, 0)),
+        pl.BlockSpec((1, block_kv, h * d_v), lambda b_, i, j: (b_, j, 0)),
+        pl.BlockSpec((1, block_q, h * d_v), lambda b_, i, j: (b_, i, 0)),
+        pl.BlockSpec((1, block_q, h * RES_LANES), lambda b_, i, j: (b_, i, 0)),
+        pl.BlockSpec((1, block_q, h * RES_LANES), lambda b_, i, j: (b_, i, 0)),
+    ]
+    inputs = ([bias] if has_bias else []) + [q, k, v, g, lse, delta]
 
     dk, dv = pl.pallas_call(
         functools.partial(
@@ -728,17 +870,11 @@ def _flash_packed_bwd(causal, offset, sm_scale, block_q, block_kv, h, d_qk, d_v,
             num_heads=h,
             d_qk=d_qk,
             d_v=d_v,
+            has_bias=has_bias,
+            v2=v2,
         ),
         grid=(b, nkvb, nqb),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_kv), lambda b_, j, i: (b_, 0, j)),
-            pl.BlockSpec((1, block_q, h * d_qk), lambda b_, j, i: (b_, i, 0)),
-            pl.BlockSpec((1, block_kv, h * d_qk), lambda b_, j, i: (b_, j, 0)),
-            pl.BlockSpec((1, block_kv, h * d_v), lambda b_, j, i: (b_, j, 0)),
-            pl.BlockSpec((1, block_q, h * d_v), lambda b_, j, i: (b_, i, 0)),
-            pl.BlockSpec((1, block_q, h * RES_LANES), lambda b_, j, i: (b_, i, 0)),
-            pl.BlockSpec((1, block_q, h * RES_LANES), lambda b_, j, i: (b_, i, 0)),
-        ],
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, block_kv, h * d_qk), lambda b_, j, i: (b_, j, 0)),
             pl.BlockSpec((1, block_kv, h * d_v), lambda b_, j, i: (b_, j, 0)),
@@ -753,7 +889,7 @@ def _flash_packed_bwd(causal, offset, sm_scale, block_q, block_kv, h, d_qk, d_v,
         ],
         compiler_params=_compiler_params("parallel", "parallel", "arbitrary"),
         interpret=_interpret_default(),
-    )(bias, q, k, v, g, lse, delta)
+    )(*inputs)
 
     (dq,) = pl.pallas_call(
         functools.partial(
@@ -765,17 +901,11 @@ def _flash_packed_bwd(causal, offset, sm_scale, block_q, block_kv, h, d_qk, d_v,
             num_heads=h,
             d_qk=d_qk,
             d_v=d_v,
+            has_bias=has_bias,
+            v2=v2,
         ),
         grid=(b, nqb, nkvb),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_kv), lambda b_, i, j: (b_, 0, j)),
-            pl.BlockSpec((1, block_q, h * d_qk), lambda b_, i, j: (b_, i, 0)),
-            pl.BlockSpec((1, block_kv, h * d_qk), lambda b_, i, j: (b_, j, 0)),
-            pl.BlockSpec((1, block_kv, h * d_v), lambda b_, i, j: (b_, j, 0)),
-            pl.BlockSpec((1, block_q, h * d_v), lambda b_, i, j: (b_, i, 0)),
-            pl.BlockSpec((1, block_q, h * RES_LANES), lambda b_, i, j: (b_, i, 0)),
-            pl.BlockSpec((1, block_q, h * RES_LANES), lambda b_, i, j: (b_, i, 0)),
-        ],
+        in_specs=dq_in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, h * d_qk), lambda b_, i, j: (b_, i, 0)),
         ],
@@ -783,9 +913,9 @@ def _flash_packed_bwd(causal, offset, sm_scale, block_q, block_kv, h, d_qk, d_v,
         scratch_shapes=[pltpu.VMEM((h, block_q, d_qk), jnp.float32)],
         compiler_params=_compiler_params("parallel", "parallel", "arbitrary"),
         interpret=_interpret_default(),
-    )(bias, q, k, v, g, lse, delta)
+    )(*inputs)
 
-    return dq, dk, dv, jnp.zeros_like(bias)
+    return dq, dk, dv, jnp.zeros_like(bias) if has_bias else None
 
 
 _flash_packed.defvjp(_flash_packed_fwd, _flash_packed_bwd)
@@ -813,10 +943,13 @@ def flash_attention_packed(
     pad_mask: Optional[jnp.ndarray] = None,
     causal: bool = False,
     sm_scale: float = 1.0,
-    block_q: int = 1024,
-    block_kv: int = 2048,
+    block_q: Optional[int] = None,
+    block_kv: Optional[int] = None,
 ) -> jnp.ndarray:
     """Blockwise fused attention over packed slots-major tensors.
+
+    ``block_q``/``block_kv``: None = tuned default hint (a no-pad divisor up
+    to 25% larger may be picked); an explicit value is an upper bound.
 
     :param q: queries (B, Nq, H*Dqk), already scaled/rotated.
     :param k: keys (B, Nkv, H*Dqk), already rotated.
@@ -834,22 +967,28 @@ def flash_attention_packed(
     d_v = v.shape[2] // h
     offset = nkv - nq
 
-    block_q = _choose_block(nq, block_q)
-    block_kv = _choose_block(nkv, block_kv)
+    block_q = _choose_block(nq, 1024 if block_q is None else block_q, exact=block_q is not None)
+    block_kv = _choose_block(nkv, 2048 if block_kv is None else block_kv, exact=block_kv is not None)
 
     qf = _pad_to(q, 1, block_q)
     kf = _pad_to(k, 1, block_kv)
     vf = _pad_to(v, 1, block_kv)
 
+    v2 = FAST_FEATURES
     nkv_p = kf.shape[1]
-    bias = jnp.zeros((b, nkv_p), jnp.float32)
-    if pad_mask is not None:
-        bias = bias.at[:, :nkv].set(jnp.where(pad_mask, MASK_VALUE, 0.0))
-    if nkv_p != nkv:
-        bias = bias.at[:, nkv:].set(MASK_VALUE)
-    bias = bias[:, None, :]
+    if "nobias" in v2 and pad_mask is None and nkv_p == nkv:
+        # all-zero bias: drop the stream + per-tile add entirely (the
+        # flagship path — packed full windows, divisor blocks)
+        bias = None
+    else:
+        bias = jnp.zeros((b, nkv_p), jnp.float32)
+        if pad_mask is not None:
+            bias = bias.at[:, :nkv].set(jnp.where(pad_mask, MASK_VALUE, 0.0))
+        if nkv_p != nkv:
+            bias = bias.at[:, nkv:].set(MASK_VALUE)
+        bias = bias[:, None, :]
 
-    out = _flash_packed(qf, kf, vf, bias, causal, offset, sm_scale, block_q, block_kv, h, d_qk, d_v)
+    out = _flash_packed(qf, kf, vf, bias, causal, offset, sm_scale, block_q, block_kv, h, d_qk, d_v, v2)
     return out[:, :nq, :]
 
 
@@ -860,10 +999,11 @@ def flash_attention(
     pad_mask: Optional[jnp.ndarray] = None,
     causal: bool = False,
     sm_scale: float = 1.0,
-    # re-tuned at batch 4 on v5e (same-process sweep): block_q 1024 beats 512
-    # by ~1.6% and 256 by ~8%; block_kv 2048-class is flat vs 4352
-    block_q: int = 1024,
-    block_kv: int = 2048,
+    # None = tuned defaults, re-tuned at batch 4 on v5e (same-process sweep):
+    # block_q 1024 beats 512 by ~1.6% and 256 by ~8%; block_kv 2048-class is
+    # flat vs 4352. Explicit values are upper bounds (exact _choose_block).
+    block_q: Optional[int] = None,
+    block_kv: Optional[int] = None,
 ) -> jnp.ndarray:
     """Blockwise fused attention.
 
@@ -881,8 +1021,8 @@ def flash_attention(
     d_v = v.shape[3]
     offset = nkv - nq  # from the *unpadded* lengths
 
-    block_q = _choose_block(nq, block_q)
-    block_kv = _choose_block(nkv, block_kv)
+    block_q = _choose_block(nq, 1024 if block_q is None else block_q, exact=block_q is not None)
+    block_kv = _choose_block(nkv, 2048 if block_kv is None else block_kv, exact=block_kv is not None)
 
     qf = _pad_to(q.reshape(b * h, nq, d_qk), 1, block_q)
     kf = _pad_to(k.reshape(b * h, nkv, d_qk), 1, block_kv)
@@ -898,30 +1038,40 @@ def flash_attention(
     vf = _pad_to(vf, 2, 8)
 
     # additive kv bias per (batch*head) row: padded slots + user pad mask
+    v2 = FAST_FEATURES
     nkv_p = kf.shape[1]
-    bias = jnp.zeros((b, nkv_p), jnp.float32)
-    if pad_mask is not None:
-        bias = bias.at[:, :nkv].set(jnp.where(pad_mask, MASK_VALUE, 0.0))
-    if nkv_p != nkv:
-        bias = bias.at[:, nkv:].set(MASK_VALUE)
-    # kernels index the (B, 1, Nkv_p) bias with (bh // num_heads, 0, j)
-    bias = bias[:, None, :]
+    if "nobias" in v2 and pad_mask is None and nkv_p == nkv:
+        bias = None  # all-zero: drop the stream + per-tile add (see packed)
+    else:
+        bias = jnp.zeros((b, nkv_p), jnp.float32)
+        if pad_mask is not None:
+            bias = bias.at[:, :nkv].set(jnp.where(pad_mask, MASK_VALUE, 0.0))
+        if nkv_p != nkv:
+            bias = bias.at[:, nkv:].set(MASK_VALUE)
+        # kernels index the (B, 1, Nkv_p) bias with (bh // num_heads, 0, j)
+        bias = bias[:, None, :]
 
-    out = _flash(qf, kf, vf, bias, causal, offset, sm_scale, block_q, block_kv, h)
+    out = _flash(qf, kf, vf, bias, causal, offset, sm_scale, block_q, block_kv, h, v2)
     return out[:, :nq, :d_v].reshape(b, h, nq, d_v)
 
 
-def _choose_block(n: int, requested: int) -> int:
+def _choose_block(n: int, requested: int, exact: bool = False) -> int:
     """Pick a block size for an axis of length ``n``: prefer an exact divisor
-    (multiple of 128, within 1.25x of the requested size) so the wrapper need
-    not pad at all — e.g. the dropout-discounted 16k cross-attention kv of
-    8704 takes block 2176 instead of padding to 10240 (pad + slice copies and
-    ~18% wasted kernel iterations, profiled ~0.6 ms/step at batch 4).
+    (multiple of 128) so the wrapper need not pad at all — e.g. the
+    dropout-discounted 16k cross-attention kv of 8704 takes block 2176
+    instead of padding to 10240 (pad + slice copies and ~18% wasted
+    backward-kernel iterations, profiled ~0.6 ms/step at batch 4).
     Fall back to the requested size capped to a power of two (the original
-    pad-to-multiple path)."""
+    pad-to-multiple path).
+
+    ``exact=False`` (the wrappers' *default* hint): a divisor up to 25%
+    LARGER than the hint may be chosen. ``exact=True`` (caller passed an
+    explicit block size — tests, VMEM-tuned configs, A/B sweeps): divisors
+    never exceed the requested size, so the choice is an upper bound."""
+    slack = 0 if exact else requested // 4
     best = 0
     for b in range(LANES, n + 1, LANES):
-        if n % b == 0 and b <= requested + requested // 4:
+        if n % b == 0 and b <= requested + slack:
             best = b
     # only take the divisor when it is actually near the requested size —
     # a 128-wide divisor for an awkward length (e.g. 128*prime) would trade

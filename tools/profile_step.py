@@ -1,0 +1,80 @@
+"""Capture a device profile of the flagship train step and print the top
+device ops (tools/xplane.py parser — no TensorFlow needed).
+
+    python tools/profile_step.py [--batch-size 4] [--top 40] [--out /tmp/prof]
+
+The per-op durations come from the device plane, so host/tunnel dispatch
+jitter does not pollute them; a handful of eagerly dispatched steps inside
+the trace window is enough.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bench import flagship_config
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_probe_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--seq-len", type=int, default=16384)
+    p.add_argument("--latents", type=int, default=1024)
+    p.add_argument("--batch-size", type=int, default=4)
+    p.add_argument("--steps", type=int, default=5)
+    p.add_argument("--top", type=int, default=40)
+    p.add_argument("--out", default="/tmp/prof_step")
+    args = p.parse_args()
+
+    from perceiver_io_tpu.models.text import CausalLanguageModel
+    from perceiver_io_tpu.training import TrainState, clm_loss_fn, make_optimizer
+    from perceiver_io_tpu.training.loop import make_train_step
+
+    config = flagship_config(args.seq_len, args.latents)
+    model = CausalLanguageModel(config, dtype=jnp.bfloat16)
+    b, n = args.batch_size, args.seq_len
+    rng = np.random.default_rng(0)
+    t = rng.integers(0, config.vocab_size, size=(b, n + 1))
+    batch = {
+        "labels": jnp.asarray(t[:, 1:]),
+        "input_ids": jnp.asarray(t[:, :-1]),
+        "pad_mask": None,
+    }
+    params = model.init(jax.random.PRNGKey(0), batch["input_ids"][:, : args.latents + 1], prefix_len=1)
+    tx = make_optimizer(1e-3, gradient_clip=1.0)
+    state = TrainState.create(model.apply, params, tx, jax.random.PRNGKey(1))
+    step = make_train_step(clm_loss_fn(model.apply, max_latents=args.latents))
+
+    # warm up / compile outside the trace
+    for _ in range(2):
+        state, metrics = step(state, batch)
+        float(metrics["loss"])
+
+    jax.profiler.start_trace(args.out)
+    for _ in range(args.steps):
+        state, metrics = step(state, batch)
+        float(metrics["loss"])
+    jax.profiler.stop_trace()
+
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "xplane", os.path.join(os.path.dirname(os.path.abspath(__file__)), "xplane.py")
+    )
+    xplane = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(xplane)
+    xplane.summarize(args.out, args.top, "")
+
+
+if __name__ == "__main__":
+    main()
