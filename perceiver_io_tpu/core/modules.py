@@ -33,6 +33,7 @@ from flax import struct
 from jax import lax
 
 from perceiver_io_tpu.core.attention import AttentionOutput, KVCache, MultiHeadAttention, init_kv_cache
+from perceiver_io_tpu.ops.layernorm import FusedLayerNorm
 from perceiver_io_tpu.core.config import CausalSequenceModelConfig
 from perceiver_io_tpu.core.position import positions
 
@@ -96,8 +97,8 @@ class CrossAttention(nn.Module):
     use_flash: Optional[bool] = None
 
     def setup(self):
-        self.q_norm = nn.LayerNorm(epsilon=LAYER_NORM_EPSILON, dtype=self.dtype)
-        self.kv_norm = nn.LayerNorm(epsilon=LAYER_NORM_EPSILON, dtype=self.dtype)
+        self.q_norm = FusedLayerNorm(epsilon=LAYER_NORM_EPSILON, dtype=self.dtype)
+        self.kv_norm = FusedLayerNorm(epsilon=LAYER_NORM_EPSILON, dtype=self.dtype)
         self.attention = MultiHeadAttention(
             num_heads=self.num_heads,
             num_q_input_channels=self.num_q_input_channels,
@@ -159,7 +160,7 @@ class SelfAttention(nn.Module):
     use_flash: Optional[bool] = None
 
     def setup(self):
-        self.norm = nn.LayerNorm(epsilon=LAYER_NORM_EPSILON, dtype=self.dtype)
+        self.norm = FusedLayerNorm(epsilon=LAYER_NORM_EPSILON, dtype=self.dtype)
         self.attention = MultiHeadAttention(
             num_heads=self.num_heads,
             num_q_input_channels=self.num_channels,
@@ -216,7 +217,8 @@ class MLP(nn.Module):
             dtype=self.dtype,
             name=name,
         )
-        x = nn.LayerNorm(epsilon=LAYER_NORM_EPSILON, dtype=self.dtype)(x)
+        # name pinned: auto-naming would differ from nn.LayerNorm's
+        x = FusedLayerNorm(epsilon=LAYER_NORM_EPSILON, dtype=self.dtype, name="LayerNorm_0")(x)
         x = dense(self.widening_factor * self.num_channels, "dense_1")(x)
         x = nn.gelu(x, approximate=False)
         x = dense(self.num_channels, "dense_2")(x)
@@ -1005,7 +1007,7 @@ class CausalSequenceModel(nn.Module):
             **ar_kwargs,
         )
         if cfg.output_norm:
-            self.out_norm = nn.LayerNorm(epsilon=LAYER_NORM_EPSILON, dtype=self.dtype)
+            self.out_norm = FusedLayerNorm(epsilon=LAYER_NORM_EPSILON, dtype=self.dtype)
         self.output_adapter = TiedTokenOutputAdapter(
             vocab_size=cfg.vocab_size, emb_bias=cfg.output_bias, dtype=self.dtype
         )

@@ -105,6 +105,7 @@ def test_checkpoint_save_restore(tmp_path):
     mngr.close()
 
 
+@pytest.mark.slow  # long-compile; the fast subset keeps one representative of this path
 def test_pretrained_roundtrip(tmp_path):
     model, config = tiny_classifier()
     state, batch = make_state(model, config)
@@ -199,6 +200,7 @@ def test_trainer_fit_and_resume(tmp_path):
     assert int(out2.step) == 30
 
 
+@pytest.mark.slow  # long-compile; the fast subset keeps one representative of this path
 def test_trainer_callback_runs(tmp_path):
     model, config = tiny_classifier()
     state, batch = make_state(model, config)

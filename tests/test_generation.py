@@ -142,6 +142,7 @@ def test_sampling_strategies(model_and_params):
     assert out3.shape == (B, 14)
 
 
+@pytest.mark.slow  # long-compile; the fast subset keeps one representative of this path
 def test_eos_stops_generation(model_and_params):
     model, params = model_and_params
     ids = prompt()
@@ -204,6 +205,7 @@ class TestBeamSearch:
         # reported score = mean log-prob at length_penalty 1
         np.testing.assert_allclose(np.asarray(scores), lp_beam / k, atol=2e-2)  # cached-vs-uncached f32 drift
 
+    @pytest.mark.slow  # long-compile; the fast subset keeps one representative of this path
     def test_beam_one_equals_greedy_past_latent_window(self, model_and_params):
         """Regression: generation deeper than max_latents must slide the
         self-attention caches exactly like generate() does."""
@@ -225,6 +227,7 @@ class TestBeamSearch:
         with pytest.raises(ValueError, match="does not slide the window"):
             beam_search(model, params, prompt(20), num_latents=8, max_new_tokens=8)
 
+    @pytest.mark.slow  # long-compile; the fast subset keeps one representative of this path
     def test_beam_padded_batch_equals_unpadded_rows(self, model_and_params):
         """Mixed-length prompts via left padding: each padded row's beam
         continuation equals the row run alone without padding (pad slots

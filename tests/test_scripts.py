@@ -128,6 +128,7 @@ def test_clm_cli_fit(tmp_path):
     assert metrics_files, "expected a metrics.csv in the run dir"
 
 
+@pytest.mark.slow  # long-compile; the fast subset keeps one representative of this path
 def test_mlm_cli_fit(tmp_path):
     from perceiver_io_tpu.scripts.text.mlm import main as mlm_main
     from perceiver_io_tpu.training.checkpoint import save_pretrained
@@ -235,6 +236,7 @@ def test_classifier_encoder_warm_start_and_freeze(tmp_path):
     assert any(not np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(dec_before, dec_after))
 
 
+@pytest.mark.slow  # long-compile; the fast subset keeps one representative of this path
 def test_image_classifier_cli_fit(tmp_path):
     from perceiver_io_tpu.scripts.vision.image_classifier import main
 
@@ -272,6 +274,7 @@ def test_preproc_cli(tmp_path):
     assert list((tmp_path / "cache").glob("preproc-*.npz"))
 
 
+@pytest.mark.slow  # long-compile; the fast subset keeps one representative of this path
 def test_resume_from_weights_only_checkpoint(tmp_path):
     """Resuming full-state training from a weights-only checkpoint restores
     params and starts the optimizer fresh (Lightning save_weights_only
@@ -312,6 +315,7 @@ def test_resume_from_weights_only_checkpoint(tmp_path):
     assert int(state2.step) == 4
 
 
+@pytest.mark.slow  # long-compile; the fast subset keeps one representative of this path
 def test_validate_restores_checkpoint(tmp_path):
     """`validate` evaluates the checkpointed weights, not the fresh init
     (the Lightning `validate --ckpt_path` analog)."""

@@ -114,6 +114,7 @@ class TestDataModule:
 
 
 class TestCLI:
+    @pytest.mark.slow  # long-compile; the fast subset keeps one representative of this path
     def test_fit(self, tmp_path):
         from perceiver_io_tpu.scripts.timeseries import main
 

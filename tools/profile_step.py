@@ -26,6 +26,29 @@ jax.config.update("jax_compilation_cache_dir", "/tmp/jax_probe_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 
+def decode_profile(args):
+    """Trace a compiled decode scan (full 16k window) — per-op durations are
+    the per-TOKEN cost times the scan length."""
+    from perceiver_io_tpu.generation import GenerationConfig, make_generate_fn
+    from perceiver_io_tpu.models.text import CausalLanguageModel
+
+    config = flagship_config(args.seq_len, args.latents)
+    model = CausalLanguageModel(config, dtype=jnp.bfloat16)
+    b = args.batch_size
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(rng.integers(0, config.vocab_size, size=(b, args.seq_len)))
+    params = model.init(jax.random.PRNGKey(0), prompt[:, : args.latents + 1], prefix_len=1)
+    gen = make_generate_fn(
+        model, args.latents,
+        GenerationConfig(max_new_tokens=args.steps, do_sample=True, top_k=10),
+        cache_dtype=jnp.bfloat16,
+    )
+    float(gen(params, prompt)[0, -1])  # compile + warm
+    jax.profiler.start_trace(args.out)
+    float(gen(params, prompt)[0, -1])
+    jax.profiler.stop_trace()
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--seq-len", type=int, default=16384)
@@ -34,7 +57,12 @@ def main():
     p.add_argument("--steps", type=int, default=5)
     p.add_argument("--top", type=int, default=40)
     p.add_argument("--out", default="/tmp/prof_step")
+    p.add_argument("--mode", choices=["train", "decode"], default="train")
     args = p.parse_args()
+
+    if args.mode == "decode":
+        decode_profile(args)
+        return _summarize(args)
 
     from perceiver_io_tpu.models.text import CausalLanguageModel
     from perceiver_io_tpu.training import TrainState, clm_loss_fn, make_optimizer
@@ -65,7 +93,10 @@ def main():
         state, metrics = step(state, batch)
         float(metrics["loss"])
     jax.profiler.stop_trace()
+    _summarize(args)
 
+
+def _summarize(args):
     import importlib.util
 
     spec = importlib.util.spec_from_file_location(
