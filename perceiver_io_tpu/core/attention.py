@@ -33,6 +33,7 @@ TPU-first differences from the reference:
 
 from __future__ import annotations
 
+from contextlib import contextmanager
 from typing import Optional, Tuple
 
 import jax
@@ -85,6 +86,29 @@ def init_kv_cache(
 class AttentionOutput:
     last_hidden_state: jnp.ndarray
     kv_cache: Optional[KVCache] = None
+
+
+_PREFILL = False
+
+
+@contextmanager
+def prefill_mode():
+    """Trace-time marker: the enclosed forward populates EMPTY caches (the
+    generation prompt pass). Attention then computes its output with the
+    packed flash kernels over the FRESH keys/values instead of the
+    slot-capacity einsum path — profiled at batch 8 / 16k context, the
+    einsum prime materializes a 4.3 GB f32 (B, H, latents, capacity) score
+    tensor and ~19 ms of attention work per generate call that flash does
+    in ~1.3 ms, and that materialization (not the decode loop) is what
+    bounds the decode batch size. The caches are still written identically
+    (rotate-at-write). Only valid when every cache entered empty — callers
+    are the two prompt passes in generation.py."""
+    global _PREFILL
+    _PREFILL = True
+    try:
+        yield
+    finally:
+        _PREFILL = False
 
 
 class MultiHeadAttention(nn.Module):
@@ -178,6 +202,29 @@ class MultiHeadAttention(nn.Module):
         b, _, n, _ = o.shape
         return self.o_proj(o.transpose(0, 2, 1, 3).reshape(b, n, self.v_channels))
 
+    def _packed_flash(self, q, k, v, rope_q, pad_mask, already_rotated_k: bool, rope_k=None):
+        """Shared packed-flash invocation: scale/rotate q in the packed
+        layout, rotate k unless the caller already did (the cache path
+        rotates at write time), and run the fused kernels."""
+        h = self.num_heads
+        qk_per_head = self.qk_channels // h
+        q4 = q.reshape(q.shape[0], q.shape[1], h, qk_per_head) * qk_per_head**-0.5
+        if rope_q is not None:
+            q4 = apply_rotary_pos_emb(q4, rope_q[:, :, None, :])
+        if rope_k is not None and not already_rotated_k:
+            k4 = k.reshape(k.shape[0], k.shape[1], h, qk_per_head)
+            k4 = apply_rotary_pos_emb(k4, rope_k[:, :, None, :])
+            k = k4.reshape(k.shape)
+        return flash_attention_packed(
+            q4.reshape(q.shape),
+            k,
+            v,
+            num_heads=h,
+            pad_mask=pad_mask,
+            causal=self.causal_attention,
+            sm_scale=1.0,
+        )
+
     def __call__(
         self,
         x_q: jnp.ndarray,
@@ -222,22 +269,7 @@ class MultiHeadAttention(nn.Module):
                 n_q, x_kv.shape[1], qk_per_head, self.v_channels // h, dropout_active
             )
         ):
-            q4 = q.reshape(q.shape[0], n_q, h, qk_per_head) * qk_per_head**-0.5
-            if rope_q is not None:
-                q4 = apply_rotary_pos_emb(q4, rope_q[:, :, None, :])
-            if rope_k is not None:
-                k4 = k.reshape(k.shape[0], k.shape[1], h, qk_per_head)
-                k4 = apply_rotary_pos_emb(k4, rope_k[:, :, None, :])
-                k = k4.reshape(k.shape)
-            o = flash_attention_packed(
-                q4.reshape(q.shape),
-                k,
-                v,
-                num_heads=h,
-                pad_mask=pad_mask,
-                causal=self.causal_attention,
-                sm_scale=1.0,
-            )
+            o = self._packed_flash(q, k, v, rope_q, pad_mask, already_rotated_k=False, rope_k=rope_k)
             return AttentionOutput(last_hidden_state=self.o_proj(o), kv_cache=None)
 
         if kv_cache is not None:
@@ -256,6 +288,33 @@ class MultiHeadAttention(nn.Module):
             v_slots = lax.dynamic_update_slice(kv_cache.v, v.astype(kv_cache.v.dtype), (0, start, 0))
             eff_len = start + x_kv.shape[1]
             new_cache = KVCache(k=k_slots, v=v_slots, length=eff_len)
+
+            # prefill (see prefill_mode): the caches entered empty, so the
+            # attention over [0, eff_len) IS the attention over the fresh
+            # k/v — take the packed flash path instead of the slot-capacity
+            # einsum (which materializes f32 (B, H, Nq, capacity) scores).
+            # Misuse guard: a CONCRETE non-empty cache (eager chunked
+            # prefill) falls back to the correct einsum path; a traced
+            # length cannot be checked (generation creates the cache inside
+            # its jitted program) — those callers own the empty-cache
+            # contract.
+            from perceiver_io_tpu.utils.arrays import concrete_or_none
+
+            concrete_len = concrete_or_none(kv_cache.length)
+            if (
+                _PREFILL
+                and n_q > 1
+                and (concrete_len is None or int(concrete_len) == 0)
+                and flash_enabled(self.use_flash)
+                and packed_supported(h, qk_per_head, self.v_channels // h)
+                and flash_supported(
+                    n_q, x_kv.shape[1], qk_per_head, self.v_channels // h, dropout_active
+                )
+            ):
+                # slot-aligned pad mask: fresh tokens occupy slots [0, n_kv)
+                fresh_pad = None if pad_mask is None else pad_mask[:, : x_kv.shape[1]]
+                o = self._packed_flash(q, k, v, rope_q, fresh_pad, already_rotated_k=True)
+                return AttentionOutput(last_hidden_state=self.o_proj(o), kv_cache=new_cache)
         else:
             k_slots, v_slots = k, v
             eff_len = x_kv.shape[1]

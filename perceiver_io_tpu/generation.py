@@ -33,7 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from perceiver_io_tpu.core.attention import KVCache
+from perceiver_io_tpu.core.attention import KVCache, prefill_mode
 from perceiver_io_tpu.utils.arrays import concrete_or_none
 
 
@@ -168,9 +168,10 @@ def beam_search(
     bb = b * num_beams
     # prompt pass on B rows, then tile caches/logits to B*num_beams rows
     small_cache = CausalSequenceModel.init_cache(mcfg, b, dtype=cache_dtype)
-    out = model.apply(
-        params, input_ids, prefix_len=prefix_len, pad_mask=pad_mask, kv_cache=small_cache
-    )
+    with prefill_mode():
+        out = model.apply(
+            params, input_ids, prefix_len=prefix_len, pad_mask=pad_mask, kv_cache=small_cache
+        )
 
     def tile(x):
         return jnp.repeat(x, num_beams, axis=0)
@@ -356,8 +357,10 @@ def generate(
     # left-pads only; expired slots are derived from the start counters)
     pad_slots = jnp.zeros((b, ca_capacity), bool).at[:, :seq_len].set(pad_mask)
 
-    # prompt pass (populates caches)
-    out = model.apply(params, input_ids, prefix_len=prefix_len, pad_mask=pad_mask, kv_cache=cache)
+    # prompt pass (populates caches); prefill_mode routes its attention
+    # through the flash kernels over the fresh k/v (see core/attention.py)
+    with prefill_mode():
+        out = model.apply(params, input_ids, prefix_len=prefix_len, pad_mask=pad_mask, kv_cache=cache)
     rng, first_rng = jax.random.split(rng)
     next_token = _sample(out.logits[:, -1], first_rng, config)
     cache = out.kv_cache

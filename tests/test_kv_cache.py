@@ -271,3 +271,55 @@ def test_max_heads_parallel_matches_full(cross_attn):
     np.testing.assert_allclose(
         np.asarray(chunked_c.last_hidden_state), np.asarray(full.last_hidden_state), atol=ATOL
     )
+
+
+def test_prefill_mode_matches_einsum_prime():
+    """generation.py's prompt pass under ``prefill_mode`` (packed flash over
+    the fresh k/v) must reproduce the slot-capacity einsum prime exactly:
+    same latent logits, same cache contents — including a left-padded row.
+    Geometry is flash-sized (the fused path needs >=128 queries/keys)."""
+    from perceiver_io_tpu.core.attention import prefill_mode
+    from perceiver_io_tpu.ops.flash_attention import set_default_flash
+
+    config = CausalSequenceModelConfig(
+        vocab_size=100,
+        max_seq_len=256,
+        max_latents=128,
+        num_channels=64,
+        num_heads=4,
+        num_self_attention_layers=2,
+        num_self_attention_rotary_layers=-1,
+        output_norm=True,
+    )
+    model = CausalSequenceModel(config)
+    total = 256
+    x = jnp.asarray(np.random.default_rng(3).integers(0, 100, size=(BATCH_SIZE, total)))
+    pad_mask = jnp.zeros((BATCH_SIZE, total), bool).at[0, :5].set(True)
+    params = model.init(jax.random.PRNGKey(0), x, prefix_len=128)
+
+    out_ref = model.apply(
+        params, x, prefix_len=128, pad_mask=pad_mask,
+        kv_cache=CausalSequenceModel.init_cache(config, BATCH_SIZE),
+    )
+
+    set_default_flash(True)
+    try:
+        with prefill_mode():
+            out_flash = model.apply(
+                params, x, prefix_len=128, pad_mask=pad_mask,
+                kv_cache=CausalSequenceModel.init_cache(config, BATCH_SIZE),
+            )
+    finally:
+        set_default_flash(None)
+
+    np.testing.assert_allclose(
+        np.asarray(out_flash.logits), np.asarray(out_ref.logits), atol=2e-5, rtol=2e-5
+    )
+    for i, (c_f, c_r) in enumerate(zip(out_flash.kv_cache, out_ref.kv_cache)):
+        assert int(c_f.length) == int(c_r.length)
+        np.testing.assert_allclose(
+            np.asarray(c_f.k), np.asarray(c_r.k), atol=1e-6, err_msg=f"cache {i} k"
+        )
+        np.testing.assert_allclose(
+            np.asarray(c_f.v), np.asarray(c_r.v), atol=1e-6, err_msg=f"cache {i} v"
+        )
