@@ -13,9 +13,17 @@ before rsqrt. The ``FusedLayerNorm`` module stores the same parameters
 ({scale, bias}, f32) under the same names, so checkpoints are
 interchangeable with ``nn.LayerNorm``.
 
-Off-TPU (and for shapes the tiles don't fit) a plain jnp fallback with the
-identical formula applies; ``set_default_fused_ln`` mirrors
-``set_default_flash`` for forcing the kernel (interpret mode) in tests.
+MEASURED AND REJECTED as the training-path default (same-process
+interleaved full-step A/B on the 16k flagship, batch 4, v5e): the fused
+kernels are ~1% SLOWER end-to-end than XLA's layernorm fusions (22.93 vs
+22.71 ms/step) despite their ~1.5 ms exclusive-time footprint — XLA
+overlaps the stat fusions with surrounding work, and the pallas_call
+boundary breaks the adjacent-op fusions the LN input/output otherwise
+joins. The lesson generalizes (see docs/performance.md round-3 notes):
+this step is SCHEDULE-bound, and exclusive-time profiles overstate what
+removing an op can save. The kernels stay correct, tested, and toggleable
+(``set_default_fused_ln(True)``) for shapes/backends where the trade
+differs; the default everywhere is the identical-formula jnp fallback.
 """
 
 from __future__ import annotations
@@ -32,12 +40,13 @@ from jax.experimental.pallas import tpu as pltpu
 
 STAT_LANES = 8  # residual lanes for per-row mean/rstd (lane 0 carries data)
 
-_FUSED_LN_DEFAULT: Optional[bool] = None  # None = auto (TPU backend only)
+_FUSED_LN_DEFAULT: Optional[bool] = None  # None = auto (currently: OFF, see module notes)
 
 
 def set_default_fused_ln(mode: Optional[bool]) -> None:
     """True forces the Pallas path (interpret off-TPU — slow, for tests),
-    False disables it, None restores auto. Read at trace time."""
+    False disables it, None restores the measured auto default (off).
+    Read at trace time."""
     global _FUSED_LN_DEFAULT
     _FUSED_LN_DEFAULT = mode
 
@@ -45,7 +54,9 @@ def set_default_fused_ln(mode: Optional[bool]) -> None:
 def _fused_enabled() -> bool:
     if _FUSED_LN_DEFAULT is not None:
         return _FUSED_LN_DEFAULT
-    return jax.default_backend() == "tpu"
+    # auto = off: the fused path measured ~1% slower on the flagship train
+    # step (A/B above); flip with set_default_fused_ln to re-probe
+    return False
 
 
 def _interpret_default() -> bool:
