@@ -398,4 +398,5 @@ def run_training(
         )
         return state, None
     finally:
+        trainer.close()
         logger.close()

@@ -251,16 +251,25 @@ class CheckpointManager:
         monitor: str = "val_loss",
         mode: str = "min",
         save_weights_only: bool = False,
+        enable_async: bool = False,
     ):
+        """``enable_async=True`` overlaps checkpoint serialization/IO with
+        continued training (orbax async checkpointing — the Trainer turns
+        this on): ``save`` returns once the on-device state is snapshotted
+        and the write proceeds in the background. Every read-side method
+        (``latest_step``/``best_step``/``restore``) and ``close`` first
+        ``wait_until_finished``, so save-then-restore stays correct."""
         self.directory = os.path.abspath(directory)
         self.monitor = monitor
         self.save_weights_only = save_weights_only
+        self.enable_async = enable_async
+        self._config_written = False
         options = ocp.CheckpointManagerOptions(
             max_to_keep=max_to_keep,
             best_fn=(lambda metrics: metrics[monitor]) if monitor else None,
             best_mode=mode,
             create=True,
-            enable_async_checkpointing=False,
+            enable_async_checkpointing=enable_async,
         )
         self._mngr = ocp.CheckpointManager(self.directory, options=options)
 
@@ -272,15 +281,28 @@ class CheckpointManager:
         saved = self._mngr.save(
             int(state.step), metrics=metrics, args=ocp.args.StandardSave(payload)
         )
-        self._mngr.wait_until_finished()
-        if config is not None:
+        if not self.enable_async:
+            self._mngr.wait_until_finished()
+        if config is not None and not self._config_written:
+            # config.json must never exist without a committed checkpoint
+            # (warm-start tooling reads config then restores): wait for the
+            # first save to commit before the one-time config write — the
+            # config is static per run, so later async saves skip this
+            self._mngr.wait_until_finished()
             save_config(self.directory, config)
+            self._config_written = True
         return saved
 
+    def wait_until_finished(self) -> None:
+        """Block until any in-flight async save has committed."""
+        self._mngr.wait_until_finished()
+
     def latest_step(self) -> Optional[int]:
+        self._mngr.wait_until_finished()
         return self._mngr.latest_step()
 
     def best_step(self) -> Optional[int]:
+        self._mngr.wait_until_finished()
         return self._mngr.best_step()
 
     def restore(self, state, step: Optional[int] = None):
@@ -289,6 +311,7 @@ class CheckpointManager:
         checkpoint actually contains: resuming from a weights-only checkpoint
         restores params/step/rng and leaves the optimizer state fresh
         (Lightning ``save_weights_only`` resume semantics)."""
+        self._mngr.wait_until_finished()
         step = self._mngr.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(f"no checkpoint found under {self.directory}")
@@ -314,4 +337,5 @@ class CheckpointManager:
         return load_config(self.directory)
 
     def close(self):
+        self._mngr.wait_until_finished()
         self._mngr.close()

@@ -99,6 +99,9 @@ class Trainer:
                 monitor=self.config.monitor,
                 mode=self.config.mode,
                 save_weights_only=self.config.save_weights_only,
+                # overlap checkpoint IO with continued training; fit() waits
+                # before returning so callers always see committed state
+                enable_async=True,
             )
 
     # -- helpers ----------------------------------------------------------
@@ -153,31 +156,38 @@ class Trainer:
         window: list = []
         t0 = time.time()
         start_step = int(state.step)
-        for _ in range(start_step, cfg.max_steps):
-            batch = self._prepare_batch(next(train_iter))
-            state, metrics = self._train_step(state, batch)
-            window.append(metrics)
-            step = int(state.step)
+        try:
+            for _ in range(start_step, cfg.max_steps):
+                batch = self._prepare_batch(next(train_iter))
+                state, metrics = self._train_step(state, batch)
+                window.append(metrics)
+                step = int(state.step)
 
-            if step % cfg.log_interval == 0 or step == cfg.max_steps:
-                avg = {
-                    cfg.metric_prefix_train + k: float(np.mean([float(m[k]) for m in window]))
-                    for k in window[-1]
-                }
-                if self.lr_schedule is not None:
-                    avg["lr"] = float(self.lr_schedule(step))
-                avg["steps_per_sec"] = len(window) / max(time.time() - t0, 1e-9)
-                self._log(step, avg)
-                window, t0 = [], time.time()
+                if step % cfg.log_interval == 0 or step == cfg.max_steps:
+                    avg = {
+                        cfg.metric_prefix_train + k: float(np.mean([float(m[k]) for m in window]))
+                        for k in window[-1]
+                    }
+                    if self.lr_schedule is not None:
+                        avg["lr"] = float(self.lr_schedule(step))
+                    avg["steps_per_sec"] = len(window) / max(time.time() - t0, 1e-9)
+                    self._log(step, avg)
+                    window, t0 = [], time.time()
 
-            at_val = cfg.val_interval is not None and step % cfg.val_interval == 0
-            if (at_val or step == cfg.max_steps) and val_loader is not None:
-                val_metrics = self.validate(state, val_loader)
-                self._log(step, val_metrics)
-                if self.checkpoints is not None:
-                    self.checkpoints.save(state, metrics=val_metrics, config=model_config)
-                for cb in self.callbacks:
-                    cb(self, state, step)
+                at_val = cfg.val_interval is not None and step % cfg.val_interval == 0
+                if (at_val or step == cfg.max_steps) and val_loader is not None:
+                    val_metrics = self.validate(state, val_loader)
+                    self._log(step, val_metrics)
+                    if self.checkpoints is not None:
+                        self.checkpoints.save(state, metrics=val_metrics, config=model_config)
+                    for cb in self.callbacks:
+                        cb(self, state, step)
+        finally:
+            # commit any in-flight async save even when the loop raises
+            # (callback/iterator error, KeyboardInterrupt) — otherwise a
+            # hard exit abandons the last checkpoint
+            if self.checkpoints is not None:
+                self.checkpoints.wait_until_finished()
         if val_loader is None and self.checkpoints is not None:
             # no validation: leave a final latest-state checkpoint via a
             # monitor-free manager (Lightning save-last parity) so NaN metrics
@@ -191,3 +201,11 @@ class Trainer:
             final_mngr.save(state, config=model_config)
             final_mngr.close()
         return state
+
+    def close(self) -> None:
+        """Release the checkpoint manager (waits for in-flight async saves).
+        ``run_training`` calls this; long-lived callers constructing many
+        Trainers should too."""
+        if self.checkpoints is not None:
+            self.checkpoints.close()
+            self.checkpoints = None

@@ -351,3 +351,25 @@ def test_orbax_warm_start_prefers_best_step(tmp_path):
     for a, b in zip(jax.tree.leaves(loaded), jax.tree.leaves(best_params)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
+
+
+@pytest.mark.slow  # long-compile; the fast subset keeps one representative of this path
+def test_async_checkpointing_save_restore(tmp_path):
+    """Async manager (the Trainer's configuration): saves overlap compute,
+    read-side methods wait for in-flight commits, and a restore after a
+    burst of async saves returns exactly the last committed state."""
+    model, config = tiny_classifier()
+    state, batch = make_state(model, config)
+    mngr = CheckpointManager(
+        str(tmp_path / "async"), max_to_keep=2, monitor=None, enable_async=True
+    )
+    for step in (1, 2, 3):
+        mngr.save(state.replace(step=jnp.asarray(step)))
+    assert mngr.latest_step() == 3  # waits for the in-flight save
+    restored = mngr.restore(state)
+    assert int(restored.step) == 3
+    for a, b in zip(
+        jax.tree.leaves(restored.params), jax.tree.leaves(state.params)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    mngr.close()

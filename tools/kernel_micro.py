@@ -65,7 +65,17 @@ def main():
     fa = sys.modules["perceiver_io_tpu.ops.flash_attention"]
 
     def mode(name):
+        # "bkv1088" / "bq512": round-2 kernels with BWD_BLOCK_KV/Q overridden
+        if name.startswith("bkv") or name.startswith("bq"):
+            return False
         return True if name == "all" else False if name == "none" else name.split(",")
+
+    def bwd_blocks(name):
+        if name.startswith("bkv"):
+            return None, int(name[3:])
+        if name.startswith("bq"):
+            return int(name[2:]), None
+        return None, None
 
     rng = np.random.default_rng(0)
     runs = {}  # (variant, geom, mode) -> fn(iters) -> float
@@ -77,6 +87,7 @@ def main():
 
         for vname in args.variants:
             fa.set_fast_kernels(mode(vname))
+            fa.BWD_BLOCK_Q, fa.BWD_BLOCK_KV = bwd_blocks(vname)
 
             def attn(q, k, v):
                 return fa.flash_attention_packed(
@@ -151,6 +162,8 @@ def main():
                 fn(2 + args.iters)
                 print(f"{(vname, gname, cname)}: compiled in {time.perf_counter() - t0:.0f}s", flush=True)
                 runs[(vname, gname, cname)] = fn
+    fa.BWD_BLOCK_Q = fa.BWD_BLOCK_KV = None
+    fa.set_fast_kernels(False)  # library default (round-2 kernels)
 
     n_short, n_long = 2, 2 + args.iters
 
