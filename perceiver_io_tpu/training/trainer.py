@@ -39,6 +39,9 @@ class TrainerConfig:
     fsdp_min_weight_size: int = 2**14
     metric_prefix_train: str = "train_"
     metric_prefix_val: str = "val_"
+    # host-side batch production overlapped with device compute via a
+    # producer thread (data/loader.py PrefetchIterator); 0 disables
+    prefetch_batches: int = 2
 
 
 class Trainer:
@@ -153,9 +156,16 @@ class Trainer:
                 state = self.checkpoints.restore(state)
 
         train_iter = iter(train_iter)
+        prefetch = None
+        start_step = int(state.step)
+        if cfg.prefetch_batches > 0 and start_step < cfg.max_steps:
+            # only when steps will actually run — a no-op fit must not pull
+            # (and discard) items from a shared stateful iterator
+            from perceiver_io_tpu.data.loader import PrefetchIterator
+
+            train_iter = prefetch = PrefetchIterator(train_iter, depth=cfg.prefetch_batches)
         window: list = []
         t0 = time.time()
-        start_step = int(state.step)
         try:
             for _ in range(start_step, cfg.max_steps):
                 batch = self._prepare_batch(next(train_iter))
@@ -183,6 +193,8 @@ class Trainer:
                     for cb in self.callbacks:
                         cb(self, state, step)
         finally:
+            if prefetch is not None:
+                prefetch.close()
             # commit any in-flight async save even when the loop raises
             # (callback/iterator error, KeyboardInterrupt) — otherwise a
             # hard exit abandons the last checkpoint

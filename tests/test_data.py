@@ -344,3 +344,48 @@ def test_streaming_chunks_match_naive_construction():
     assert len(chunks) == len(naive)
     for c, n in zip(chunks, naive):
         np.testing.assert_array_equal(np.asarray(c), np.asarray(n))
+
+
+def test_prefetch_iterator_order_exceptions_and_close():
+    from perceiver_io_tpu.data.loader import PrefetchIterator
+
+    # order preserved over a finite iterator, StopIteration surfaces
+    it = PrefetchIterator(iter(range(7)), depth=3)
+    assert list(it) == list(range(7))
+
+    # producer exceptions re-raise in the consumer after the good items
+    def gen():
+        yield 1
+        yield 2
+        raise RuntimeError("producer boom")
+
+    it = PrefetchIterator(gen(), depth=2)
+    assert next(it) == 1
+    assert next(it) == 2
+    with pytest.raises(RuntimeError, match="producer boom"):
+        next(it)
+
+    # exhaustion is sticky: next() after StopIteration raises again, never hangs
+    it2 = PrefetchIterator(iter([1]), depth=2)
+    assert list(it2) == [1]
+    assert next(it2, "default") == "default"
+
+    # close() stops an infinite producer (the thread is a daemon either way)
+    import itertools
+
+    it = PrefetchIterator(itertools.count(), depth=2)
+    assert next(it) == 0
+    it.close()
+
+    # dropping the wrapper without close() lets GC stop the producer (the
+    # thread holds no reference to the wrapper)
+    import gc
+
+    it3 = PrefetchIterator(itertools.count(), depth=1)
+    thread = it3._thread
+    stop = it3._stop
+    del it3
+    gc.collect()
+    assert stop.is_set()
+    thread.join(timeout=2)
+    assert not thread.is_alive()
