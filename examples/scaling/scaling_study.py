@@ -135,41 +135,100 @@ def cmd_export(args):
     print(f"wrote {path}")
 
 
-def cmd_fit_demo(args):
-    """End-to-end MECHANICS demo of the fit workflow on an offline
-    convergence curve (docs/results/clm.csv, the --smoke preset run): each
-    validation point on the curve becomes a (compute, params, tokens) triple
-    — every point of a single training curve lies on its own compute
-    envelope, the degenerate single-model case of the reference's approach-1
-    minima-over-curves extraction (reference:
-    examples/scaling/clm/scaling/laws.py:7-36). This proves the
-    curve→triples→fit pipeline runs; the fitted coefficients are NOT physics
-    (one model size cannot constrain a power law's exponent — that needs the
-    real multi-model study, which is network-blocked here)."""
-    rows = [r for r in csv.DictReader(open(args.csv)) if r.get("val_loss")]
+def _read_curve(path):
+    rows = [r for r in csv.DictReader(open(path)) if r.get("val_loss")]
     if not rows:
-        raise SystemExit(f"no val_loss rows in {args.csv}")
+        raise SystemExit(f"no val_loss rows in {path}")
+    # resumed runs append rows again from an earlier step; keep the LAST
+    # value per step and sort — np.interp silently mis-reads non-monotonic x
+    by_step = {}
+    for r in rows:
+        by_step[float(r["step"])] = float(r["val_loss"])
+    return sorted(by_step.items())
+
+
+def cmd_fit_demo(args):
+    """End-to-end run of the fit workflow on offline convergence curves.
+
+    Single curve (default: docs/results/clm.csv, the --smoke preset run):
+    each validation point becomes a (compute, params, tokens) triple — every
+    point of one training curve lies on its own compute envelope, the
+    degenerate single-model case of the reference's approach-1
+    minima-over-curves extraction (reference:
+    examples/scaling/clm/scaling/laws.py:7-36). Mechanics proof only.
+
+    Multiple curves (repeat ``--run csv:channels:layers``): the FULL
+    approach-1 workflow — per-budget loss interpolation across model sizes,
+    envelope extraction (which model achieves the lowest loss at each
+    compute budget), then the coefficient fit at the fixed published
+    exponents, exactly the reference's pipeline. Physics is still bounded
+    by the synthetic corpus and tiny grid; the workflow is the real one."""
+    import numpy as np
 
     est = ComputeEstimator(
         vocab_size=args.vocab_size, max_seq_len=args.max_seq_len, num_latents=args.num_latents
     )
-    info = ModelInfo(args.num_channels, args.num_layers, est)
-    n_params = info.num_self_attn_params() + info.num_cross_attn_params()
-    f_tok = info.self_attn_flops() + info.cross_attn_flops()
+
+    runs = []
+    if args.run and args.csv != "docs/results/clm.csv":
+        raise SystemExit(
+            "give curves either as the positional csv OR as --run specs, not "
+            "both (the positional csv would be silently excluded)"
+        )
+    specs = args.run or [f"{args.csv}:{args.num_channels}:{args.num_layers}"]
+    for spec in specs:
+        try:
+            path, channels_s, layers_s = spec.rsplit(":", 2)
+            channels, layers = int(channels_s), int(layers_s)
+        except ValueError:
+            raise SystemExit(
+                f"bad --run spec {spec!r}: expected csv_path:channels:layers "
+                "(e.g. data/offline_runs/clm_128ch_3l.csv:128:3)"
+            )
+        info = ModelInfo(channels, layers, est)
+        n = info.num_self_attn_params() + info.num_cross_attn_params()
+        f_tok = info.self_attn_flops() + info.cross_attn_flops()
+        curve = _read_curve(path)
+        d = np.asarray([s * args.batch_size * args.num_latents for s, _ in curve])
+        loss = np.asarray([l for _, l in curve])
+        runs.append(dict(path=path, channels=channels, layers=layers,
+                         n=n, f_tok=f_tok, d=d, loss=loss))
+        print(f"{path}: {channels}ch x {layers}L, {n/1e6:.2f}M params, "
+              f"{f_tok:.3e} FLOPs/token, val {loss[0]:.3f} -> {loss[-1]:.3f}")
 
     flops, params, tokens = [], [], []
-    print(f"{'step':>6} {'val_loss':>9} {'tokens':>12} {'FLOPs':>12}")
-    for r in rows:
-        d = float(r["step"]) * args.batch_size * args.num_latents  # latent tokens seen
-        c = f_tok * d
-        print(f"{int(float(r['step'])):>6} {float(r['val_loss']):>9.4f} {d:>12.3e} {c:>12.3e}")
-        flops.append(c)
-        params.append(n_params)
-        tokens.append(d)
+    if len(runs) == 1:
+        r = runs[0]
+        for d in r["d"]:
+            flops.append(r["f_tok"] * d)
+            params.append(r["n"])
+            tokens.append(d)
+    else:
+        # approach-1 envelope over the model grid: at each compute budget,
+        # the model reaching the lowest interpolated loss is compute-optimal
+        c_lo = max(min(r["f_tok"] * r["d"][0] for r in runs), 1.0)
+        c_hi = min(max(r["f_tok"] * r["d"][-1] for r in runs), 1e30)
+        budgets = np.geomspace(c_lo * 1.2, c_hi, num=args.budget_points)
+        print(f"\n{'C (FLOPs)':>12} {'best model':>12} {'loss':>8} {'tokens':>12}")
+        for c in budgets:
+            best = None
+            for r in runs:
+                d_at_c = c / r["f_tok"]
+                if d_at_c < r["d"][0] or d_at_c > r["d"][-1]:
+                    continue
+                l = float(np.interp(d_at_c, r["d"], r["loss"]))
+                if best is None or l < best[0]:
+                    best = (l, r, d_at_c)
+            if best is None:
+                continue
+            l, r, d_at_c = best
+            print(f"{c:>12.3e} {r['channels']}ch x {r['layers']}L{'':>2} {l:>8.4f} {d_at_c:>12.3e}")
+            flops.append(c)
+            params.append(r["n"])
+            tokens.append(d_at_c)
 
     law = fit_scaling_law(flops, params, tokens, a=args.a, b=args.b)
-    print(f"\nfitted law over {len(rows)} curve points "
-          f"({args.num_channels}ch x {args.num_layers}L, {n_params/1e6:.1f}M params):")
+    print(f"\nfitted law over {len(flops)} envelope points, {len(runs)} model size(s):")
     print(law)
     for c in (1e15, 1e16, 1e17):
         print(f"C={c:.0e}: N_opt={law.n_opt(c)/1e6:.1f}M  D_opt={law.d_opt(c)/1e6:.1f}M tokens")
@@ -215,6 +274,12 @@ def main(argv=None):
     demo.add_argument("--batch-size", type=int, default=8)
     demo.add_argument("--a", type=float, default=0.5)
     demo.add_argument("--b", type=float, default=0.5)
+    demo.add_argument(
+        "--run",
+        action="append",
+        help="csv:channels:layers — repeat for the multi-model approach-1 envelope",
+    )
+    demo.add_argument("--budget-points", type=int, default=12)
     demo.set_defaults(fn=cmd_fit_demo)
 
     args = parser.parse_args(argv)
