@@ -17,7 +17,9 @@ from perceiver_io_tpu.parallel.mesh import param_shardings
 from perceiver_io_tpu.training.state import TrainState
 
 
-def make_train_step(loss_fn: Callable, donate: bool = True, jit: bool = True) -> Callable:
+def make_train_step(
+    loss_fn: Callable, donate: bool = True, jit: bool = True, microbatch: int = 1
+) -> Callable:
     """``train_step(state, batch) -> (state, metrics)``, jitted.
 
     ``loss_fn(params, batch, rng) -> (loss, metrics)``.
@@ -25,18 +27,69 @@ def make_train_step(loss_fn: Callable, donate: bool = True, jit: bool = True) ->
     ``jit=False`` returns the raw step function — for callers embedding the
     step in a larger jitted computation (e.g. a multi-step ``lax.scan``),
     where an inner jit boundary would force per-iteration buffer copies.
+
+    ``microbatch=k`` splits the batch into ``k`` equal chunks along axis 0
+    inside the SAME compiled step — gradients averaged across chunks, ONE
+    optimizer update. PRECONDITION: the loss must weight every chunk
+    equally — true for uniform per-token objectives like the packed CLM
+    flagship (no padding, no ignored labels), NOT for losses that normalize
+    by a per-call valid-token count (padded batches, masked-LM
+    ``IGNORE_INDEX``) — there the chunk mean-of-means reweights tokens. A
+    batch carrying a non-None ``pad_mask`` is rejected at trace time;
+    label-masking objectives must keep ``microbatch=1``. Metrics are
+    averaged across chunks (correct for means like ``loss``; count-valued
+    metrics would come out scaled by 1/k — another reason masking
+    objectives keep the default). Dropout draws differ per chunk but keep
+    the same distribution.
+
+    Measured motivation (v5e, 16k flagship): per-sample fwd+bwd is ~9%
+    cheaper at batch 2 than batch 4, so the 2x2 chunked step beats the
+    monolithic batch-4 step (-5%) while amortizing the optimizer's HBM
+    roofline over the full batch. Unlike ``optax.MultiSteps`` gradient
+    accumulation (optim.py), this changes no optimizer-visible step count.
     """
 
     def train_step(state: TrainState, batch):
         rng, step_rng = jax.random.split(state.rng)
         grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
-        (_, metrics), grads = grad_fn(state.params, batch, step_rng)
+        if microbatch <= 1:
+            (_, metrics), grads = grad_fn(state.params, batch, step_rng)
+        else:
+            if isinstance(batch, dict) and batch.get("pad_mask") is not None:
+                raise ValueError(
+                    "microbatch > 1 requires equal chunk weighting; padded "
+                    "batches normalize per-chunk and would reweight tokens — "
+                    "use microbatch=1"
+                )
+            chunk_rngs = jax.random.split(step_rng, microbatch)
+            metrics = None
+            grads = None
+            for i in range(microbatch):  # unrolled: k is small and static
+                chunk = jax.tree.map(
+                    lambda x: _chunk(x, i, microbatch), batch, is_leaf=lambda x: x is None
+                )
+                (_, m), g = grad_fn(state.params, chunk, chunk_rngs[i])
+                grads = g if grads is None else jax.tree.map(jax.numpy.add, grads, g)
+                metrics = m if metrics is None else jax.tree.map(jax.numpy.add, metrics, m)
+            inv = 1.0 / microbatch
+            grads = jax.tree.map(lambda g: g * inv, grads)
+            metrics = jax.tree.map(lambda m: m * inv, metrics)
         state = state.apply_gradients(grads).replace(rng=rng)
         return state, metrics
 
     if not jit:
         return train_step
     return jax.jit(train_step, donate_argnums=(0,) if donate else ())
+
+
+def _chunk(x, i: int, k: int):
+    if x is None:
+        return None
+    n = x.shape[0]
+    if n % k != 0:
+        raise ValueError(f"microbatch={k} does not divide batch size {n}")
+    per = n // k
+    return x[i * per : (i + 1) * per]
 
 
 def make_eval_step(eval_fn: Callable) -> Callable:

@@ -195,3 +195,44 @@ def test_mlm_memorizes_fixed_batch():
             first = float(metrics["loss"])
     assert first > 4.0  # starts near uniform ln(262) ~ 5.6
     assert float(metrics["loss"]) < 2.0  # breaks the ~2.8 marginal plateau
+
+
+def test_microbatched_step_matches_full_batch():
+    """microbatch=k chunking inside the step is the full-batch step: same
+    gradients (fp reassociation tolerance) and same loss for a
+    deterministic-loss model (prefix dropout off — chunks draw different
+    dropout keys by design)."""
+    import numpy as np
+
+    from perceiver_io_tpu.models.text import CausalLanguageModel, CausalLanguageModelConfig
+    from perceiver_io_tpu.training import TrainState, clm_loss_fn, make_optimizer
+    from perceiver_io_tpu.training.loop import make_train_step
+
+    config = CausalLanguageModelConfig(
+        vocab_size=64, max_seq_len=32, max_latents=8, num_channels=32,
+        num_heads=4, num_self_attention_layers=1, cross_attention_dropout=0.0,
+    )
+    model = CausalLanguageModel(config)
+    rng = np.random.default_rng(0)
+    t = rng.integers(0, 64, size=(4, 33))
+    batch = {
+        "labels": jnp.asarray(t[:, 1:]),
+        "input_ids": jnp.asarray(t[:, :-1]),
+        "pad_mask": None,
+    }
+    params = model.init(jax.random.PRNGKey(0), batch["input_ids"][:, :9], prefix_len=1)
+    loss_fn = clm_loss_fn(model.apply, max_latents=8)
+
+    def state():
+        tx = make_optimizer(1e-2, gradient_clip=1.0)
+        return TrainState.create(model.apply, params, tx, jax.random.PRNGKey(1))
+
+    s_full, m_full = make_train_step(loss_fn, donate=False)(state(), batch)
+    s_mb, m_mb = make_train_step(loss_fn, donate=False, microbatch=2)(state(), batch)
+
+    np.testing.assert_allclose(float(m_mb["loss"]), float(m_full["loss"]), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(s_mb.params), jax.tree.leaves(s_full.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4)
+
+    with pytest.raises(ValueError, match="does not divide"):
+        make_train_step(loss_fn, donate=False, microbatch=3)(state(), batch)
