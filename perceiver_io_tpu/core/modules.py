@@ -658,6 +658,12 @@ class PerceiverAR(nn.Module):
     self_attention_widening_factor: int = 4
     cross_attention_widening_factor: int = 4
     cross_attention_dropout: float = 0.5
+    # "gather" (default): drop prefix positions by a static-count row gather —
+    # also shrinks the CA kernel's kv length by the dropped count. "mask":
+    # keep the full-length prefix and mask dropped positions out of the CA
+    # softmax (SURVEY §7.3) — numerically identical, measured slower at the
+    # 16k flagship (docs/performance.md round-4 A/B).
+    prefix_dropout_mode: str = "gather"
     post_attention_dropout: float = 0.0
     residual_dropout: float = 0.0
     activation_checkpointing: bool = False
@@ -666,6 +672,8 @@ class PerceiverAR(nn.Module):
     dtype: jnp.dtype = jnp.float32
 
     def setup(self):
+        if self.prefix_dropout_mode not in ("gather", "mask"):
+            raise ValueError(f"unknown prefix_dropout_mode: {self.prefix_dropout_mode!r}")
         num_channels = self.input_adapter.num_input_channels
         cross_attn_cls = _remat(
             CrossAttentionLayer, (8,), self.activation_checkpointing, self.activation_offloading
@@ -719,12 +727,24 @@ class PerceiverAR(nn.Module):
         deterministic: bool = True,
         sa_pad_mask=None,
         pos_shift=None,
+        prefix_keep_idx=None,
     ) -> BlockOutput:
         """``sa_pad_mask``/``pos_shift`` apply to decode steps only:
         slot masks for the self-attention caches (expired sliding-window
         slots) and an explicit left-pad position shift (B, 1) — needed when
         ``pad_mask`` also marks expired slots and can no longer double as the
-        left-pad count (see generation.py's roll-free sliding window)."""
+        left-pad count (see generation.py's roll-free sliding window).
+
+        ``prefix_keep_idx``: optional host-sampled prefix-dropout keep set,
+        (B, keep) int32, **sorted unique per row**, where
+        ``keep = prefix_len - int(prefix_len * cross_attention_dropout)``.
+        When given, the in-graph subset draw (``top_k`` + ``sort`` over the
+        prefix — a full on-device sort, ~0.9 ms/step at the 16k flagship) is
+        skipped; the draw runs on the host where it overlaps device compute
+        through the input pipeline (training.prefix_dropout). The
+        distribution is identical: a uniformly random size-``keep`` subset,
+        exactly the reference's ``torch.topk``-of-uniforms draw
+        (reference: modules.py:814-819)."""
         if decode and kv_cache is None:
             raise ValueError("decode=True requires kv_cache")
         if kv_cache is not None and not deterministic and self.cross_attention_dropout > 0.0:
@@ -732,6 +752,8 @@ class PerceiverAR(nn.Module):
             raise ValueError("cross-attention dropout not supported with caching")
 
         if decode:
+            if prefix_keep_idx is not None:
+                raise ValueError("prefix_keep_idx applies to training forwards, not decode steps")
             return self._decode_step(
                 x,
                 pad_mask=pad_mask,
@@ -741,10 +763,15 @@ class PerceiverAR(nn.Module):
                 pos_shift=pos_shift,
             )
         return self._forward(
-            x, prefix_len=prefix_len, pad_mask=pad_mask, kv_cache=kv_cache, deterministic=deterministic
+            x,
+            prefix_len=prefix_len,
+            pad_mask=pad_mask,
+            kv_cache=kv_cache,
+            deterministic=deterministic,
+            prefix_keep_idx=prefix_keep_idx,
         )
 
-    def _forward(self, x, prefix_len, pad_mask, kv_cache, deterministic):
+    def _forward(self, x, prefix_len, pad_mask, kv_cache, deterministic, prefix_keep_idx=None):
         b, n = x.shape[0], x.shape[1]
         if not 0 <= prefix_len < n:
             raise ValueError(f"prefix_len ({prefix_len}) out of valid range [0..{n})")
@@ -753,34 +780,67 @@ class PerceiverAR(nn.Module):
         # then embeds positions via a table slice (scatter-free backward)
         if pad_mask is None:
             x_emb, frq = self.input_adapter(x, None)
+            pad_latent = pad_prefix = None
         else:
             shift = pad_mask.sum(axis=1, keepdims=True).astype(jnp.int32)
             x_emb, frq = self.input_adapter(x, positions(b, n, shift=shift))
+            pad_latent, pad_prefix = pad_mask[:, prefix_len:], pad_mask[:, :prefix_len]
 
         x_latent, x_prefix = x_emb[:, prefix_len:], x_emb[:, :prefix_len]
         frq_latent, frq_prefix = frq[:, prefix_len:], frq[:, :prefix_len]
-        if pad_mask is not None:
-            pad_latent, pad_prefix = pad_mask[:, prefix_len:], pad_mask[:, :prefix_len]
 
         if not deterministic and prefix_len > 0 and self.cross_attention_dropout > 0.0:
             # Static-count prefix dropout: keep `keep` positions, chosen
             # uniformly, order preserved (reference: modules.py:809-830).
             keep = prefix_len - int(prefix_len * self.cross_attention_dropout)
-            rand = jax.random.uniform(self.make_rng("dropout"), (b, prefix_len))
-            _, keep_idx = lax.top_k(rand, keep)
-            keep_idx = jnp.sort(keep_idx, axis=-1)
-            # gather-backward gather (ops/gathers.py): the scatter-add VJP of
-            # this row gather costs ~0.8 ms/step at the 16k flagship (profiled)
-            from perceiver_io_tpu.ops.gathers import gather_rows
+            if prefix_keep_idx is not None:
+                if prefix_keep_idx.shape[-1] != keep:
+                    raise ValueError(
+                        f"prefix_keep_idx carries {prefix_keep_idx.shape[-1]} indices; "
+                        f"this config keeps {keep} of {prefix_len} prefix positions"
+                    )
+                keep_idx, rand = prefix_keep_idx, None
+            else:
+                rand = jax.random.uniform(self.make_rng("dropout"), (b, prefix_len))
+                keep_idx = None
+                if self.prefix_dropout_mode == "gather":
+                    _, keep_idx = lax.top_k(rand, keep)
+                    keep_idx = jnp.sort(keep_idx, axis=-1)
 
-            x_prefix = gather_rows(x_prefix, keep_idx)
-            frq_prefix = jnp.take_along_axis(frq_prefix, keep_idx[..., None], axis=1)
-            if pad_mask is not None:
-                pad_prefix = jnp.take_along_axis(pad_prefix, keep_idx, axis=1)
+            if self.prefix_dropout_mode == "mask":
+                # Keep-mask form (SURVEY §7.3): the prefix stays full length
+                # and dropped positions are masked out of the CA softmax —
+                # numerically the gathered softmax. Measured SLOWER than the
+                # gather at the 16k flagship: the gather also nearly halves
+                # the flash CA kernel work (kv 8704 vs 16384), which outweighs
+                # the gather machinery it removes (docs/performance.md,
+                # round-4 A/B table). Kept as an option and for the
+                # seq-parallel path, where masking is structurally required.
+                if rand is None:
+                    keep_mask = jnp.zeros((b, prefix_len), bool)
+                    keep_mask = keep_mask.at[jnp.arange(b)[:, None], keep_idx].set(True)
+                else:
+                    # threshold at the keep-th largest uniform: the same keep
+                    # set top_k would select, without materializing indices
+                    thr, _ = lax.top_k(rand, keep)
+                    keep_mask = rand >= thr[:, -1:]
+                drop = ~keep_mask
+                pad_prefix = drop if pad_prefix is None else (pad_prefix | drop)
+                if pad_latent is None:
+                    pad_latent = jnp.zeros((b, n - prefix_len), bool)
+            else:
+                # gather-backward gather (ops/gathers.py): the scatter-add VJP
+                # of this row gather costs ~0.8 ms/step at the 16k flagship
+                from perceiver_io_tpu.ops.gathers import gather_rows
+
+                x_prefix = gather_rows(x_prefix, keep_idx)
+                frq_prefix = jnp.take_along_axis(frq_prefix, keep_idx[..., None], axis=1)
+                if pad_prefix is not None:
+                    pad_prefix = jnp.take_along_axis(pad_prefix, keep_idx, axis=1)
 
         rope_q = frq_latent
         rope_k_ca = jnp.concatenate([frq_prefix, frq_latent], axis=1)
-        pad_ca = None if pad_mask is None else jnp.concatenate([pad_prefix, pad_latent], axis=1)
+        pad_ca = None if pad_prefix is None else jnp.concatenate([pad_prefix, pad_latent], axis=1)
 
         if kv_cache is None:
             ca_cache, sa_cache = None, None
@@ -868,7 +928,6 @@ class PerceiverAR(nn.Module):
         from the dense path).
         """
         from perceiver_io_tpu.ops.online_softmax import (
-            NEG_INF,
             block_attention,
             finalize,
             online_combine,
@@ -898,15 +957,15 @@ class PerceiverAR(nn.Module):
 
         # per-device prefix partial; all prefix positions precede all latents,
         # so only the pad mask (and the training keep-mask) applies
+        b = x_latent.shape[0]
         p_local = x_prefix_local.shape[1]
-        masked_p = jnp.zeros((1, 1, 1, p_local), bool)
+        mask_p = jnp.zeros((b, p_local), bool)
         if prefix_pad_local is not None:
-            masked_p = masked_p | prefix_pad_local[:, None, None, :]
+            mask_p = mask_p | prefix_pad_local
         if not deterministic and self.cross_attention_dropout > 0.0 and p_local > 0:
             # the dense path's static-count keep set (see _forward), drawn
             # identically on every device from the replicated rng, then
             # sliced to this device's block
-            b = x_latent.shape[0]
             p_total = p_local * lax.axis_size(axis_name)
             keep = p_total - int(p_total * self.cross_attention_dropout)
             rand = jax.random.uniform(self.make_rng("dropout"), (b, p_total))
@@ -915,14 +974,16 @@ class PerceiverAR(nn.Module):
             keep_mask = keep_mask.at[jnp.arange(b)[:, None], keep_idx].set(True)
             start = lax.axis_index(axis_name) * p_local
             keep_local = lax.dynamic_slice_in_dim(keep_mask, start, p_local, axis=1)
-            masked_p = masked_p | ~keep_local[:, None, None, :]
-        o_p, m_p, l_p = block_attention(q, k_p, v_p, masked_p)
+            mask_p = mask_p | ~keep_local
 
-        # LSE-combine the prefix partials across the axis: O(L) communication
-        m_glob = lax.pmax(m_p, axis_name)
-        scale = jnp.exp(m_p - jnp.maximum(m_glob, NEG_INF / 2))
-        o_p = lax.psum(o_p * scale[..., None], axis_name)
-        l_p = lax.psum(l_p * scale, axis_name)
+        # the prefix partial + its O(L) LSE-combine across the axis is the
+        # ring/sequence-parallel CA primitive (parallel/ring_attention.py —
+        # the path --trainer.strategy=ring reaches)
+        from perceiver_io_tpu.parallel.ring_attention import seq_sharded_cross_attention
+
+        o_p, m_glob, l_p = seq_sharded_cross_attention(
+            q, k_p, v_p, mask_p, axis_name=axis_name, causal=False, finalize=False
+        )
 
         # replicated causal latent partial
         n_lat = x_latent.shape[1]
@@ -1115,6 +1176,7 @@ class CausalSequenceModel(nn.Module):
         deterministic: bool = True,
         sa_pad_mask=None,
         pos_shift=None,
+        prefix_keep_idx=None,
     ) -> CausalModelOutput:
         if prefix_len > self.max_prefix_len:
             raise ValueError(
@@ -1129,6 +1191,7 @@ class CausalSequenceModel(nn.Module):
             deterministic=deterministic,
             sa_pad_mask=sa_pad_mask,
             pos_shift=pos_shift,
+            prefix_keep_idx=prefix_keep_idx,
         )
         h = out.last_hidden_state
         if self.config.output_norm:

@@ -22,6 +22,11 @@ from perceiver_io_tpu.training.checkpoint import (
     save_pretrained,
 )
 from perceiver_io_tpu.training.metrics import MetricsLogger
+from perceiver_io_tpu.training.prefix_dropout import (
+    prefix_keep_count,
+    sample_prefix_keep_idx,
+    with_prefix_keep_idx,
+)
 from perceiver_io_tpu.training.trainer import Trainer, TrainerConfig
 
 __all__ = [
@@ -43,6 +48,9 @@ __all__ = [
     "save_config",
     "save_pretrained",
     "MetricsLogger",
+    "prefix_keep_count",
+    "sample_prefix_keep_idx",
+    "with_prefix_keep_idx",
     "Trainer",
     "TrainerConfig",
 ]

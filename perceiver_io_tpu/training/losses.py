@@ -87,6 +87,11 @@ def clm_loss_fn(apply_fn, max_latents: int, deterministic: bool = False) -> Call
         if pad_mask is not None:
             labels = jnp.where(pad_mask, IGNORE_INDEX, labels)
         kwargs = {} if deterministic else {"rngs": {"dropout": rng}}
+        # optional host-sampled prefix-dropout keep set (training.prefix_dropout):
+        # moves the subset draw's top_k+sort off the device
+        keep_idx = batch.get("prefix_keep_idx")
+        if keep_idx is not None and not deterministic:
+            kwargs["prefix_keep_idx"] = keep_idx
         out = apply_fn(
             params,
             x,

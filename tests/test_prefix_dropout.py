@@ -1,0 +1,184 @@
+"""Prefix cross-attention dropout: gather vs mask mode equivalence, the
+host-sampled keep-index path, and the host sampler's law.
+
+The three implementations under test all realize the reference's prefix
+dropout (reference: perceiver/model/core/modules.py:809-830 — a uniformly
+random static-count keep subset):
+
+- ``prefix_dropout_mode="gather"`` (default): row-gather of the keep set,
+  shrinking the CA kv length.
+- ``prefix_dropout_mode="mask"``: full-length prefix, dropped positions
+  masked out of the CA softmax (SURVEY §7.3).
+- ``prefix_keep_idx=...``: the subset drawn on the host
+  (training.prefix_dropout) instead of in-graph.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from perceiver_io_tpu.models.text import CausalLanguageModel, CausalLanguageModelConfig
+from perceiver_io_tpu.training import clm_loss_fn
+from perceiver_io_tpu.training.prefix_dropout import (
+    prefix_keep_count,
+    sample_prefix_keep_idx,
+    with_prefix_keep_idx,
+)
+
+
+def _config(**kwargs):
+    base = dict(
+        vocab_size=50,
+        max_seq_len=24,
+        max_latents=8,
+        num_channels=32,
+        num_heads=4,
+        num_self_attention_layers=2,
+        cross_attention_dropout=0.5,
+    )
+    base.update(kwargs)
+    return CausalLanguageModelConfig(**base)
+
+
+def _batchish(rng, b=3, n=24, vocab=50):
+    return jnp.asarray(rng.integers(0, vocab, size=(b, n)))
+
+
+def test_gather_and_mask_modes_agree():
+    """Same rng draw → the same keep set → identical latent logits, whether
+    the dropped positions are gathered away or masked out."""
+    rng = np.random.default_rng(0)
+    x = _batchish(rng)
+    gather = CausalLanguageModel(_config())
+    mask = CausalLanguageModel(_config(prefix_dropout_mode="mask"))
+    params = gather.init(jax.random.PRNGKey(0), x, prefix_len=16)
+    drop_rng = jax.random.PRNGKey(7)
+
+    out_g = gather.apply(
+        params, x, prefix_len=16, deterministic=False, rngs={"dropout": drop_rng}
+    )
+    out_m = mask.apply(
+        params, x, prefix_len=16, deterministic=False, rngs={"dropout": drop_rng}
+    )
+    np.testing.assert_allclose(out_g.logits, out_m.logits, atol=1e-5)
+
+
+@pytest.mark.parametrize("mode", ["gather", "mask"])
+def test_host_keep_idx_matches_in_graph_draw(mode):
+    """Feeding the keep set explicitly reproduces the in-graph draw's output
+    when the sets coincide (both modes consume ``prefix_keep_idx``)."""
+    rng = np.random.default_rng(1)
+    x = _batchish(rng)
+    model = CausalLanguageModel(_config(prefix_dropout_mode=mode))
+    params = model.init(jax.random.PRNGKey(0), x, prefix_len=16)
+
+    drop_rng = jax.random.PRNGKey(3)
+    keep = prefix_keep_count(16, 0.5)
+    idx = jnp.asarray(
+        np.stack([np.sort(np.random.default_rng(s).choice(16, keep, replace=False)) for s in range(3)])
+    ).astype(jnp.int32)
+
+    out_idx = model.apply(
+        params, x, prefix_len=16, deterministic=False, prefix_keep_idx=idx,
+        rngs={"dropout": drop_rng},
+    )
+    out_idx2 = model.apply(
+        params, x, prefix_len=16, deterministic=False, prefix_keep_idx=idx,
+        rngs={"dropout": jax.random.PRNGKey(99)},
+    )
+    # with the keep set supplied, the dropout rng is not consumed for it
+    np.testing.assert_allclose(out_idx.logits, out_idx2.logits, atol=1e-6)
+    assert np.isfinite(np.asarray(out_idx.logits)).all()
+
+
+def test_gather_and_mask_agree_on_explicit_idx():
+    rng = np.random.default_rng(2)
+    x = _batchish(rng)
+    gather = CausalLanguageModel(_config())
+    mask = CausalLanguageModel(_config(prefix_dropout_mode="mask"))
+    params = gather.init(jax.random.PRNGKey(0), x, prefix_len=16)
+    keep = prefix_keep_count(16, 0.5)
+    idx = sample_prefix_keep_idx(np.random.default_rng(5), 3, 16, 0.5)
+    assert idx.shape == (3, keep)
+    out_g = gather.apply(
+        params, x, prefix_len=16, deterministic=False, prefix_keep_idx=jnp.asarray(idx),
+        rngs={"dropout": jax.random.PRNGKey(0)},
+    )
+    out_m = mask.apply(
+        params, x, prefix_len=16, deterministic=False, prefix_keep_idx=jnp.asarray(idx),
+        rngs={"dropout": jax.random.PRNGKey(0)},
+    )
+    np.testing.assert_allclose(out_g.logits, out_m.logits, atol=1e-5)
+
+
+def test_keep_idx_wrong_count_raises():
+    rng = np.random.default_rng(3)
+    x = _batchish(rng)
+    model = CausalLanguageModel(_config())
+    params = model.init(jax.random.PRNGKey(0), x, prefix_len=16)
+    bad = jnp.zeros((3, 3), jnp.int32)  # keeps 8, not 3
+    with pytest.raises(ValueError, match="keeps 8 of 16"):
+        model.apply(
+            params, x, prefix_len=16, deterministic=False, prefix_keep_idx=bad,
+            rngs={"dropout": jax.random.PRNGKey(0)},
+        )
+
+
+def test_unknown_mode_rejected():
+    model = CausalLanguageModel(_config(prefix_dropout_mode="bogus"))
+    with pytest.raises(ValueError, match="prefix_dropout_mode"):
+        model.init(jax.random.PRNGKey(0), jnp.zeros((1, 24), jnp.int32), prefix_len=16)
+
+
+def test_clm_loss_fn_forwards_batch_keep_idx():
+    rng = np.random.default_rng(4)
+    t = rng.integers(0, 50, size=(3, 25))
+    model = CausalLanguageModel(_config())
+    x = jnp.asarray(t[:, :-1])
+    params = model.init(jax.random.PRNGKey(0), x, prefix_len=16)
+    loss = clm_loss_fn(model.apply, max_latents=8)
+    idx = jnp.asarray(sample_prefix_keep_idx(np.random.default_rng(6), 3, 16, 0.5))
+    batch = {
+        "labels": jnp.asarray(t[:, 1:]),
+        "input_ids": x,
+        "pad_mask": None,
+        "prefix_keep_idx": idx,
+    }
+    l1, _ = loss(params, batch, jax.random.PRNGKey(1))
+    l2, _ = loss(params, batch, jax.random.PRNGKey(2))  # rng no longer drives the subset
+    assert float(l1) == pytest.approx(float(l2), abs=1e-6)
+    # and without the key, different rngs draw different subsets
+    batch.pop("prefix_keep_idx")
+    l3, _ = loss(params, batch, jax.random.PRNGKey(1))
+    l4, _ = loss(params, batch, jax.random.PRNGKey(2))
+    assert float(l3) != pytest.approx(float(l4), abs=1e-9)
+
+
+def test_sampler_law():
+    rng = np.random.default_rng(0)
+    idx = sample_prefix_keep_idx(rng, 64, 40, 0.5)
+    keep = prefix_keep_count(40, 0.5)
+    assert idx.shape == (64, keep) and idx.dtype == np.int32
+    for row in idx:
+        assert len(set(row.tolist())) == keep  # unique
+        assert (np.sort(row) == row).all()  # sorted
+        assert row.min() >= 0 and row.max() < 40
+    # marginal inclusion probability ~ keep/n for every position
+    freq = np.zeros(40)
+    big = sample_prefix_keep_idx(rng, 2000, 40, 0.5)
+    for row in big:
+        freq[row] += 1
+    freq /= 2000
+    np.testing.assert_allclose(freq, keep / 40, atol=0.05)
+
+
+def test_iterator_wrapper():
+    batches = [{"input_ids": np.zeros((2, 24)), "pad_mask": None} for _ in range(3)]
+    out = list(with_prefix_keep_idx(iter(batches), prefix_len=16, dropout=0.5, seed=1))
+    keep = prefix_keep_count(16, 0.5)
+    assert all(b["prefix_keep_idx"].shape == (2, keep) for b in out)
+    # fresh draw per batch
+    assert not np.array_equal(out[0]["prefix_keep_idx"], out[1]["prefix_keep_idx"])
+    # original dicts untouched
+    assert "prefix_keep_idx" not in batches[0]

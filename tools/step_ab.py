@@ -1,0 +1,137 @@
+"""Same-process interleaved A/B of round-4 step-level structural variants on
+the flagship train step (cross-process comparisons drift 1.5-1.8x with the
+chip clock — docs/performance.md):
+
+- ``graph``   — in-graph prefix-dropout draw (top_k + sort) + row gather
+                (the round-3 default)
+- ``host``    — keep set sampled on the host, fed as ``prefix_keep_idx``
+                (training/prefix_dropout.py); device runs only the gather
+- ``mask``    — keep-mask form (SURVEY §7.3): full-length prefix, dropped
+                positions masked in the CA softmax (prefix_dropout_mode)
+- ``bf16m``   — in-graph draw + bf16 Adam moment storage
+                (optim.scale_by_adam_compact)
+- ``host+bf16m`` — both levers
+
+    python tools/step_ab.py [--batch-size 4] [--steps 20] [--microbatch 2]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bench import flagship_config
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_probe_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--seq-len", type=int, default=16384)
+    p.add_argument("--latents", type=int, default=1024)
+    p.add_argument("--batch-size", type=int, default=4)
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--reps", type=int, default=4)
+    p.add_argument("--microbatch", type=int, default=2)
+    p.add_argument(
+        "--variants", nargs="*", default=["graph", "host", "mask", "bf16m", "host+bf16m"]
+    )
+    args = p.parse_args()
+
+    from perceiver_io_tpu.models.text import CausalLanguageModel
+    from perceiver_io_tpu.training import TrainState, clm_loss_fn, make_optimizer
+    from perceiver_io_tpu.training.loop import make_train_step
+    from perceiver_io_tpu.training.prefix_dropout import sample_prefix_keep_idx
+
+    b, n = args.batch_size, args.seq_len
+    prefix_len = n - args.latents
+    rng = np.random.default_rng(0)
+    t = rng.integers(0, 262, size=(b, n + 1))
+    base_batch = {
+        "labels": jnp.asarray(t[:, 1:]),
+        "input_ids": jnp.asarray(t[:, :-1]),
+        "pad_mask": None,
+    }
+    keep_idx = jnp.asarray(sample_prefix_keep_idx(rng, b, prefix_len, 0.5))
+
+    def build(variant):
+        mode = "mask" if variant == "mask" else "gather"
+        config = flagship_config(args.seq_len, args.latents)
+        config.prefix_dropout_mode = mode
+        model = CausalLanguageModel(config, dtype=jnp.bfloat16)
+        params = model.init(
+            jax.random.PRNGKey(0), base_batch["input_ids"][:, : args.latents + 1], prefix_len=1
+        )
+        moment_dtype = "bfloat16" if "bf16m" in variant else None
+        tx = make_optimizer(1e-3, gradient_clip=1.0, moment_dtype=moment_dtype)
+        state = TrainState.create(model.apply, params, tx, jax.random.PRNGKey(1))
+        step = make_train_step(
+            clm_loss_fn(model.apply, max_latents=args.latents),
+            jit=False,
+            microbatch=args.microbatch,
+        )
+        batch = dict(base_batch)
+        if variant.startswith("host"):
+            batch["prefix_keep_idx"] = keep_idx
+
+        @functools.partial(jax.jit, static_argnums=2)
+        def run(state, batch, k):
+            def body(c, _):
+                l, s = c
+                s, metrics = step(s, batch)
+                return (l + metrics["loss"], s), ()
+
+            (l, _), _ = jax.lax.scan(body, (jnp.float32(0), state), None, length=k)
+            return l
+
+        return lambda k: float(run(state, batch, k))
+
+    n_short, n_long = 2, 2 + args.steps
+    runs = {}
+    for name in args.variants:
+        runs[name] = build(name)
+        t0 = time.perf_counter()
+        runs[name](n_short)
+        runs[name](n_long)
+        print(f"{name}: compiled in {time.perf_counter() - t0:.0f}s", flush=True)
+
+    times = {}
+    slopes = {v: [] for v in args.variants}
+    for est in range(3):
+        for v in args.variants:
+            times[v] = {"s": float("inf"), "l": float("inf")}
+        for _ in range(args.reps):
+            for v in args.variants:
+                t0 = time.perf_counter()
+                runs[v](n_short)
+                times[v]["s"] = min(times[v]["s"], time.perf_counter() - t0)
+                t0 = time.perf_counter()
+                runs[v](n_long)
+                times[v]["l"] = min(times[v]["l"], time.perf_counter() - t0)
+        for v in args.variants:
+            s = (times[v]["l"] - times[v]["s"]) / (n_long - n_short)
+            if s > 0:
+                slopes[v].append(s)
+
+    print(f"{'variant':<16} {'ms/step':>8} {'tok/s':>12}")
+    for v in args.variants:
+        ss = sorted(slopes[v])
+        if not ss:
+            print(f"{v:<16}  all slope estimates non-positive (tunnel stall?) — rerun")
+            continue
+        med = (ss[(len(ss) - 1) // 2] + ss[len(ss) // 2]) / 2
+        print(f"{v:<16} {med * 1e3:8.3f} {b * n / med:12.0f}")
+
+
+if __name__ == "__main__":
+    main()
