@@ -73,6 +73,57 @@ def freeze_mask(params, frozen_paths) -> "object":
     return jax.tree_util.tree_map_with_path(lambda path, _: is_frozen(path), params)
 
 
+def scale_by_adam_compact(
+    b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8, moment_dtype="bfloat16"
+) -> optax.GradientTransformation:
+    """Adam whose moment accumulators are *stored* in ``moment_dtype``
+    (bfloat16), halving the optimizer state's HBM footprint and traffic.
+
+    Motivation: the flagship train step's optimizer update is pinned at its
+    HBM roofline — ~1 GB of f32 param+moment traffic, 1.24 ms/step at the
+    37M model (docs/performance.md). The update math runs in f32 (moments
+    are upcast, updated, and cast back on store), so only the storage
+    precision narrows: bf16 keeps f32's full exponent range (no
+    under/overflow of ``nu``) but 8 mantissa bits, i.e. ~0.4% relative noise
+    on the moment estimates — measured indistinguishable convergence on the
+    offline convergence runs (docs/results/). Parameters stay full f32.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    dtype = jnp.dtype(moment_dtype)
+
+    def init_fn(params):
+        zeros = lambda p: jnp.zeros_like(p, dtype=dtype)  # noqa: E731
+        return optax.ScaleByAdamState(
+            count=jnp.zeros([], jnp.int32),
+            mu=jax.tree.map(zeros, params),
+            nu=jax.tree.map(zeros, params),
+        )
+
+    def update_fn(updates, state, params=None):
+        del params
+        count = optax.safe_increment(state.count)
+        bc1 = 1.0 - b1 ** count.astype(jnp.float32)
+        bc2 = 1.0 - b2 ** count.astype(jnp.float32)
+
+        def moments(g, m, v):
+            g32 = g.astype(jnp.float32)
+            m32 = b1 * m.astype(jnp.float32) + (1.0 - b1) * g32
+            v32 = b2 * v.astype(jnp.float32) + (1.0 - b2) * g32 * g32
+            u = (m32 / bc1) / (jnp.sqrt(v32 / bc2) + eps)
+            return u.astype(g.dtype), m32.astype(dtype), v32.astype(dtype)
+
+        flat = jax.tree.map(moments, updates, state.mu, state.nu)
+        is_triple = lambda x: isinstance(x, tuple) and len(x) == 3  # noqa: E731
+        u = jax.tree.map(lambda t: t[0], flat, is_leaf=is_triple)
+        mu = jax.tree.map(lambda t: t[1], flat, is_leaf=is_triple)
+        nu = jax.tree.map(lambda t: t[2], flat, is_leaf=is_triple)
+        return u, optax.ScaleByAdamState(count=count, mu=mu, nu=nu)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
 def make_optimizer(
     learning_rate: Union[float, optax.Schedule],
     optimizer: str = "adamw",
@@ -82,11 +133,30 @@ def make_optimizer(
     gradient_clip: Optional[float] = None,
     accumulate_grad_batches: int = 1,
     frozen_mask=None,
+    moment_dtype: Optional[str] = None,
 ) -> optax.GradientTransformation:
+    """``moment_dtype``: store Adam moments in a narrower dtype (e.g.
+    ``"bfloat16"`` — see :func:`scale_by_adam_compact`). Only meaningful for
+    adamw/adam; other optimizers reject it."""
+    if moment_dtype is not None and optimizer not in ("adamw", "adam"):
+        raise ValueError(f"moment_dtype is only supported for adam/adamw, not {optimizer}")
     if optimizer == "adamw":
-        tx = optax.adamw(learning_rate, b1=beta1, b2=beta2, weight_decay=weight_decay)
+        if moment_dtype is not None:
+            tx = optax.chain(
+                scale_by_adam_compact(b1=beta1, b2=beta2, moment_dtype=moment_dtype),
+                optax.add_decayed_weights(weight_decay),
+                optax.scale_by_learning_rate(learning_rate),
+            )
+        else:
+            tx = optax.adamw(learning_rate, b1=beta1, b2=beta2, weight_decay=weight_decay)
     elif optimizer == "adam":
-        tx = optax.adam(learning_rate, b1=beta1, b2=beta2)
+        if moment_dtype is not None:
+            tx = optax.chain(
+                scale_by_adam_compact(b1=beta1, b2=beta2, moment_dtype=moment_dtype),
+                optax.scale_by_learning_rate(learning_rate),
+            )
+        else:
+            tx = optax.adam(learning_rate, b1=beta1, b2=beta2)
     elif optimizer == "lamb":
         tx = optax.lamb(learning_rate, b1=beta1, b2=beta2, weight_decay=weight_decay)
     elif optimizer == "sgd":

@@ -236,3 +236,50 @@ def test_microbatched_step_matches_full_batch():
 
     with pytest.raises(ValueError, match="does not divide"):
         make_train_step(loss_fn, donate=False, microbatch=3)(state(), batch)
+
+
+def test_compact_adam_matches_optax_adam():
+    """scale_by_adam_compact at f32 storage IS optax.adam; at bf16 storage it
+    tracks it to moment-storage precision (the HBM-diet optimizer,
+    docs/performance.md round-4)."""
+    import optax
+
+    from perceiver_io_tpu.training.optim import scale_by_adam_compact
+
+    params = {"w": jnp.linspace(-1.0, 1.0, 32).reshape(4, 8), "b": jnp.ones((8,))}
+    grads = [
+        {"w": jnp.sin(jnp.arange(32.0)).reshape(4, 8) * 0.1, "b": jnp.cos(jnp.arange(8.0))},
+        {"w": jnp.full((4, 8), -0.05), "b": jnp.arange(8.0) * 0.01},
+        {"w": jnp.ones((4, 8)) * 0.2, "b": -jnp.ones((8,)) * 0.3},
+    ]
+
+    ref = optax.scale_by_adam()
+    f32 = scale_by_adam_compact(moment_dtype="float32")
+    b16 = scale_by_adam_compact(moment_dtype="bfloat16")
+    s_ref, s_f32, s_b16 = ref.init(params), f32.init(params), b16.init(params)
+    for g in grads:
+        u_ref, s_ref = ref.update(g, s_ref)
+        u_f32, s_f32 = f32.update(g, s_f32)
+        u_b16, s_b16 = b16.update(g, s_b16)
+        for a, b in zip(jax.tree.leaves(u_ref), jax.tree.leaves(u_f32)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7)
+        for a, b in zip(jax.tree.leaves(u_ref), jax.tree.leaves(u_b16)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=0.05, atol=0.05)
+    # storage dtype honored (the point of the transform)
+    assert all(m.dtype == jnp.bfloat16 for m in jax.tree.leaves(s_b16.mu))
+    assert all(v.dtype == jnp.bfloat16 for v in jax.tree.leaves(s_b16.nu))
+
+
+def test_make_optimizer_moment_dtype():
+    from perceiver_io_tpu.training.optim import make_optimizer as mk
+
+    params = {"w": jnp.ones((4, 4))}
+    tx = mk(1e-3, moment_dtype="bfloat16")
+    state = tx.init(params)
+    moments = [x for x in jax.tree.leaves(state) if hasattr(x, "dtype") and x.shape == (4, 4)]
+    assert moments and all(m.dtype == jnp.bfloat16 for m in moments)
+    # a full update runs and changes params in the right direction
+    u, _ = tx.update({"w": jnp.ones((4, 4))}, state, params)
+    assert float(jax.tree.leaves(u)[0].sum()) < 0
+    with pytest.raises(ValueError, match="moment_dtype"):
+        mk(1e-3, optimizer="sgd", moment_dtype="bfloat16")
