@@ -33,6 +33,7 @@ TPU-first differences from the reference:
 
 from __future__ import annotations
 
+import contextvars
 from contextlib import contextmanager
 from typing import Optional, Tuple
 
@@ -88,7 +89,9 @@ class AttentionOutput:
     kv_cache: Optional[KVCache] = None
 
 
-_PREFILL = False
+# scoped per-context (not a module global): concurrent threads tracing a
+# prompt pass and a training forward cannot leak the flag into each other
+_PREFILL = contextvars.ContextVar("attention_prefill_mode", default=False)
 
 
 @contextmanager
@@ -102,13 +105,15 @@ def prefill_mode():
     in ~1.3 ms, and that materialization (not the decode loop) is what
     bounds the decode batch size. The caches are still written identically
     (rotate-at-write). Only valid when every cache entered empty — callers
-    are the two prompt passes in generation.py."""
-    global _PREFILL
-    _PREFILL = True
+    are the two prompt passes in generation.py. A violation with a traced
+    cache length cannot be detected at trace time; the compiled program
+    poisons its output with NaN at run time instead of returning silently
+    wrong numbers (see the misuse guard in ``MultiHeadAttention.__call__``)."""
+    token = _PREFILL.set(True)
     try:
         yield
     finally:
-        _PREFILL = False
+        _PREFILL.reset(token)
 
 
 class MultiHeadAttention(nn.Module):
@@ -295,14 +300,15 @@ class MultiHeadAttention(nn.Module):
             # einsum (which materializes f32 (B, H, Nq, capacity) scores).
             # Misuse guard: a CONCRETE non-empty cache (eager chunked
             # prefill) falls back to the correct einsum path; a traced
-            # length cannot be checked (generation creates the cache inside
-            # its jitted program) — those callers own the empty-cache
-            # contract.
+            # length cannot be checked at trace time (generation creates the
+            # cache inside its jitted program), so the compiled program
+            # poisons its output with NaN if the length turns out non-zero
+            # at run time — wrong numbers must not be silent.
             from perceiver_io_tpu.utils.arrays import concrete_or_none
 
             concrete_len = concrete_or_none(kv_cache.length)
             if (
-                _PREFILL
+                _PREFILL.get()
                 and n_q > 1
                 and (concrete_len is None or int(concrete_len) == 0)
                 and flash_enabled(self.use_flash)
@@ -314,6 +320,10 @@ class MultiHeadAttention(nn.Module):
                 # slot-aligned pad mask: fresh tokens occupy slots [0, n_kv)
                 fresh_pad = None if pad_mask is None else pad_mask[:, : x_kv.shape[1]]
                 o = self._packed_flash(q, k, v, rope_q, fresh_pad, already_rotated_k=True)
+                if concrete_len is None:
+                    # run-time contract check, fused to a scalar broadcast add
+                    poison = jnp.where(kv_cache.length == 0, 0.0, jnp.nan).astype(o.dtype)
+                    o = o + poison
                 return AttentionOutput(last_hidden_state=self.o_proj(o), kv_cache=new_cache)
         else:
             k_slots, v_slots = k, v

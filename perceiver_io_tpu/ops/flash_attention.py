@@ -31,6 +31,8 @@ the numerics contract vs the einsum path is ``tests/test_flash_attention.py``.
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import functools
 from typing import Optional
 
@@ -71,22 +73,42 @@ LOG2E = 1.4426950408889634  # log2(e)
 # the round-2 kernels bit-for-bit. Read at TRACE time, like
 # set_default_flash. Full table in docs/performance.md.
 ALL_FEATURES = frozenset({"base2", "nobias", "fastmask", "slimstats"})
-FAST_FEATURES: frozenset = frozenset()
+# scoped per-context (contextvar, not a module global): a probe thread
+# toggling features cannot leak them into another thread's traces
+_FAST_FEATURES = contextvars.ContextVar("flash_fast_features", default=frozenset())
+
+
+def _parse_features(mode) -> frozenset:
+    if mode is True:
+        return ALL_FEATURES
+    if mode is False:
+        return frozenset()
+    unknown = frozenset(mode) - ALL_FEATURES
+    if unknown:
+        raise ValueError(f"unknown kernel features: {sorted(unknown)}")
+    return frozenset(mode)
+
+
+def fast_features() -> frozenset:
+    """The active feature set (read at trace time by the kernel builders)."""
+    return _FAST_FEATURES.get()
 
 
 def set_fast_kernels(mode) -> None:
     """Select kernel optimizations (trace-time, for A/B probes): True = all,
-    False = none (round-2 kernels), or an iterable of feature names."""
-    global FAST_FEATURES
-    if mode is True:
-        FAST_FEATURES = ALL_FEATURES
-    elif mode is False:
-        FAST_FEATURES = frozenset()
-    else:
-        unknown = frozenset(mode) - ALL_FEATURES
-        if unknown:
-            raise ValueError(f"unknown kernel features: {sorted(unknown)}")
-        FAST_FEATURES = frozenset(mode)
+    False = none (round-2 kernels), or an iterable of feature names. Affects
+    the CURRENT context only; prefer :func:`fast_kernels` for scoped use."""
+    _FAST_FEATURES.set(_parse_features(mode))
+
+
+@contextlib.contextmanager
+def fast_kernels(mode):
+    """Scoped feature selection: traces inside the with-block see ``mode``."""
+    token = _FAST_FEATURES.set(_parse_features(mode))
+    try:
+        yield
+    finally:
+        _FAST_FEATURES.reset(token)
 
 
 def _exp(x, base2: bool):
@@ -95,6 +117,8 @@ def _exp(x, base2: bool):
 
 def _log(x, base2: bool):
     return jnp.log2(x) if base2 else jnp.log(x)
+
+
 # Residual lane width for the packed kernels' lse/delta side-channels: only
 # one lane per head carries information, but a few lanes keep the tiles
 # loadable; 8 instead of 128 cuts ~250 MB/step of backward residual traffic
@@ -974,7 +998,7 @@ def flash_attention_packed(
     kf = _pad_to(k, 1, block_kv)
     vf = _pad_to(v, 1, block_kv)
 
-    v2 = FAST_FEATURES
+    v2 = fast_features()
     nkv_p = kf.shape[1]
     if "nobias" in v2 and pad_mask is None and nkv_p == nkv:
         # all-zero bias: drop the stream + per-tile add entirely (the
@@ -1038,7 +1062,7 @@ def flash_attention(
     vf = _pad_to(vf, 2, 8)
 
     # additive kv bias per (batch*head) row: padded slots + user pad mask
-    v2 = FAST_FEATURES
+    v2 = fast_features()
     nkv_p = kf.shape[1]
     if "nobias" in v2 and pad_mask is None and nkv_p == nkv:
         bias = None  # all-zero: drop the stream + per-tile add (see packed)
@@ -1104,7 +1128,9 @@ def flash_supported(
     return nq >= 128 and nkv >= 128
 
 
-_FLASH_DEFAULT: Optional[bool] = None  # None = auto (TPU backend only)
+# None = auto (TPU backend only); contextvar so a test/probe override stays
+# scoped to its context instead of leaking across threads
+_FLASH_DEFAULT = contextvars.ContextVar("flash_default", default=None)
 
 
 def set_default_flash(mode: Optional[bool]) -> None:
@@ -1114,16 +1140,27 @@ def set_default_flash(mode: Optional[bool]) -> None:
 
     The flag is read at **trace time**: functions already jit-compiled keep
     whatever path they were traced with. Set it before building/jitting the
-    model (or clear jit caches) for the toggle to take effect."""
-    global _FLASH_DEFAULT
-    _FLASH_DEFAULT = mode
+    model (or clear jit caches) for the toggle to take effect. Affects the
+    current context only; prefer :func:`default_flash` for scoped use."""
+    _FLASH_DEFAULT.set(mode)
+
+
+@contextlib.contextmanager
+def default_flash(mode: Optional[bool]):
+    """Scoped :func:`set_default_flash`: traces inside the block see ``mode``."""
+    token = _FLASH_DEFAULT.set(mode)
+    try:
+        yield
+    finally:
+        _FLASH_DEFAULT.reset(token)
 
 
 def flash_enabled(explicit: Optional[bool] = None) -> bool:
     if explicit is not None:
         return explicit
-    if _FLASH_DEFAULT is not None:
-        return _FLASH_DEFAULT
+    default = _FLASH_DEFAULT.get()
+    if default is not None:
+        return default
     return jax.default_backend() == "tpu"
 
 

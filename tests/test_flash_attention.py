@@ -115,3 +115,35 @@ def test_odd_head_dims_match_einsum(rng):
     g_ref = f(lambda q, k, v: einsum_attention(q, k, v, causal=True, sm_scale=d_qk**-0.5))(q, k, v)
     for a, r in zip(g_flash, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(r), atol=5e-5, rtol=5e-5)
+
+
+def test_fast_kernel_flags_context_scoped():
+    """Feature flags are contextvars: scoped by the context manager, reset on
+    exit, invisible to other threads — no mutable module global reaches
+    trace time (VERDICT r3)."""
+    import threading
+
+    from perceiver_io_tpu.ops.flash_attention import (
+        ALL_FEATURES,
+        fast_features,
+        fast_kernels,
+        set_fast_kernels,
+    )
+
+    assert fast_features() == frozenset()
+    with fast_kernels(["base2", "nobias"]):
+        assert fast_features() == {"base2", "nobias"}
+        seen = {}
+        t = threading.Thread(target=lambda: seen.setdefault("f", fast_features()))
+        t.start()
+        t.join()
+        assert seen["f"] == frozenset()  # fresh thread, fresh context
+        with fast_kernels(True):
+            assert fast_features() == ALL_FEATURES
+        assert fast_features() == {"base2", "nobias"}
+    assert fast_features() == frozenset()
+
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="unknown kernel features"):
+        set_fast_kernels(["warp_speed"])

@@ -323,3 +323,59 @@ def test_prefill_mode_matches_einsum_prime():
         np.testing.assert_allclose(
             np.asarray(c_f.v), np.asarray(c_r.v), atol=1e-6, err_msg=f"cache {i} v"
         )
+
+
+def test_prefill_nonempty_cache_poisons_output():
+    """The prefill empty-cache contract cannot be checked at trace time (the
+    cache length is traced inside the caller's jit); a jitted forward whose
+    cache turns out NON-empty under ``prefill_mode`` must fail loudly — its
+    output is NaN-poisoned at run time — instead of returning silently wrong
+    numbers. The same program with length 0 computes normally."""
+    from perceiver_io_tpu.core.attention import KVCache, prefill_mode
+    from perceiver_io_tpu.ops.flash_attention import default_flash
+
+    config = CausalSequenceModelConfig(
+        vocab_size=100,
+        max_seq_len=256,
+        max_latents=128,
+        num_channels=64,
+        num_heads=4,
+        num_self_attention_layers=1,
+        num_self_attention_rotary_layers=-1,
+    )
+    model = CausalSequenceModel(config)
+    x = jnp.asarray(np.random.default_rng(5).integers(0, 100, size=(BATCH_SIZE, 256)))
+    params = model.init(jax.random.PRNGKey(0), x, prefix_len=128)
+
+    def fwd(ca_len):
+        cache = CausalSequenceModel.init_cache(config, BATCH_SIZE)
+        ca = cache[0]
+        cache = (KVCache(k=ca.k, v=ca.v, length=ca_len),) + cache[1:]
+        return model.apply(params, x, prefix_len=128, kv_cache=cache).logits
+
+    with default_flash(True), prefill_mode():
+        bad = jax.jit(fwd)(jnp.int32(4))
+        good = jax.jit(fwd)(jnp.int32(0))
+    assert np.isnan(np.asarray(bad)).all()
+    assert np.isfinite(np.asarray(good)).all()
+
+
+def test_prefill_flag_is_context_scoped():
+    """prefill_mode must not leak across threads (it is a contextvar, not a
+    module global): a thread started inside the with-block sees the default."""
+    import threading
+
+    from perceiver_io_tpu.core import attention as att
+
+    seen = {}
+
+    def probe():
+        seen["prefill"] = att._PREFILL.get()
+
+    with att.prefill_mode():
+        t = threading.Thread(target=probe)
+        t.start()
+        t.join()
+        assert att._PREFILL.get() is True
+    assert att._PREFILL.get() is False
+    assert seen["prefill"] is False
