@@ -55,6 +55,9 @@ def main_process_only(fn: F) -> F:
     return wrapper  # type: ignore[return-value]
 
 
+STALE_TMP_AGE_SECONDS = 24 * 3600
+
+
 def prepare_once(target, build: Callable[[object], None]) -> None:
     """Race-free build-if-missing for a DETERMINISTIC cached file or
     directory: build into a process-private temp sibling, then atomically
@@ -67,17 +70,33 @@ def prepare_once(target, build: Callable[[object], None]) -> None:
 
     ``build(tmp_path)`` must write the artifact at ``tmp_path`` (creating it
     as a file or directory itself).
+
+    Temp names are host-unique (hostname + pid + random suffix — pid alone
+    collides across hosts on a shared filesystem), and the sweep of leftovers
+    from crashed builds only reclaims temps older than
+    ``STALE_TMP_AGE_SECONDS``: a young temp is very likely a concurrent
+    process still building, and rmtree-ing it mid-write would crash that
+    builder.
     """
     import shutil
+    import socket
+    import time
+    import uuid
     from pathlib import Path
 
     target = Path(target)
     if target.exists():
         return
     target.parent.mkdir(parents=True, exist_ok=True)
-    # sweep stale temps from crashed builds (their pid-suffixed names never
-    # match a later process, so nothing else ever reclaims them)
+    # sweep stale temps from CRASHED builds only (age-gated: the target being
+    # missing is exactly when a concurrent builder may still be writing)
+    now = time.time()
     for stale in target.parent.glob(f".{target.name}.tmp-*"):
+        try:
+            if now - stale.stat().st_mtime < STALE_TMP_AGE_SECONDS:
+                continue
+        except OSError:
+            continue  # vanished under us (the builder finished or cleaned up)
         if stale.is_dir():
             shutil.rmtree(stale, ignore_errors=True)
         else:
@@ -86,7 +105,8 @@ def prepare_once(target, build: Callable[[object], None]) -> None:
             except OSError:
                 pass
 
-    tmp = target.with_name(f".{target.name}.tmp-{os.getpid()}")
+    suffix = f"{socket.gethostname()}-{os.getpid()}-{uuid.uuid4().hex[:8]}"
+    tmp = target.with_name(f".{target.name}.tmp-{suffix}")
 
     def cleanup_tmp():
         if tmp.is_dir():

@@ -100,3 +100,70 @@ def test_two_process_distributed(tmp_path):
     assert [float(r["train_loss"]) for r in rows] == [1.0]  # rank 0's value only
     samples = (out_dir / "samples.txt").read_text()
     assert "from rank 0" in samples and "from rank 1" not in samples
+
+
+def test_prepare_once_builds_and_caches(tmp_path):
+    from perceiver_io_tpu.parallel.dist import prepare_once
+
+    target = tmp_path / "cache.bin"
+    calls = []
+
+    def build(p):
+        calls.append(p)
+        p.write_bytes(b"artifact")
+
+    prepare_once(target, build)
+    assert target.read_bytes() == b"artifact"
+    prepare_once(target, build)  # already built: no second build
+    assert len(calls) == 1
+    # no temp droppings
+    assert list(tmp_path.glob(".cache.bin.tmp-*")) == []
+
+
+def test_prepare_once_sweep_is_age_gated(tmp_path):
+    """A YOUNG temp sibling (a concurrent process mid-build) must survive the
+    stale sweep; an old one (crashed build) is reclaimed (ADVICE r3: the
+    unconditional sweep deleted in-progress builds)."""
+    import os
+    import time
+
+    from perceiver_io_tpu.parallel.dist import STALE_TMP_AGE_SECONDS, prepare_once
+
+    target = tmp_path / "data"
+    young = tmp_path / ".data.tmp-otherhost-123-abcd1234"
+    young.mkdir()
+    (young / "partial").write_text("still writing")
+    old = tmp_path / ".data.tmp-deadhost-9-deadbeef"
+    old.mkdir()
+    ancient = time.time() - STALE_TMP_AGE_SECONDS - 60
+    os.utime(old, (ancient, ancient))
+
+    def build(p):
+        p.mkdir()
+        (p / "done").write_text("ok")
+
+    prepare_once(target, build)
+    assert (target / "done").exists()
+    assert young.exists() and (young / "partial").exists()  # spared
+    assert not old.exists()  # reclaimed
+
+
+def test_prepare_once_temp_suffix_host_unique(tmp_path):
+    """Temp names embed hostname+pid+random — two builders on different hosts
+    with the same pid cannot collide on a shared filesystem (ADVICE r3)."""
+    import socket
+
+    from perceiver_io_tpu.parallel.dist import prepare_once
+
+    seen = []
+
+    def build(p):
+        seen.append(p.name)
+        p.write_text("x")
+
+    prepare_once(tmp_path / "a", build)
+    prepare_once(tmp_path / "b", build)
+    host = socket.gethostname()
+    assert all(host in n for n in seen)
+    # the random component differs between invocations of the same process
+    assert seen[0].rsplit("-", 1)[1] != seen[1].rsplit("-", 1)[1]
