@@ -22,6 +22,37 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# --- analytic-baseline assumptions (documented in BASELINE.md) -------------
+# The reference publishes no throughput numbers, so vs_baseline compares
+# against an ANALYTIC single-A100 estimate. Compute-bound modes assume the
+# eager-torch reference sustains MFU_BAR on an A100's bf16 peak — a generous
+# bar (the reference materializes full f32 score tensors, modules.py:151-163,
+# whose HBM traffic at 16k context costs about as much time as the attention
+# matmuls themselves); MFU_LOW bounds the plausible eager MFU from below and
+# yields the optimistic end of the reported vs_baseline_range. Decode is
+# bandwidth-bound on both chips: the A100 gets A100_BW_FRAC of its peak
+# bandwidth, and the reported ceiling_fraction situates the measurement
+# against THIS chip's physical bandwidth cap.
+A100_BF16_PEAK = 312e12
+MFU_BAR = 0.40  # the bar every round's headline vs_baseline used
+MFU_LOW = 0.20  # defended lower bound for eager materialized-score attention
+A100_PEAK_BW = 1.555e12  # A100-40GB HBM2e
+A100_BW_FRAC = 0.60
+V5E_PEAK_BW = 819e9  # v5e HBM
+
+
+def _vs_baseline_fields(flops: float, step_time: float) -> dict:
+    """Headline vs_baseline (A100 @ MFU_BAR) plus the assumption-range pair."""
+    conservative = (flops / (A100_BF16_PEAK * MFU_BAR)) / step_time
+    optimistic = (flops / (A100_BF16_PEAK * MFU_LOW)) / step_time
+    return {
+        "vs_baseline": round(conservative, 3),
+        # [A100 @ 40% MFU, A100 @ 20% MFU] — the denominator is an analytic
+        # assumption, not a measurement; see BASELINE.md "Baseline assumptions"
+        "vs_baseline_range": [round(conservative, 3), round(optimistic, 3)],
+    }
+
+
 def _enable_compile_cache():
     """Persistent compile cache: the 16k-context programs take minutes to
     build through the tunnel; repeat runs (A/Bs, the multi-part --mode
@@ -198,7 +229,6 @@ def image_bench(args):
         + 2 * lat * 2 * enc.self_attention_widening_factor * lc * lc
     )
     flops = 3.0 * (ca + sa) * b
-    vs_baseline = round((flops / (312e12 * 0.40)) / step_time, 3)
 
     result = {
         "metric": f"perceiver-io img-clf train img/sec/chip "
@@ -206,7 +236,7 @@ def image_bench(args):
         f"({n_params/1e6:.1f}M params, {args.dtype}, batch {b})",
         "value": round(b / step_time, 2),
         "unit": "img/sec/chip",
-        "vs_baseline": vs_baseline,
+        **_vs_baseline_fields(flops, step_time),
     }
     print(json.dumps(result))
     return result
@@ -256,7 +286,13 @@ def decode_bench(args):
         config.num_self_attention_layers * config.max_latents * 2 * config.num_channels * dsize
     )
     step_bytes = n_params * dsize + b * (ca_window + sa_windows)
-    a100_step_time = step_bytes / (1.555e12 * 0.60)
+    a100_step_time = step_bytes / (A100_PEAK_BW * A100_BW_FRAC)
+    # THIS chip's physical floor: the same bytes at 100% of v5e bandwidth.
+    # vs_baseline is capped at a100_step_time/v5e_floor even at perfect
+    # bandwidth utilization (the A100 has 1.9x v5e's bandwidth), so the
+    # artifact carries both the cap and how close the measurement is to the
+    # chip's own ceiling (VERDICT r3: the cap lived in prose, not the bench).
+    v5e_floor = step_bytes / V5E_PEAK_BW
 
     result = {
         "metric": f"perceiver-ar-clm decode tokens/sec @{args.seq_len} ctx "
@@ -265,6 +301,8 @@ def decode_bench(args):
         "unit": "tokens/sec",
         # both sides are one decode step (b tokens)
         "vs_baseline": round(a100_step_time / per_token, 3),
+        "vs_baseline_cap": round(a100_step_time / v5e_floor, 3),
+        "ceiling_fraction": round(v5e_floor / per_token, 3),
     }
     print(json.dumps(result))
     return result
@@ -369,10 +407,8 @@ def main():
     step_time = scan_step_time(step, state, batch, args.steps)
     tokens_per_sec = b * n / step_time
 
-    # analytic A100 reference: same step at 312 TFLOPS bf16, 40% MFU
+    # analytic A100 reference: same step FLOPs at MFU_BAR..MFU_LOW
     flops = train_step_flops(config, b, prefix_dropout_keep=0.5)
-    a100_step_time = flops / (312e12 * 0.40)
-    vs_baseline = a100_step_time / step_time
 
     result = {
         "metric": f"perceiver-ar-clm train tokens/sec/chip @{args.seq_len} ctx "
@@ -380,7 +416,7 @@ def main():
         f"microbatch {microbatch}, prefix_len={prefix_len})",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/sec/chip",
-        "vs_baseline": round(vs_baseline, 3),
+        **_vs_baseline_fields(flops, step_time),
     }
     print(json.dumps(result))
 
