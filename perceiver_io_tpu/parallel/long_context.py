@@ -120,6 +120,51 @@ def make_seq_parallel_clm_forward(model, mesh: Mesh, *, prefix_len: int, axis_na
     return fn
 
 
+def make_ring_clm_loss(model, mesh: Mesh, *, max_latents: int, axis_name: str = AXIS_SEQ):
+    """Trainer-compatible CLM loss over the explicit sequence-parallel path —
+    the ``--trainer.strategy=ring`` route (scripts/cli.py): the prefix is
+    sharded over ``axis_name`` and its cross-attention partial goes through
+    ``parallel.ring_attention.seq_sharded_cross_attention`` (see
+    ``PerceiverAR.seq_parallel_forward``), unlike strategy ``seq`` where XLA
+    partitions the dense forward from sharding annotations alone.
+
+    Signature parity with ``training.losses.clm_loss_fn``:
+    ``loss_fn(params, batch, rng, deterministic=False) -> (loss, metrics)``
+    over ``{"labels", "input_ids", "pad_mask"}`` batches; the loss window is
+    the last ``max_latents`` positions (reference:
+    perceiver/model/core/lightning.py:117-133). ``prefix_len`` is derived
+    from each batch's static sequence length.
+    """
+    inner = {}
+
+    def loss_fn(params, batch, rng, deterministic: bool = False):
+        labels, x = batch["labels"], batch["input_ids"]
+        pad_mask = batch["pad_mask"]
+        prefix_len = x.shape[1] - max_latents
+        if prefix_len not in inner:
+            inner[prefix_len] = make_seq_parallel_clm_loss(
+                model, mesh, prefix_len=prefix_len, axis_name=axis_name
+            )
+        # the left-pad-only contract is checked by _split_prompt EAGERLY only
+        # (under the Trainer's jitted step the mask is a tracer); mask padded
+        # latent labels regardless, matching the dense clm_loss_fn (a short
+        # document left-padded into the latent window must not contribute
+        # pad-token targets to the CE)
+        lat_labels = labels[:, -max_latents:]
+        if pad_mask is not None:
+            lat_labels = jnp.where(pad_mask[:, -max_latents:], -100, lat_labels)
+        loss = inner[prefix_len](
+            params,
+            x,
+            lat_labels,
+            pad_mask=pad_mask,
+            dropout_rng=None if deterministic else rng,
+        )
+        return loss, {"loss": loss}
+
+    return loss_fn
+
+
 def make_seq_parallel_clm_loss(model, mesh: Mesh, *, prefix_len: int, axis_name: str = AXIS_SEQ):
     """``loss(params, input_ids, labels) -> scalar`` — mean next-token CE over
     the latent positions (the reference's CLM loss window: loss over the last

@@ -62,7 +62,8 @@ def seq_sharded_cross_attention(
     axis_name: str = AXIS_SEQ,
     causal: bool = False,
     kv_len_total: Optional[int] = None,
-) -> jnp.ndarray:
+    finalize: bool = True,
+):
     """Cross-attention with replicated queries and KV sharded along
     ``axis_name``. Call inside ``shard_map``.
 
@@ -71,8 +72,14 @@ def seq_sharded_cross_attention(
     pad_mask_local: (B, M_local) True = masked, or None.
     causal: right-aligned causal mask over *global* KV positions (Perceiver
         AR latents: query i sits at global position kv_len_total - N + i).
+    finalize: normalize and return (B, H, N, Dv) f32 output (default); with
+        ``finalize=False`` return the un-normalized online-softmax partial
+        ``(o, m, l)`` so callers can fold further blocks in with
+        ``online_combine`` — the composition hook PerceiverAR's
+        sequence-parallel forward uses to merge the sharded-prefix partial
+        with its replicated causal latent block.
     Returns the normalized output (B, H, N, Dv) in float32, identical on all
-    devices of the axis.
+    devices of the axis (or the ``(o, m, l)`` partial, see ``finalize``).
     """
     idx = lax.axis_index(axis_name)
     m_local = k_local.shape[2]
@@ -95,6 +102,8 @@ def seq_sharded_cross_attention(
     scale = jnp.exp(m - jnp.maximum(m_glob, _NEG_INF / 2))
     o = lax.psum(o * scale[..., None], axis_name)
     l = lax.psum(l * scale, axis_name)
+    if not finalize:
+        return o, m_glob, l
     return _finalize(o, l)
 
 

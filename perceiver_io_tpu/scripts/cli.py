@@ -122,7 +122,10 @@ class TrainerArgs:
     precision: str = "float32"  # float32 | bfloat16 (params stay f32)
     gradient_clip_val: Optional[float] = None
     accumulate_grad_batches: int = 1
-    strategy: str = "dp"  # dp (DDP parity) | fsdp (ZeRO parity) | tp | fsdp_tp | seq (context parallel)
+    # dp (DDP parity) | fsdp (ZeRO parity) | tp | fsdp_tp | seq (context
+    # parallel via GSPMD annotations) | ring (context parallel via the
+    # explicit shard_map ring/LSE-combine path — CLM only)
+    strategy: str = "dp"
     fsdp_min_weight_size: int = 2**14
     devices: int = -1  # -1 = all visible
     seed: int = 0
@@ -290,12 +293,17 @@ def make_mesh_for(trainer: TrainerArgs):
         n = len(devices)
         tensor = 2 if n % 2 == 0 else 1
         return make_mesh(data=1, fsdp=n // tensor, tensor=tensor, devices=devices)
-    if trainer.strategy == "seq":
+    if trainer.strategy in ("seq", "ring"):
         # sequence/context parallelism: the batch's token dim is sharded over
         # the seq axis (beyond reference parity — SURVEY §2.7 P8); the
-        # sequence length must be divisible by the device count
+        # sequence length must be divisible by the device count. "seq" lets
+        # GSPMD partition the dense forward from the annotations; "ring"
+        # routes the CLM prefix through the explicit shard_map
+        # ring/LSE-combine kernels (parallel/ring_attention.py)
         return make_mesh(data=1, seq=len(devices), devices=devices)
-    raise ValueError(f"unknown strategy: {trainer.strategy} (expected dp|fsdp|tp|fsdp_tp|seq)")
+    raise ValueError(
+        f"unknown strategy: {trainer.strategy} (expected dp|fsdp|tp|fsdp_tp|seq|ring)"
+    )
 
 
 def make_lr_schedule(opt: OptimizerArgs, max_steps: int):
@@ -326,6 +334,7 @@ def run_training(
     callbacks: Sequence = (),
     frozen_paths: Sequence[str] = (),
     warm_start=None,
+    ring_loss_builder=None,
 ):
     """Shared fit/validate runner for all task CLIs.
 
@@ -335,6 +344,11 @@ def run_training(
     :param warm_start: optional ``params -> params`` hook applied after init
         (ckpt / encoder warm-start, reference: perceiver/model/core/
         lightning.py:145-147, text/classifier/lightning.py:28-36).
+    :param ring_loss_builder: ``(model, mesh) -> loss_fn`` for
+        ``--trainer.strategy=ring`` (the explicit shard_map sequence-parallel
+        path, CLM only — ``parallel.long_context.make_ring_clm_loss``);
+        strategies other than ``ring`` ignore it, and ``ring`` without a
+        builder is rejected (the task has no sequence-parallel route).
     """
     import jax
 
@@ -365,9 +379,20 @@ def run_training(
 
     run_dir = Path(trainer_args.default_root_dir) / trainer_args.name
     logger = MetricsLogger(str(run_dir))
+    mesh = make_mesh_for(trainer_args)
+    if trainer_args.strategy == "ring":
+        if ring_loss_builder is None:
+            raise ValueError(
+                "strategy 'ring' requires a sequence-parallel loss route; "
+                "this task does not provide one (use the CLM CLI, or a "
+                "dp/fsdp/tp/seq strategy)"
+            )
+        loss_fn = ring_loss_builder(model, mesh)
+    else:
+        loss_fn = loss_builder(model.apply)
     trainer = Trainer(
-        loss_builder(model.apply),
-        mesh=make_mesh_for(trainer_args),
+        loss_fn,
+        mesh=mesh,
         config=TrainerConfig(
             max_steps=trainer_args.max_steps,
             log_interval=trainer_args.log_interval,

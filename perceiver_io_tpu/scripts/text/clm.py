@@ -112,6 +112,13 @@ def main(argv: Optional[Sequence[str]] = None):
         "prefix_len": seq_len - model_config.max_latents,
         "pad_mask": np.zeros((1, seq_len), bool),
     }
+    def ring_loss_builder(mdl, mesh):
+        # --trainer.strategy=ring: prefix sharded over the seq axis, CA
+        # partial through parallel/ring_attention.py (shard_map explicit)
+        from perceiver_io_tpu.parallel.long_context import make_ring_clm_loss
+
+        return make_ring_clm_loss(mdl, mesh, max_latents=model_config.max_latents)
+
     return cli.run_training(
         model,
         model_config,
@@ -123,6 +130,7 @@ def main(argv: Optional[Sequence[str]] = None):
         opt_args,
         command=args.command,
         callbacks=[make_sample_callback(model, data.tokenizer, task_args)],
+        ring_loss_builder=ring_loss_builder,
     )
 
 

@@ -390,3 +390,66 @@ def test_img_clf_default_heads_build(tmp_path):
         ]
     )
     assert int(state.step) == 1
+
+
+def test_make_mesh_for_ring_strategy():
+    import jax
+
+    mesh = cli.make_mesh_for(cli.TrainerArgs(strategy="ring"))
+    assert mesh.shape["seq"] == len(jax.devices())
+
+
+@pytest.mark.slow
+def test_clm_cli_fit_ring(tmp_path):
+    """--trainer.strategy=ring end-to-end: the CLM CLI trains through the
+    explicit shard_map sequence-parallel path (VERDICT r3 item 6)."""
+    from perceiver_io_tpu.scripts.text.clm import main
+
+    train_file = tmp_path / "train.txt"
+    train_file.write_text("hello world, this is a tiny corpus. " * 40)
+    state, _ = main(
+        [
+            "fit",
+            "--data.dataset=textfile",
+            f"--data.train_file={train_file}",
+            "--data.max_seq_len=40",  # prefix 32 divides the 8-device seq axis
+            "--data.batch_size=2",
+            f"--data.cache_dir={tmp_path / 'cache'}",
+            "--model.max_latents=8",
+            "--model.num_channels=32",
+            "--model.num_self_attention_layers=1",
+            "--model.num_heads=2",
+            "--model.cross_attention_dropout=0.0",
+            "--task.sample_prompt=hello",
+            "--task.num_sample_tokens=4",
+            "--trainer.strategy=ring",
+            *_tiny_trainer_flags(tmp_path),
+        ]
+    )
+    assert int(state.step) == 3
+
+
+def test_ring_strategy_rejected_without_builder(tmp_path):
+    """Tasks with no sequence-parallel route reject strategy=ring loudly."""
+    import numpy as np
+
+    from perceiver_io_tpu.models.text import CausalLanguageModel, CausalLanguageModelConfig
+    from perceiver_io_tpu.training.losses import clm_loss_fn
+
+    config = CausalLanguageModelConfig(
+        vocab_size=16, max_seq_len=16, max_latents=8, num_channels=32,
+        num_heads=2, num_self_attention_layers=1,
+    )
+    model = CausalLanguageModel(config)
+    with pytest.raises(ValueError, match="strategy 'ring'"):
+        cli.run_training(
+            model,
+            config,
+            lambda apply_fn: clm_loss_fn(apply_fn, 8),
+            {"x": np.zeros((1, 16), np.int32), "prefix_len": 8,
+             "pad_mask": np.zeros((1, 16), bool)},
+            iter([]),
+            [],
+            cli.TrainerArgs(strategy="ring", default_root_dir=str(tmp_path)),
+            cli.OptimizerArgs(),
+        )

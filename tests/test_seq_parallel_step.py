@@ -157,3 +157,69 @@ def test_trainer_seq_strategy_fits():
     )
     out_state = trainer.fit(state, iter(lambda: dict(batch), None))
     assert int(out_state.step) == 3
+
+
+def test_ring_loss_matches_dense():
+    """`make_ring_clm_loss` — the --trainer.strategy=ring route — must equal
+    the dense clm_loss_fn: same loss and same gradients (the prefix CA
+    partial goes through parallel/ring_attention.seq_sharded_cross_attention
+    inside shard_map instead of the dense forward)."""
+    from perceiver_io_tpu.parallel.long_context import make_ring_clm_loss
+
+    model, state, batch, _ = build()
+    mesh = make_mesh(data=1, seq=4, devices=jax.devices()[:4])
+    dense_loss = clm_loss_fn(model.apply, max_latents=16, deterministic=True)
+    ring_loss = make_ring_clm_loss(model, mesh, max_latents=16)
+
+    rng = jax.random.PRNGKey(0)
+    (l_d, _), g_d = jax.value_and_grad(dense_loss, has_aux=True)(state.params, batch, rng)
+    (l_r, m_r), g_r = jax.value_and_grad(
+        lambda p, b, r: ring_loss(p, b, r, deterministic=True), has_aux=True
+    )(state.params, batch, rng)
+
+    np.testing.assert_allclose(float(l_r), float(l_d), rtol=1e-5)
+    assert float(m_r["loss"]) == pytest.approx(float(l_r))
+    for a, b in zip(jax.tree.leaves(g_r), jax.tree.leaves(g_d)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4, rtol=2e-3)
+
+
+def test_ring_train_step_runs_with_trainer_step():
+    """One optimizer step through make_train_step on the ring loss (the
+    Trainer's exact route for strategy=ring): finite loss, params move."""
+    from perceiver_io_tpu.parallel.long_context import make_ring_clm_loss
+
+    model, state, batch, _ = build()
+    mesh = make_mesh(data=1, seq=4, devices=jax.devices()[:4])
+    step = make_train_step(make_ring_clm_loss(model, mesh, max_latents=16), donate=False)
+    new_state, metrics = step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+    before = jax.tree.leaves(state.params)[0]
+    after = jax.tree.leaves(new_state.params)[0]
+    assert not np.allclose(np.asarray(before), np.asarray(after))
+
+
+def test_ring_loss_masks_padded_latent_labels():
+    """A pad mask reaching into the latent window must not contribute
+    pad-token targets to the CE (code-review r4): the jitted ring loss
+    ignores those positions exactly like the dense clm_loss_fn."""
+    from perceiver_io_tpu.parallel.long_context import make_ring_clm_loss
+
+    model, state, batch, _ = build()
+    mesh = make_mesh(data=1, seq=4, devices=jax.devices()[:4])
+    ring_loss = make_ring_clm_loss(model, mesh, max_latents=16)
+
+    # poison the last two label positions and mark them padded: the loss must
+    # not change vs masking them with -100 explicitly
+    pad = np.zeros((2, 64), bool)
+    pad[:, -2:] = True
+    poisoned = dict(batch, pad_mask=jnp.asarray(pad))
+    explicit = dict(
+        batch,
+        pad_mask=jnp.asarray(pad),
+        labels=batch["labels"].at[:, -2:].set(-100),
+    )
+    rng = jax.random.PRNGKey(0)
+    loss_fn = jax.jit(lambda p, b: ring_loss(p, b, rng, deterministic=True)[0])
+    l_poisoned = float(loss_fn(state.params, poisoned))
+    l_explicit = float(loss_fn(state.params, explicit))
+    assert l_poisoned == pytest.approx(l_explicit, rel=1e-6)
