@@ -351,6 +351,12 @@ def main():
     # the chunked step measured -5% step time same-process (21.63 vs
     # 22.77 ms at batch 4) while staying mathematically the full-batch step
     p.add_argument("--microbatch", type=int, default=2)
+    # round-4 winners (same-process A/B, tools/step_ab.py — docs/performance.md):
+    # host-sampled prefix-dropout keep indices (kills the in-graph top_k+sort,
+    # -2.8% step) and bf16 Adam moment storage (halves optimizer HBM traffic,
+    # -2.5%); together -5.1% (21.66 -> 20.56 ms at batch 4)
+    p.add_argument("--dropout-sampling", choices=["host", "graph"], default="host")
+    p.add_argument("--moment-dtype", choices=["float32", "bfloat16"], default="bfloat16")
     p.add_argument("--dtype", default="bfloat16")
     p.add_argument("--remat", action="store_true", help="activation checkpointing (needed for large seq/batch)")
     p.add_argument("--mode", choices=["train", "decode", "img", "extra"], default="train")
@@ -388,12 +394,22 @@ def main():
     }
 
     prefix_len = n - args.latents
+    if args.dropout_sampling == "host":
+        from perceiver_io_tpu.training.prefix_dropout import sample_prefix_keep_idx
+
+        batch["prefix_keep_idx"] = jnp.asarray(
+            sample_prefix_keep_idx(rng, b, prefix_len, config.cross_attention_dropout)
+        )
     params = model.init(
         jax.random.PRNGKey(0), batch["input_ids"][:, : args.latents + 1], prefix_len=1
     )
     n_params = sum(p.size for p in jax.tree.leaves(params))
 
-    tx = make_optimizer(1e-3, gradient_clip=1.0)
+    tx = make_optimizer(
+        1e-3,
+        gradient_clip=1.0,
+        moment_dtype=None if args.moment_dtype == "float32" else args.moment_dtype,
+    )
     state = TrainState.create(model.apply, params, tx, jax.random.PRNGKey(1))
     if args.microbatch < 1:
         raise SystemExit("--microbatch must be >= 1")

@@ -119,12 +119,29 @@ def main(argv: Optional[Sequence[str]] = None):
 
         return make_ring_clm_loss(mdl, mesh, max_latents=model_config.max_latents)
 
+    train_iter = cli.cycle(data.train_batches())
+    if model_config.cross_attention_dropout > 0.0 and trainer_args.strategy not in ("ring", "seq"):
+        # host-sampled prefix-dropout keep sets: same law as the in-graph
+        # draw, overlapped with device compute by the prefetch pipeline
+        # (-2.8% step time at the 16k flagship — docs/performance.md r4).
+        # ring/seq draw in-graph instead: ring uses the replicated-rng
+        # keep-mask, and seq token-shards every batch array's dim 1 — the
+        # (B, keep) index array must not ride that sharding.
+        from perceiver_io_tpu.training.prefix_dropout import with_prefix_keep_idx
+
+        train_iter = with_prefix_keep_idx(
+            train_iter,
+            prefix_len=seq_len - model_config.max_latents,
+            dropout=model_config.cross_attention_dropout,
+            seed=trainer_args.seed,
+        )
+
     return cli.run_training(
         model,
         model_config,
         lambda apply_fn: clm_loss_fn(apply_fn, model_config.max_latents),
         init_batch,
-        cli.cycle(data.train_batches()),
+        train_iter,
         data.valid_batches(),
         trainer_args,
         opt_args,

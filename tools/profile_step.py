@@ -58,6 +58,11 @@ def main():
     p.add_argument("--top", type=int, default=40)
     p.add_argument("--out", default="/tmp/prof_step")
     p.add_argument("--mode", choices=["train", "decode"], default="train")
+    # match the bench.py round-4 defaults so the profile reflects the step
+    # the driver actually measures
+    p.add_argument("--microbatch", type=int, default=2)
+    p.add_argument("--dropout-sampling", choices=["host", "graph"], default="host")
+    p.add_argument("--moment-dtype", choices=["float32", "bfloat16"], default="bfloat16")
     args = p.parse_args()
 
     if args.mode == "decode":
@@ -78,10 +83,22 @@ def main():
         "input_ids": jnp.asarray(t[:, :-1]),
         "pad_mask": None,
     }
+    if args.dropout_sampling == "host":
+        from perceiver_io_tpu.training.prefix_dropout import sample_prefix_keep_idx
+
+        batch["prefix_keep_idx"] = jnp.asarray(
+            sample_prefix_keep_idx(rng, b, n - args.latents, config.cross_attention_dropout)
+        )
     params = model.init(jax.random.PRNGKey(0), batch["input_ids"][:, : args.latents + 1], prefix_len=1)
-    tx = make_optimizer(1e-3, gradient_clip=1.0)
+    tx = make_optimizer(
+        1e-3,
+        gradient_clip=1.0,
+        moment_dtype=None if args.moment_dtype == "float32" else args.moment_dtype,
+    )
     state = TrainState.create(model.apply, params, tx, jax.random.PRNGKey(1))
-    step = make_train_step(clm_loss_fn(model.apply, max_latents=args.latents))
+    step = make_train_step(
+        clm_loss_fn(model.apply, max_latents=args.latents), microbatch=args.microbatch
+    )
 
     # warm up / compile outside the trace
     for _ in range(2):
