@@ -15,6 +15,7 @@ import csv
 from perceiver_io_tpu.utils import (
     ComputeEstimator,
     ModelInfo,
+    fit_scaling_exponents,
     fit_scaling_law,
     num_training_steps,
     training_flops,
@@ -227,8 +228,15 @@ def cmd_fit_demo(args):
             params.append(r["n"])
             tokens.append(d_at_c)
 
-    law = fit_scaling_law(flops, params, tokens, a=args.a, b=args.b)
-    print(f"\nfitted law over {len(flops)} envelope points, {len(runs)} model size(s):")
+    if args.free_exponents:
+        # exponents fitted from the envelope itself (Chinchilla approach-1
+        # §3.1) instead of fixed at the published values — the offline
+        # physics check: exponents must come out stable across seeds
+        law = fit_scaling_exponents(flops, params, tokens)
+        print(f"\nfree-exponent fit over {len(flops)} envelope points, {len(runs)} model size(s):")
+    else:
+        law = fit_scaling_law(flops, params, tokens, a=args.a, b=args.b)
+        print(f"\nfitted law over {len(flops)} envelope points, {len(runs)} model size(s):")
     print(law)
     for c in (1e15, 1e16, 1e17):
         print(f"C={c:.0e}: N_opt={law.n_opt(c)/1e6:.1f}M  D_opt={law.d_opt(c)/1e6:.1f}M tokens")
@@ -280,6 +288,12 @@ def main(argv=None):
         help="csv:channels:layers — repeat for the multi-model approach-1 envelope",
     )
     demo.add_argument("--budget-points", type=int, default=12)
+    demo.add_argument(
+        "--free-exponents",
+        action="store_true",
+        help="fit a/b from the envelope (approach-1 exponent extraction) "
+        "instead of fixing them at --a/--b",
+    )
     demo.set_defaults(fn=cmd_fit_demo)
 
     args = parser.parse_args(argv)

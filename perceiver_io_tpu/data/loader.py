@@ -10,6 +10,7 @@ Per-process sharding replaces ``split_dataset_by_node``
 
 from __future__ import annotations
 
+import queue as _queue  # module-level: close() may run during interpreter shutdown
 from typing import Callable, Optional, Sequence
 
 import numpy as np
@@ -98,23 +99,30 @@ class PrefetchIterator:
     the iterator keeps raising StopIteration per the iterator protocol.
 
     ``close()`` (or garbage collection — the producer holds no reference to
-    this object) stops the producer.
+    this object) stops the producer. Up to ``depth + 1`` batches may have
+    been pulled from the wrapped iterator but not yet consumed at that
+    point; ``close()`` recovers them in order as ``self.residual`` so a
+    caller reusing the SAME underlying iterator (sequential ``fit()``
+    calls: resume, curriculum phases) can re-inject them instead of
+    silently losing batches (ADVICE r3) — ``Trainer.fit`` does exactly
+    that when the same Trainer instance sees the same iterator again.
     """
 
     _DONE = object()
 
     def __init__(self, iterator, depth: int = 2):
-        import queue
         import threading
 
         if depth < 1:
             raise ValueError("prefetch depth must be >= 1")
-        self._queue: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._queue: _queue.Queue = _queue.Queue(maxsize=depth)
         self._stop = threading.Event()
         self._exhausted = False
+        self._leftover: list = []  # producer parks its un-put in-flight item
+        self.residual: list = []  # filled by close(): produced, never consumed
         self._thread = threading.Thread(
             target=_prefetch_produce,
-            args=(iter(iterator), self._queue, self._stop, self._DONE),
+            args=(iter(iterator), self._queue, self._stop, self._DONE, self._leftover),
             daemon=True,
             name="batch-prefetch",
         )
@@ -135,16 +143,43 @@ class PrefetchIterator:
             raise item
         return item
 
+    def alive(self) -> bool:
+        """True while the producer thread has not exited — it may be blocked
+        inside the wrapped iterator's ``__next__`` (a slow source survives
+        ``close()``'s bounded join)."""
+        return self._thread.is_alive()
+
     def close(self) -> None:
+        """Stop the producer and recover produced-but-unconsumed batches into
+        ``self.residual`` (cumulative — safe to call again, e.g. after an
+        ``alive()`` producer finally exits; each batch is collected once).
+        The in-flight parked item is harvested only once the thread has
+        actually exited, so a still-running producer cannot race the list."""
         self._stop.set()
+        self._thread.join(timeout=5.0)
+        # queue contents first (produced earlier than the parked item)
+        drained = []
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except _queue.Empty:
+                break
+            if item is not self._DONE and not isinstance(item, BaseException):
+                drained.append(item)
+        self.residual = self.residual + drained
+        if not self._thread.is_alive():
+            self.residual = self.residual + self._leftover
+            self._leftover = []
 
     def __del__(self):
         self.close()
 
 
-def _prefetch_produce(it, out_queue, stop, done_sentinel):
+def _prefetch_produce(it, out_queue, stop, done_sentinel, leftover):
     """Producer loop — a free function so the thread holds no reference to
-    the PrefetchIterator (garbage-collecting the wrapper can stop it)."""
+    the PrefetchIterator (garbage-collecting the wrapper can stop it).
+    An item already pulled from ``it`` when stop is raised is parked in
+    ``leftover`` for ``close()`` to recover."""
     import queue
 
     def put_stop_aware(item) -> bool:
@@ -159,6 +194,7 @@ def _prefetch_produce(it, out_queue, stop, done_sentinel):
     try:
         for item in it:
             if not put_stop_aware(item):
+                leftover.append(item)
                 return
         put_stop_aware(done_sentinel)
     except BaseException as e:  # re-raised in the consumer

@@ -29,6 +29,8 @@ differs; the default everywhere is the identical-formula jnp fallback.
 from __future__ import annotations
 
 import functools
+import contextlib
+import contextvars
 from typing import Optional
 
 import jax
@@ -40,20 +42,32 @@ from jax.experimental.pallas import tpu as pltpu
 
 STAT_LANES = 8  # residual lanes for per-row mean/rstd (lane 0 carries data)
 
-_FUSED_LN_DEFAULT: Optional[bool] = None  # None = auto (currently: OFF, see module notes)
+# None = auto (currently: OFF, see module notes); a contextvar like the
+# other trace-time toggles (no mutable module global reaches tracing)
+_FUSED_LN_DEFAULT = contextvars.ContextVar("fused_ln_default", default=None)
 
 
 def set_default_fused_ln(mode: Optional[bool]) -> None:
     """True forces the Pallas path (interpret off-TPU — slow, for tests),
     False disables it, None restores the measured auto default (off).
-    Read at trace time."""
-    global _FUSED_LN_DEFAULT
-    _FUSED_LN_DEFAULT = mode
+    Read at trace time; affects the current context only."""
+    _FUSED_LN_DEFAULT.set(mode)
+
+
+@contextlib.contextmanager
+def fused_ln(mode: Optional[bool]):
+    """Scoped :func:`set_default_fused_ln`."""
+    token = _FUSED_LN_DEFAULT.set(mode)
+    try:
+        yield
+    finally:
+        _FUSED_LN_DEFAULT.reset(token)
 
 
 def _fused_enabled() -> bool:
-    if _FUSED_LN_DEFAULT is not None:
-        return _FUSED_LN_DEFAULT
+    default = _FUSED_LN_DEFAULT.get()
+    if default is not None:
+        return default
     # auto = off: the fused path measured ~1% slower on the flagship train
     # step (A/B above); flip with set_default_fused_ln to re-probe
     return False
@@ -223,7 +237,14 @@ _ln2d.defvjp(_ln2d_fwd, _ln2d_bwd)
 
 
 def _reference_ln(x, scale, bias, eps, dtype):
-    """flax.linen.LayerNorm formula (fast variance, f32 stats)."""
+    """flax.linen.LayerNorm formula (fast variance, f32 stats).
+
+    Intentional precision deviation from ``nn.LayerNorm(dtype=narrow)``
+    (ADVICE r3): flax casts x/mean/var to the narrow dtype BEFORE
+    normalizing; here the whole normalize (center, rsqrt, scale/bias) runs
+    in f32 and only the final output is cast — strictly tighter numerics
+    for bf16 configs, matching the Pallas kernels so the fused/fallback
+    paths agree bit-for-bit in their f32 math."""
     xf = x.astype(jnp.float32)
     mean = jnp.mean(xf, axis=-1, keepdims=True)
     mean2 = jnp.mean(xf * xf, axis=-1, keepdims=True)
@@ -254,7 +275,14 @@ class FusedLayerNorm(nn.Module):
     """Drop-in for ``nn.LayerNorm`` (same {scale, bias} parameters, same
     defaults) backed by the fused kernels; pass ``name=`` explicitly when
     replacing an auto-named ``nn.LayerNorm`` (e.g. ``LayerNorm_0``) so
-    checkpoint naming is preserved."""
+    checkpoint naming is preserved.
+
+    Scope deviations from ``nn.LayerNorm`` (intentional, ADVICE r3): with a
+    narrow ``dtype`` the normalize stays in f32 end-to-end and only the
+    output is cast (flax casts before normalizing — slightly looser
+    numerics); the ``use_scale``/``use_bias``/``param_dtype`` knobs are not
+    reproduced (no caller in this framework disables scale/bias or narrows
+    parameter storage)."""
 
     epsilon: float = 1e-5
     dtype: jnp.dtype = jnp.float32

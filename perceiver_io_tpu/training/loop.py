@@ -34,13 +34,16 @@ def make_train_step(
     equally — true for uniform per-token objectives like the packed CLM
     flagship (no padding, no ignored labels), NOT for losses that normalize
     by a per-call valid-token count (padded batches, masked-LM
-    ``IGNORE_INDEX``) — there the chunk mean-of-means reweights tokens. A
-    batch carrying a non-None ``pad_mask`` is rejected at trace time;
-    label-masking objectives must keep ``microbatch=1``. Metrics are
-    averaged across chunks (correct for means like ``loss``; count-valued
-    metrics would come out scaled by 1/k — another reason masking
-    objectives keep the default). Dropout draws differ per chunk but keep
-    the same distribution.
+    ``IGNORE_INDEX``) — there the chunk mean-of-means reweights tokens.
+    Enforced two ways (ADVICE r3): a loss factory may declare itself with a
+    ``uniform_weighting`` attribute — ``False`` (e.g. ``masked_lm_loss_fn``)
+    is rejected at build time, ``True`` is always allowed — and an
+    undeclared loss falls back to the trace-time pad sniff: a batch
+    carrying a non-None ``pad_mask`` is rejected. Metrics are averaged
+    across chunks (correct for means like ``loss``; count-valued metrics
+    would come out scaled by 1/k — the other reason masking objectives are
+    rejected). Dropout draws differ per chunk but keep the same
+    distribution.
 
     Measured motivation (v5e, 16k flagship): per-sample fwd+bwd is ~9%
     cheaper at batch 2 than batch 4, so the 2x2 chunked step beats the
@@ -49,13 +52,25 @@ def make_train_step(
     accumulation (optim.py), this changes no optimizer-visible step count.
     """
 
+    if microbatch > 1 and getattr(loss_fn, "uniform_weighting", None) is False:
+        raise ValueError(
+            "this loss declares uniform_weighting=False (per-call count "
+            "normalization — masked-LM style); microbatch > 1 would reweight "
+            "tokens and scale count metrics by 1/k — use microbatch=1"
+        )
+    uniform_declared = getattr(loss_fn, "uniform_weighting", None) is True
+
     def train_step(state: TrainState, batch):
         rng, step_rng = jax.random.split(state.rng)
         grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
         if microbatch <= 1:
             (_, metrics), grads = grad_fn(state.params, batch, step_rng)
         else:
-            if isinstance(batch, dict) and batch.get("pad_mask") is not None:
+            if (
+                not uniform_declared
+                and isinstance(batch, dict)
+                and batch.get("pad_mask") is not None
+            ):
                 raise ValueError(
                     "microbatch > 1 requires equal chunk weighting; padded "
                     "batches normalize per-chunk and would reweight tokens — "

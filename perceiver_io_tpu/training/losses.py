@@ -43,6 +43,9 @@ def classification_loss_fn(apply_fn, deterministic: bool = False) -> Callable:  
         acc = jnp.mean((jnp.argmax(logits, axis=-1) == y).astype(jnp.float32))
         return loss, {"loss": loss, "acc": acc}
 
+    # per-example mean CE/acc: equal-size chunks carry equal weight, so the
+    # microbatch mean-of-means equals the full-batch mean
+    loss_fn.uniform_weighting = True
     return loss_fn
 
 
@@ -62,6 +65,10 @@ def masked_lm_loss_fn(apply_fn, deterministic: bool = False) -> Callable:
         loss, num_masked = _cross_entropy(logits, batch["labels"])
         return loss, {"loss": loss, "num_masked": num_masked}
 
+    # normalizes by the per-call masked-token count and emits a count-valued
+    # metric — microbatch chunking would reweight tokens and scale the count
+    # by 1/k, so make_train_step rejects microbatch > 1 for this loss
+    loss_fn.uniform_weighting = False
     return loss_fn
 
 
@@ -117,4 +124,5 @@ def mse_loss_fn(apply_fn, deterministic: bool = False) -> Callable:
         loss = jnp.mean((pred - batch["y"]) ** 2)
         return loss, {"loss": loss}
 
+    loss_fn.uniform_weighting = True  # plain mean over elements
     return loss_fn

@@ -94,6 +94,17 @@ class Trainer:
             return metrics
 
         self._eval_step = jax.jit(eval_step)
+        # prefetch recovery across sequential fit() calls on the SAME
+        # iterator object (resume, curriculum phases): batches the producer
+        # pulled but fit() never consumed are re-injected next time instead
+        # of being silently dropped (ADVICE r3; data/loader.py close()).
+        # A deque drained lazily: whatever a later fit does not consume
+        # (no-op fit, prefetch disabled, early max_steps) simply stays put.
+        from collections import deque
+
+        self._residual_batches: "deque" = deque()
+        self._residual_src = None  # weakref to the iterator they came from
+        self._pending_prefetch = None  # a close()d prefetch whose producer was still alive
         self.checkpoints: Optional[CheckpointManager] = None
         if self.config.checkpoint_dir is not None:
             self.checkpoints = CheckpointManager(
@@ -156,6 +167,35 @@ class Trainer:
                 state = self.checkpoints.restore(state)
 
         train_iter = iter(train_iter)
+        src = train_iter
+        if self._pending_prefetch is not None:
+            # a previous fit's producer outlived its bounded close() join
+            # (source iterator blocked); collect whatever it has since
+            # produced before touching the source again
+            self._pending_prefetch.close()
+            if self._pending_prefetch.alive():
+                raise RuntimeError(
+                    "the previous fit's prefetch producer is still blocked "
+                    "inside the training iterator; a second fit on it would "
+                    "race the producer thread"
+                )
+            self._residual_batches.extend(self._pending_prefetch.residual)
+            self._pending_prefetch = None
+        same_src = self._residual_src is not None and self._residual_src() is src
+        if not same_src:
+            # stale residuals belong to a different (gone) iterator — drop
+            # them rather than mix them into this fit's recovery deque
+            self._residual_batches.clear()
+        residual_dq = self._residual_batches if same_src else None
+        if residual_dq:
+            import itertools
+
+            def _drain(dq=residual_dq):
+                while dq:
+                    yield dq.popleft()
+
+            # lazy drain: unconsumed items REMAIN in the deque for the next fit
+            train_iter = itertools.chain(_drain(), train_iter)
         prefetch = None
         start_step = int(state.step)
         if cfg.prefetch_batches > 0 and start_step < cfg.max_steps:
@@ -195,6 +235,19 @@ class Trainer:
         finally:
             if prefetch is not None:
                 prefetch.close()
+                # the prefetch pulled items ahead of the step loop — they
+                # logically precede anything still parked in the deque
+                self._residual_batches.extendleft(reversed(prefetch.residual))
+                if prefetch.alive():
+                    # producer stuck in the source iterator; hold the wrapper
+                    # so the next fit can harvest (and refuses to race it)
+                    self._pending_prefetch = prefetch
+                try:
+                    import weakref
+
+                    self._residual_src = weakref.ref(src)
+                except TypeError:  # not weakref-able (e.g. plain list_iterator)
+                    self._residual_src = None
             # commit any in-flight async save even when the loop raises
             # (callback/iterator error, KeyboardInterrupt) — otherwise a
             # hard exit abandons the last checkpoint

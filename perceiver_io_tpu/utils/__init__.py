@@ -6,7 +6,12 @@ from perceiver_io_tpu.utils.flops import (  # noqa: F401
     num_training_tokens,
     training_flops,
 )
-from perceiver_io_tpu.utils.laws import ScalingLaw, fit_power_law, fit_scaling_law  # noqa: F401
+from perceiver_io_tpu.utils.laws import (  # noqa: F401
+    ScalingLaw,
+    fit_power_law,
+    fit_scaling_exponents,
+    fit_scaling_law,
+)
 from perceiver_io_tpu.utils.profiling import StepTimer, trace  # noqa: F401
 
 __all__ = [
@@ -18,6 +23,7 @@ __all__ = [
     "training_flops",
     "ScalingLaw",
     "fit_power_law",
+    "fit_scaling_exponents",
     "fit_scaling_law",
     "StepTimer",
     "trace",

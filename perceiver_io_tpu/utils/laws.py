@@ -50,3 +50,20 @@ def fit_scaling_law(
     k_n = fit_power_law(flops_arr, params_arr, m=a)
     k_d = fit_power_law(flops_arr, tokens_arr, m=b)
     return ScalingLaw(a=a, b=b, k_n=k_n, k_d=k_d)
+
+
+def fit_scaling_exponents(
+    flops_arr: Sequence[float],
+    params_arr: Sequence[float],
+    tokens_arr: Sequence[float],
+) -> ScalingLaw:
+    """FREE-exponent fit: log-log linear regression for both laws
+    (``log N_opt = a log C + log k_n``) — the Chinchilla approach-1 exponent
+    extraction (arXiv:2203.15556 §3.1), used by the offline multi-model study
+    to check exponent stability across seeds. ``fit_scaling_law`` (fixed
+    exponents) remains the reference-parity fit
+    (reference: examples/scaling/clm/scaling/laws.py:7-36 fixes a/b)."""
+    lc = np.log(np.asarray(flops_arr, np.float64))
+    a, lkn = np.polyfit(lc, np.log(np.asarray(params_arr, np.float64)), 1)
+    b, lkd = np.polyfit(lc, np.log(np.asarray(tokens_arr, np.float64)), 1)
+    return ScalingLaw(a=float(a), b=float(b), k_n=float(np.exp(lkn)), k_d=float(np.exp(lkd)))

@@ -283,3 +283,37 @@ def test_make_optimizer_moment_dtype():
     assert float(jax.tree.leaves(u)[0].sum()) < 0
     with pytest.raises(ValueError, match="moment_dtype"):
         mk(1e-3, optimizer="sgd", moment_dtype="bfloat16")
+
+
+def test_microbatch_loss_weighting_declarations():
+    """masked-LM (count-normalized) is rejected at build time for
+    microbatch>1; classification (per-example mean) declares itself uniform
+    and is allowed even with a padded batch (ADVICE r3: explicit contract
+    instead of pad_mask key sniffing alone)."""
+    from perceiver_io_tpu.training import classification_loss_fn, masked_lm_loss_fn, mse_loss_fn
+
+    mlm = masked_lm_loss_fn(lambda *a, **k: None)
+    assert mlm.uniform_weighting is False
+    with pytest.raises(ValueError, match="uniform_weighting=False"):
+        make_train_step(mlm, microbatch=2)
+
+    clf_apply_calls = []
+
+    def clf_apply(params, x, **kwargs):
+        clf_apply_calls.append(kwargs.get("pad_mask") is not None)
+        return jnp.zeros((x.shape[0], 4))
+
+    clf = classification_loss_fn(clf_apply)
+    assert clf.uniform_weighting is True
+    step = make_train_step(clf, microbatch=2, donate=False)
+    params = {"w": jnp.zeros((2,))}
+    tx = make_optimizer(1e-2)
+    state = TrainState.create(None, params, tx, jax.random.PRNGKey(0))
+    batch = {
+        "x": jnp.zeros((4, 8)),
+        "label": jnp.zeros((4,), jnp.int32),
+        "pad_mask": jnp.zeros((4, 8), bool),  # padded batch: still allowed
+    }
+    _, metrics = step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+    assert mse_loss_fn(lambda *a, **k: jnp.zeros((2, 2))).uniform_weighting is True

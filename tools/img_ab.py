@@ -1,0 +1,131 @@
+"""Same-process interleaved A/B of image-classifier step variants (round 4:
+the VERDICT r3 image roofline treatment). The image step's exclusive profile
+(tools/profile_step.py --mode img) puts ~22.6 ms/step (12.7%) in XLA
+layernorm stat fusions — an order of magnitude more LN work than the CLM
+flagship (96 LN applications per forward over the 48-layer shared SA stack),
+where the fused Pallas LN lost by 1%.
+
+    python tools/img_ab.py [--batch-size 16] [--steps 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_probe_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--steps", type=int, default=8)
+    p.add_argument("--reps", type=int, default=4)
+    p.add_argument("--variants", nargs="*", default=["base", "fusedln"])
+    args = p.parse_args()
+
+    from perceiver_io_tpu.core.config import ClassificationDecoderConfig
+    from perceiver_io_tpu.models.vision.image_classifier import (
+        ImageClassifier,
+        ImageClassifierConfig,
+        ImageEncoderConfig,
+    )
+    from perceiver_io_tpu.ops.layernorm import fused_ln
+    from perceiver_io_tpu.training import TrainState, classification_loss_fn, make_optimizer
+    from perceiver_io_tpu.training.loop import make_train_step
+
+    config = ImageClassifierConfig(
+        encoder=ImageEncoderConfig(
+            image_shape=(224, 224, 3),
+            num_frequency_bands=64,
+            num_cross_attention_heads=1,
+            num_self_attention_heads=8,
+            num_self_attention_layers_per_block=6,
+            num_self_attention_blocks=8,
+            first_self_attention_block_shared=True,
+        ),
+        decoder=ClassificationDecoderConfig(
+            num_classes=1000, num_output_query_channels=1024, num_cross_attention_heads=1
+        ),
+        num_latents=512,
+        num_latent_channels=1024,
+    )
+    model = ImageClassifier(config, dtype=jnp.bfloat16)
+    b = args.batch_size
+    rng = np.random.default_rng(0)
+    batch = {
+        "image": jnp.asarray(rng.normal(size=(b, 224, 224, 3)), jnp.float32),
+        "label": jnp.asarray(rng.integers(0, 1000, size=(b,))),
+    }
+    params = model.init(jax.random.PRNGKey(0), batch["image"])
+
+    def build(variant):
+        tx = make_optimizer(1e-3, gradient_clip=1.0)
+        state = TrainState.create(model.apply, params, tx, jax.random.PRNGKey(1))
+        step = make_train_step(classification_loss_fn(model.apply), jit=False)
+
+        @functools.partial(jax.jit, static_argnums=2)
+        def run(state, batch, k):
+            def body(c, _):
+                l, s = c
+                s, metrics = step(s, batch)
+                return (l + metrics["loss"], s), ()
+
+            (l, _), _ = jax.lax.scan(body, (jnp.float32(0), state), None, length=k)
+            return l
+
+        def call(k):
+            with fused_ln(True if variant == "fusedln" else None):
+                return float(run(state, batch, k))
+
+        return call
+
+    n_short, n_long = 1, 1 + args.steps
+    runs = {}
+    for name in args.variants:
+        runs[name] = build(name)
+        t0 = time.perf_counter()
+        runs[name](n_short)
+        runs[name](n_long)
+        print(f"{name}: compiled in {time.perf_counter() - t0:.0f}s", flush=True)
+
+    times = {}
+    slopes = {v: [] for v in args.variants}
+    for est in range(3):
+        for v in args.variants:
+            times[v] = {"s": float("inf"), "l": float("inf")}
+        for _ in range(args.reps):
+            for v in args.variants:
+                t0 = time.perf_counter()
+                runs[v](n_short)
+                times[v]["s"] = min(times[v]["s"], time.perf_counter() - t0)
+                t0 = time.perf_counter()
+                runs[v](n_long)
+                times[v]["l"] = min(times[v]["l"], time.perf_counter() - t0)
+        for v in args.variants:
+            s = (times[v]["l"] - times[v]["s"]) / (n_long - n_short)
+            if s > 0:
+                slopes[v].append(s)
+
+    print(f"{'variant':<16} {'ms/step':>8} {'img/s':>8}")
+    for v in args.variants:
+        ss = sorted(slopes[v])
+        if not ss:
+            print(f"{v:<16}  all slope estimates non-positive (tunnel stall?) — rerun")
+            continue
+        med = (ss[(len(ss) - 1) // 2] + ss[len(ss) // 2]) / 2
+        print(f"{v:<16} {med * 1e3:8.2f} {b / med:8.1f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -49,6 +49,58 @@ def decode_profile(args):
     jax.profiler.stop_trace()
 
 
+def image_profile(args):
+    """Trace the image-classifier train step (the BENCH_extra image workload,
+    bench.image_bench config) — the round-4 roofline treatment. Matches the
+    bench exactly: microbatch is always 1 on the image workload (the
+    --microbatch flag applies to the CLM train mode only)."""
+    from perceiver_io_tpu.models.vision.image_classifier import (
+        ImageClassifier,
+        ImageClassifierConfig,
+        ImageEncoderConfig,
+    )
+    from perceiver_io_tpu.core.config import ClassificationDecoderConfig
+    from perceiver_io_tpu.training import TrainState, classification_loss_fn, make_optimizer
+    from perceiver_io_tpu.training.loop import make_train_step
+
+    config = ImageClassifierConfig(
+        encoder=ImageEncoderConfig(
+            image_shape=(224, 224, 3),
+            num_frequency_bands=64,
+            num_cross_attention_heads=1,
+            num_self_attention_heads=8,
+            num_self_attention_layers_per_block=6,
+            num_self_attention_blocks=8,
+            first_self_attention_block_shared=True,
+        ),
+        decoder=ClassificationDecoderConfig(
+            num_classes=1000, num_output_query_channels=1024, num_cross_attention_heads=1
+        ),
+        num_latents=512,
+        num_latent_channels=1024,
+    )
+    model = ImageClassifier(config, dtype=jnp.bfloat16)
+    b = args.batch_size
+    rng = np.random.default_rng(0)
+    batch = {
+        "image": jnp.asarray(rng.normal(size=(b, 224, 224, 3)), jnp.float32),
+        "label": jnp.asarray(rng.integers(0, 1000, size=(b,))),
+    }
+    params = model.init(jax.random.PRNGKey(0), batch["image"])
+    tx = make_optimizer(1e-3, gradient_clip=1.0)
+    state = TrainState.create(model.apply, params, tx, jax.random.PRNGKey(1))
+    step = make_train_step(classification_loss_fn(model.apply))
+
+    for _ in range(2):
+        state, metrics = step(state, batch)
+        float(metrics["loss"])
+    jax.profiler.start_trace(args.out)
+    for _ in range(args.steps):
+        state, metrics = step(state, batch)
+        float(metrics["loss"])
+    jax.profiler.stop_trace()
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--seq-len", type=int, default=16384)
@@ -57,7 +109,7 @@ def main():
     p.add_argument("--steps", type=int, default=5)
     p.add_argument("--top", type=int, default=40)
     p.add_argument("--out", default="/tmp/prof_step")
-    p.add_argument("--mode", choices=["train", "decode"], default="train")
+    p.add_argument("--mode", choices=["train", "decode", "img"], default="train")
     # match the bench.py round-4 defaults so the profile reflects the step
     # the driver actually measures
     p.add_argument("--microbatch", type=int, default=2)
@@ -67,6 +119,9 @@ def main():
 
     if args.mode == "decode":
         decode_profile(args)
+        return _summarize(args)
+    if args.mode == "img":
+        image_profile(args)
         return _summarize(args)
 
     from perceiver_io_tpu.models.text import CausalLanguageModel
