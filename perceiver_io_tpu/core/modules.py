@@ -39,6 +39,16 @@ from perceiver_io_tpu.core.position import positions
 
 LAYER_NORM_EPSILON = 1e-5  # match torch nn.LayerNorm default
 
+# channel-pad rounding shared by the fused split-kv input route: the gate in
+# PerceiverEncoder.__call__ must predict exactly the padded head dims
+# split_kv_projection emits and call_with_split_kv hands to flash_attention
+SPLIT_KV_PAD = 8
+
+
+def split_padded(n: int) -> int:
+    """Channel width after the fused split-kv route's zero-padding."""
+    return n + (-n) % SPLIT_KV_PAD
+
 
 def _remat(layer_cls, static_argnums, checkpoint: bool, offload: bool):
     """Activation-checkpointing wrapper for an attention layer class; returns
@@ -141,6 +151,81 @@ class CrossAttention(nn.Module):
             kv_cache=kv_cache,
             deterministic=deterministic,
         )
+
+    def split_kv_projection(self, x_pix, enc):
+        """K/V of ``kv_norm(concat([x_pix, enc], -1))`` WITHOUT materializing
+        the concatenated input or its LayerNorm output.
+
+        ``x_pix`` (B, M, P) is the per-example part (pixels); ``enc`` (M, F)
+        is a per-position CONSTANT (the image Fourier features). The vision
+        encoder's profile (b=16, v5e) spends ~14 ms/step building two
+        (B, 50176, 261) concat+cast copies, LayerNorm-ing them, and padding
+        the projections — all of it linear-algebraically redundant:
+
+        with z = gamma * (x - mu) * r + beta (the LN row) and a projection
+        W/b, ``z @ W + b = r*(x @ Wg) - (mu*r)*colsum(Wg) + (beta @ W + b)``
+        where ``Wg = diag(gamma) @ W``; and since x = [pix | enc],
+        ``x @ Wg = pix @ Wg[:P] + enc @ Wg[P:]`` with the second term shared
+        across the batch. The per-position LN stats (mu, r) come from pixel
+        sums plus precomputed constants of ``enc``. Everything the kernels
+        consume is emitted directly, channel-padded to a multiple of
+        ``SPLIT_KV_PAD`` with EXACT zeros via weight-side padding (no (B, M, C)
+        pad op). Numerics: stats in f32 like the LN; the GEMMs run in the
+        module dtype on raw (un-normalized) inputs — same accumulation
+        magnitudes, equivalence pinned by tests/test_fused_image_input.py.
+
+        Returns ``(k, v, k_pad, v_pad)`` with k/v (B, M, ch+pad).
+        """
+        mha = self.attention
+        if self.is_initializing():
+            # the standard path's parameter shapes, created eagerly so both
+            # paths share one checkpoint layout
+            z = jnp.zeros((1, 1, self.num_kv_input_channels), self.dtype)
+            self.kv_norm(z)
+            mha.k_proj(z)
+            mha.v_proj(z)
+        n_pix = x_pix.shape[-1]
+        c = self.num_kv_input_channels
+        ln = self.kv_norm.variables["params"]
+        gamma = ln["scale"].astype(jnp.float32)
+        beta = ln["bias"].astype(jnp.float32)
+
+        enc = lax.stop_gradient(enc)
+        enc32 = enc.astype(jnp.float32)
+        s1_enc = enc32.sum(-1)
+        s2_enc = (enc32 * enc32).sum(-1)
+        pix32 = x_pix.astype(jnp.float32)
+        s1 = pix32.sum(-1) + s1_enc[None]  # (B, M)
+        s2 = (pix32 * pix32).sum(-1) + s2_enc[None]
+        mean = s1 / c
+        var = jnp.maximum(s2 / c - mean * mean, 0.0)
+        r = lax.rsqrt(var + LAYER_NORM_EPSILON)
+        dt = self.dtype
+        r_dt = r.astype(dt)[..., None]
+        mr_dt = (mean * r).astype(dt)[..., None]
+
+        def project(dense, out_ch):
+            p = dense.variables["params"]
+            w = p["kernel"].astype(jnp.float32)  # (C, out_ch)
+            b = p["bias"].astype(jnp.float32) if "bias" in p else jnp.zeros((out_ch,), jnp.float32)
+            pad = split_padded(out_ch) - out_ch
+            wg = w * gamma[:, None]
+            if pad:
+                wg = jnp.pad(wg, ((0, 0), (0, pad)))
+                w_p = jnp.pad(w, ((0, 0), (0, pad)))
+                b_p = jnp.pad(b, (0, pad))
+            else:
+                w_p, b_p = w, b
+            colsum = wg.sum(0).astype(dt)  # (out+pad,)
+            const = (beta @ w_p + b_p).astype(dt)
+            enc_term = enc.astype(dt) @ wg[n_pix:].astype(dt)  # (M, out+pad)
+            pix_term = x_pix.astype(dt) @ wg[:n_pix].astype(dt)  # (B, M, out+pad)
+            xw = pix_term + enc_term[None]
+            return xw * r_dt - mr_dt * colsum + const, pad
+
+        k, k_pad = project(mha.k_proj, mha.qk_channels)
+        v, v_pad = project(mha.v_proj, mha.v_channels)
+        return k, v, k_pad, v_pad
 
 
 class SelfAttention(nn.Module):
@@ -298,6 +383,32 @@ class CrossAttentionLayer(nn.Module):
             h = attn.last_hidden_state
         h = h + self.res_dropout(self.mlp(h), deterministic=deterministic)
         return AttentionOutput(last_hidden_state=h, kv_cache=attn.kv_cache)
+
+    def call_with_split_kv(self, x_q, x_pix, enc, deterministic: bool = True) -> AttentionOutput:
+        """The full layer (attention + residual + MLP) with k/v built by
+        :meth:`CrossAttention.split_kv_projection` — the vision encoder's
+        fused-input route (pad_mask-free, single-head, no attention-prob
+        dropout; `PerceiverEncoder` gates these). Numerically the standard
+        ``__call__`` on ``concat([x_pix, broadcast(enc)], -1)``."""
+        from perceiver_io_tpu.ops.flash_attention import flash_attention
+
+        ca = self.cross_attn
+        mha = ca.attention
+        q_in = ca.q_norm(x_q)
+        k, v, k_pad, v_pad = ca.split_kv_projection(x_pix, enc)
+        q = mha.project_q(q_in)  # (B, 1, N, dk) scaled; single head
+        if k_pad:
+            q = jnp.pad(q, ((0, 0), (0, 0), (0, 0), (0, k_pad)))
+        o = flash_attention(q, k[:, None], v[:, None], causal=False)
+        if v_pad:
+            o = o[..., : mha.v_channels]
+        h_attn = mha.merge_output(o.astype(x_q.dtype))
+        if self.attention_residual:
+            h = x_q + self.res_dropout(h_attn, deterministic=deterministic)
+        else:
+            h = h_attn
+        h = h + self.res_dropout(self.mlp(h), deterministic=deterministic)
+        return AttentionOutput(last_hidden_state=h, kv_cache=None)
 
 
 class SelfAttentionLayer(nn.Module):
@@ -543,17 +654,56 @@ class PerceiverEncoder(nn.Module):
         if self.extra_self_attention_block:
             self.self_attn_n = self_attn("self_attn_n")
 
+    def _use_split_input(self, pad_mask, deterministic) -> bool:
+        """Route the cross-attentions through the fused split-kv path (the
+        adapter's constant positional features folded into the projections —
+        CrossAttention.split_kv_projection) when the configuration allows:
+        no pad mask, single-head CA (the channel pad trick is per-head), no
+        active attention-prob dropout, remat AND offload off (the nn.remat
+        class transform wraps ``__call__`` only). Shape support for the flash
+        kernels is checked at the call site where the input is known."""
+        if not getattr(self.input_adapter, "supports_split", False):
+            return False
+        if pad_mask is not None or self.num_cross_attention_heads != 1:
+            return False
+        if self.dropout > 0.0 and not deterministic:
+            return False
+        return not (self.activation_checkpointing or self.activation_offloading)
+
     def __call__(self, x, pad_mask=None, return_adapted_input: bool = False, deterministic: bool = True):
+        from perceiver_io_tpu.ops.flash_attention import flash_enabled, flash_supported
+
         b = x.shape[0]
 
-        x_adapted = self.input_adapter(x)
         x_latent = self.latent_provider()
         x_latent = jnp.broadcast_to(x_latent, (b,) + x_latent.shape[1:])
 
-        def call_ca(layer, x_latent):
-            return layer(
-                x_latent, x_adapted, None, pad_mask, None, None, None, deterministic
-            ).last_hidden_state
+        # return_adapted_input forfeits the route's saving (the concat would be
+        # materialized anyway for the return value) — take the standard path
+        use_split = not return_adapted_input and self._use_split_input(pad_mask, deterministic)
+        if use_split:
+            x_pix, enc = self.input_adapter.split(x)
+            qk = self.cross_attn_1.cross_attn.attention.qk_channels
+            v = self.cross_attn_1.cross_attn.attention.v_channels
+            use_split = flash_enabled() and flash_supported(
+                self.num_latents, x_pix.shape[1], split_padded(qk), split_padded(v), False
+            )
+
+        if use_split:
+            x_adapted = None
+
+            def call_ca(layer, x_latent):
+                return layer.call_with_split_kv(
+                    x_latent, x_pix, enc, deterministic
+                ).last_hidden_state
+
+        else:
+            x_adapted = self.input_adapter(x)
+
+            def call_ca(layer, x_latent):
+                return layer(
+                    x_latent, x_adapted, None, pad_mask, None, None, None, deterministic
+                ).last_hidden_state
 
         x_latent = call_ca(self.cross_attn_1, x_latent)
         x_latent = self.self_attn_1(x_latent, deterministic=deterministic).last_hidden_state

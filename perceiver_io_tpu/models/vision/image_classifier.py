@@ -44,16 +44,28 @@ class ImageInputAdapter(nn.Module):
     def num_input_channels(self) -> int:
         return self.image_shape[-1] + self.position_encoding.num_position_encoding_channels()
 
+    # the Fourier features are per-position CONSTANTS: the encoder's fused
+    # input route (PerceiverEncoder + CrossAttention.split_kv_projection)
+    # consumes them unconcatenated and never materializes the (B, M, C) input
+    supports_split: bool = True
+
     @nn.compact
     def __call__(self, x):
+        x_pix, enc = self.split(x)
+        x_enc = jnp.broadcast_to(enc[None].astype(x.dtype), x_pix.shape[:2] + (enc.shape[-1],))
+        return jnp.concatenate([x_pix, x_enc], axis=-1)
+
+    def split(self, x):
+        """``(x_pix (B, M, P), enc (M, F))`` — the adapter output without the
+        batch-broadcast concat; ``__call__`` == concat of the broadcast."""
         b, *d = x.shape
         if tuple(d) != tuple(self.image_shape):
             raise ValueError(
                 f"Input vision shape {tuple(d)} different from required shape {self.image_shape}"
             )
-        x = x.reshape(b, -1, self.image_shape[-1])
-        x_enc = self.position_encoding(b).astype(x.dtype)
-        return jnp.concatenate([x, x_enc], axis=-1)
+        x_pix = x.reshape(b, -1, self.image_shape[-1])
+        enc = self.position_encoding(1)[0].astype(x.dtype)
+        return x_pix, enc
 
 
 class ImageClassifier(nn.Module):

@@ -1,0 +1,71 @@
+"""Pins on the committed offline convergence artifacts (VERDICT r3 item 3).
+
+These tests validate the **committed evidence**, not a live run: the flagship
+convergence driver (tools/flagship_convergence.py) trains the reference
+CLM-small geometry on a deterministic Markov corpus whose entropy rate is
+computable, and the MNIST-class classifier on synthetic digits; the curves
+and summary land in docs/results/. The pins here fail if a regression ships
+worse converged quality (or the artifacts go missing).
+"""
+
+import csv
+import json
+import os
+
+import numpy as np
+import pytest
+
+RESULTS = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "docs", "results")
+SUMMARY = os.path.join(RESULTS, "flagship_convergence.json")
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(SUMMARY), reason="flagship convergence artifacts not generated yet"
+)
+
+
+def _summary():
+    return json.load(open(SUMMARY))
+
+
+def test_clm_flagship_converged_near_entropy_floor():
+    """The 30.7M CLM must close most of the unigram->floor gap on the
+    analytic-entropy corpus — the offline stand-in for the reference's
+    published val_loss 0.876 on WikiText (training-examples.md:160-161)."""
+    s = _summary()["clm"]
+    assert s["final_val_loss"] < 1.0, s
+    # the corpus's analytic bounds sandwich the result
+    assert s["entropy_floor"] < s["final_val_loss"] < s["unigram_baseline"], s
+    assert s["gap_closed"] > 0.8, s
+
+
+def test_clm_flagship_curve_is_monotone_converged():
+    path = os.path.join(RESULTS, "clm_flagship.csv")
+    vals = [float(r["val_loss"]) for r in csv.DictReader(open(path)) if r.get("val_loss")]
+    assert len(vals) >= 5
+    assert vals[-1] == min(vals[-3:])  # still at (or tied with) its best at the end
+    assert vals[-1] < vals[0] * 0.6  # real descent, not noise
+    # plateau: the last quarter moves by < 5% — "to convergence"
+    q = max(1, len(vals) // 4)
+    assert abs(vals[-1] - vals[-q]) / vals[-q] < 0.05
+
+
+def test_img_flagship_accuracy():
+    """MNIST-class classifier on synthetic digits — offline stand-in for the
+    reference's published MNIST val_acc 0.9816 (training-examples.md:143-150)."""
+    s = _summary()["img"]
+    assert s["final_val_acc"] > 0.95, s
+
+
+def test_corpus_entropy_math_self_consistent():
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(RESULTS), "..", "tools"))
+    from flagship_convergence import corpus_entropy_rate
+
+    ent = corpus_entropy_rate(vocab=128, fanout=8, seed=7)
+    # fanout-8 uniform draws with zipf duplicates: per-word entropy must be
+    # positive and below log(8); bytes/word between min and max word length+1
+    h_w = ent["nats_per_byte_floor"] * ent["bytes_per_word"]
+    assert 0.0 < h_w <= np.log(8) + 1e-9
+    assert ent["nats_per_byte_floor"] < ent["nats_per_byte_unigram"]
+    assert 3.0 <= ent["bytes_per_word"] <= 6.0

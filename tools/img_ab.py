@@ -31,7 +31,9 @@ def main():
     p.add_argument("--batch-size", type=int, default=16)
     p.add_argument("--steps", type=int, default=8)
     p.add_argument("--reps", type=int, default=4)
-    p.add_argument("--variants", nargs="*", default=["base", "fusedln"])
+    # base = concat input route (round-3); split = fused split-kv input
+    # (round-4 default); fusedln = base + Pallas LN (measured SLOWER)
+    p.add_argument("--variants", nargs="*", default=["base", "split"])
     args = p.parse_args()
 
     from perceiver_io_tpu.core.config import ClassificationDecoderConfig
@@ -69,6 +71,8 @@ def main():
     }
     params = model.init(jax.random.PRNGKey(0), batch["image"])
 
+    from perceiver_io_tpu.core.modules import PerceiverEncoder
+
     def build(variant):
         tx = make_optimizer(1e-3, gradient_clip=1.0)
         state = TrainState.create(model.apply, params, tx, jax.random.PRNGKey(1))
@@ -85,8 +89,16 @@ def main():
             return l
 
         def call(k):
-            with fused_ln(True if variant == "fusedln" else None):
-                return float(run(state, batch, k))
+            # trace-time routing: 'base'/'fusedln' force the concat input
+            # route by disabling the split gate; 'split' leaves the default
+            orig = PerceiverEncoder._use_split_input
+            if variant != "split":
+                PerceiverEncoder._use_split_input = lambda self, pm, det: False
+            try:
+                with fused_ln(True if variant == "fusedln" else None):
+                    return float(run(state, batch, k))
+            finally:
+                PerceiverEncoder._use_split_input = orig
 
         return call
 

@@ -65,9 +65,14 @@ def make_corpus(path: str, n_words: int = 2_000_000, vocab: int = 2048, seed: in
 def run_one(channels: int, sa_layers: int, seed: int, steps: int, corpus: str,
             out_csv: str, platform: str) -> None:
     root = tempfile.mkdtemp(prefix=f"scaling_{channels}ch_s{seed}_")
-    code = (
+    # platform "default" leaves backend selection to JAX (i.e. the real
+    # accelerator when one is attached); a named platform pins it
+    select = "" if platform in ("", "default") else (
         f"import jax; jax.config.update('jax_platforms', '{platform}')\n"
-        f"import sys; sys.path.insert(0, {REPO!r})\n"
+    )
+    code = (
+        select
+        + f"import sys; sys.path.insert(0, {REPO!r})\n"
         "from perceiver_io_tpu.scripts.text.clm import main\n"
         f"main({_argv(channels, sa_layers, seed, steps, corpus, root)!r})\n"
     )
