@@ -56,6 +56,13 @@ def test_img_flagship_accuracy():
     assert s["final_val_acc"] > 0.95, s
 
 
+def test_img_flagship_curve_learns():
+    path = os.path.join(RESULTS, "img_clf_flagship.csv")
+    vals = [float(r["val_acc"]) for r in csv.DictReader(open(path)) if r.get("val_acc")]
+    assert len(vals) >= 3
+    assert vals[0] < 0.6 < 0.95 < vals[-1]  # chance-ish start, converged end
+
+
 def test_corpus_entropy_math_self_consistent():
     import sys
 
