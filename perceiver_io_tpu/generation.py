@@ -53,9 +53,8 @@ def _shift_left_if_full(cache: KVCache) -> KVCache:
     of the reference's ``[:, -max_len+1:]`` truncation)."""
 
     def shift(c):
-        return KVCache(
-            k=jnp.roll(c.k, -1, axis=1), v=jnp.roll(c.v, -1, axis=1), length=c.length - 1
-        )
+        # map_slots keeps the int8 scale planes aligned with their slots
+        return c.map_slots(lambda a: jnp.roll(a, -1, axis=1), length=c.length - 1)
 
     full = cache.length >= cache.capacity
     return lax.cond(full, shift, lambda c: c, cache)
@@ -176,9 +175,7 @@ def beam_search(
     def tile(x):
         return jnp.repeat(x, num_beams, axis=0)
 
-    cache = tuple(
-        KVCache(k=tile(c.k), v=tile(c.v), length=c.length) for c in out.kv_cache
-    )
+    cache = tuple(c.map_slots(tile) for c in out.kv_cache)
 
     # left-pad handling for decode steps: padded prompt slots stay masked in
     # the CA window forever (slot-aligned mask over the cache capacity), and
@@ -235,12 +232,7 @@ def beam_search(
 
         gather_rows = (batch_base.reshape(b, num_beams) + beam_idx).reshape(bb)
         new_cache = tuple(
-            KVCache(
-                k=jnp.take(c.k, gather_rows, axis=0),
-                v=jnp.take(c.v, gather_rows, axis=0),
-                length=c.length,
-            )
-            for c in out.kv_cache
+            c.map_slots(lambda a: jnp.take(a, gather_rows, axis=0)) for c in out.kv_cache
         )
         seqs = jnp.take(seqs, gather_rows, axis=0).at[:, t].set(new_token)
         done = jnp.take(done, gather_rows, axis=0)

@@ -1,0 +1,128 @@
+"""int8 KV-cache contract: quantized storage must track the exact cache
+closely (per-token symmetric scales), survive every slot transformation
+generation performs, and run end-to-end through generate/beam search.
+
+Capability beyond the reference (its torch cache is full-precision,
+huggingface.py:158-185): decode is bandwidth-bound, so int8 halves the
+dominant traffic — measured 1.69x on the decode attention core
+(tools/int8_cache_probe.py) and benchable via
+``bench.py --mode decode --cache-dtype int8``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from perceiver_io_tpu.core.attention import init_kv_cache, quantize_kv
+from perceiver_io_tpu.core.config import CausalSequenceModelConfig
+from perceiver_io_tpu.core.modules import CausalSequenceModel
+
+NUM_PREFIX = 8
+NUM_LATENTS = 16
+NUM_CHANNELS = 128
+NUM_LAYERS = 2
+BATCH_SIZE = 2
+
+
+def test_quantize_roundtrip_bound():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(3, 17, 64)) * rng.lognormal(size=(3, 17, 1)), jnp.float32)
+    q, s = quantize_kv(x)
+    assert q.dtype == jnp.int8 and s.dtype == jnp.bfloat16
+    deq = q.astype(jnp.float32) * s.astype(jnp.float32)[..., None]
+    # rounding error is at most half a quantization step (+ bf16 scale slack)
+    bound = np.broadcast_to(0.51 * np.asarray(s, np.float32)[..., None] + 1e-6, x.shape)
+    np.testing.assert_array_less(np.abs(np.asarray(deq - x)), bound)
+
+
+def test_map_slots_preserves_scales():
+    cache = init_kv_cache(2, 8, 16, 16, jnp.int8)
+    assert cache.quantized
+    rolled = cache.map_slots(lambda a: jnp.roll(a, -1, axis=1))
+    assert rolled.k_scale is not None and rolled.v_scale is not None
+    assert rolled.k.dtype == jnp.int8
+    plain = init_kv_cache(2, 8, 16, 16)
+    assert not plain.quantized
+    assert plain.map_slots(lambda a: a).k_scale is None
+
+
+@pytest.fixture(scope="module")
+def csm():
+    config = CausalSequenceModelConfig(
+        vocab_size=100,
+        max_seq_len=NUM_LATENTS + NUM_PREFIX,
+        max_latents=NUM_LATENTS,
+        num_channels=NUM_CHANNELS,
+        num_self_attention_layers=NUM_LAYERS,
+        num_self_attention_rotary_layers=-1,
+        output_norm=True,
+    )
+    model = CausalSequenceModel(config)
+    x = jnp.zeros((BATCH_SIZE, NUM_PREFIX + NUM_LATENTS), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), x, prefix_len=NUM_PREFIX)
+    return model, params, config
+
+
+def test_csm_int8_cache_tracks_exact(csm):
+    """Incremental decode on an int8 cache stays close to the exact uncached
+    forward — the test_kv_cache.py contract with quantization tolerance."""
+    model, params, config = csm
+    total = NUM_PREFIX + NUM_LATENTS
+    x = jnp.asarray(
+        np.random.default_rng(2).integers(0, config.vocab_size, size=(BATCH_SIZE, total))
+    )
+
+    exact = model.apply(params, x, prefix_len=NUM_PREFIX).logits
+
+    cache = CausalSequenceModel.init_cache(config, BATCH_SIZE, dtype=jnp.int8)
+    assert cache[0].quantized
+    out = model.apply(
+        params, x[:, : NUM_PREFIX + 2], prefix_len=NUM_PREFIX, kv_cache=cache
+    )
+    logits = [out.logits]
+    cache = out.kv_cache
+    for i in range(2, NUM_LATENTS):
+        out = model.apply(
+            params,
+            x[:, NUM_PREFIX + i : NUM_PREFIX + i + 1],
+            prefix_len=NUM_PREFIX,
+            kv_cache=cache,
+            decode=True,
+        )
+        logits.append(out.logits)
+        cache = out.kv_cache
+    logits = jnp.concatenate(logits, axis=1)
+
+    err = np.abs(np.asarray(logits) - np.asarray(exact))
+    # int8 per-token quantization on a random-init f32 model: observed max
+    # ~1e-2; the bound leaves ~3x headroom while still catching any scale
+    # misalignment (which produces O(1) garbage)
+    assert err.max() < 0.05, err.max()
+    # the decode-relevant quantity — the top-1 ordering — must agree
+    agree = (np.argmax(logits, -1) == np.argmax(np.asarray(exact), -1)).mean()
+    assert agree > 0.9, agree
+
+
+def test_generate_and_beam_run_with_int8_cache(csm):
+    """End-to-end: greedy generate and beam search (slot roll + beam-gather
+    reorder paths) execute with quantized caches and emit valid ids."""
+    from perceiver_io_tpu.generation import GenerationConfig, beam_search, make_generate_fn
+
+    model, params, config = csm
+    prompt = jnp.asarray(
+        np.random.default_rng(3).integers(0, config.vocab_size, size=(BATCH_SIZE, NUM_PREFIX + 2))
+    )
+    fn = make_generate_fn(
+        model, NUM_LATENTS, GenerationConfig(max_new_tokens=NUM_LATENTS + 2),
+        cache_dtype=jnp.int8,
+    )
+    out = fn(params, prompt)
+    assert out.shape == (BATCH_SIZE, prompt.shape[1] + NUM_LATENTS + 2)
+    assert ((np.asarray(out) >= 0) & (np.asarray(out) < config.vocab_size)).all()
+
+    seqs, _scores = beam_search(
+        model, params, prompt, num_latents=NUM_LATENTS, num_beams=2, max_new_tokens=3,
+        cache_dtype=jnp.int8,
+    )
+    assert ((np.asarray(seqs) >= 0) & (np.asarray(seqs) < config.vocab_size)).all()
