@@ -39,7 +39,7 @@ import numpy as np
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from scaling_runs import make_corpus  # noqa: E402
+from scaling_runs import corpus_valid, make_corpus  # noqa: E402
 
 
 def corpus_entropy_rate(vocab: int = 2048, fanout: int = 8, seed: int = 7) -> dict:
@@ -83,20 +83,9 @@ def corpus_entropy_rate(vocab: int = 2048, fanout: int = 8, seed: int = 7) -> di
 
 def run_clm(out_dir: str, steps: int, seed: int) -> dict:
     corpus = os.path.join(tempfile.gettempdir(), "flagship_corpus_markov1.txt")
-    # 8M words of the seed-7 chain serialize to ~32.5 MB; reuse only a file
-    # that is both complete (size) and verifiably OUR chain (the stream's
-    # deterministic first words) — /tmp is world-shared and a foreign or
-    # truncated file would silently detach the run from the analytic floor
-    def _valid(path):
-        try:
-            if os.path.getsize(path) < 30e6:
-                return False
-            with open(path) as f:
-                return f.read(16).startswith("w725 w3 w1037 ")
-        except OSError:
-            return False
-
-    if not _valid(corpus):
+    # 8M words of the seed-7 chain serialize to ~32.5 MB (guard rationale:
+    # scaling_runs.corpus_valid)
+    if not corpus_valid(corpus):
         print("generating 8M-word corpus ...", flush=True)
         make_corpus(corpus, n_words=8_000_000)
     root = tempfile.mkdtemp(prefix="flagship_clm_")

@@ -62,6 +62,21 @@ def make_corpus(path: str, n_words: int = 2_000_000, vocab: int = 2048, seed: in
         f.write(text)
 
 
+def corpus_valid(path: str, min_bytes: float = 30e6) -> bool:
+    """True iff ``path`` is a complete seed-7 ``make_corpus`` stream: size
+    plus the chain's deterministic first words. /tmp is world-shared — a
+    foreign or truncated file would silently detach a run from the corpus's
+    analytic entropy floor. Shared by flagship_convergence and the int8
+    trained probe so the guard and the generator stay in one file."""
+    try:
+        if os.path.getsize(path) < min_bytes:
+            return False
+        with open(path) as f:
+            return f.read(16).startswith("w725 w3 w1037 ")
+    except OSError:
+        return False
+
+
 def run_one(channels: int, sa_layers: int, seed: int, steps: int, corpus: str,
             out_csv: str, platform: str) -> None:
     root = tempfile.mkdtemp(prefix=f"scaling_{channels}ch_s{seed}_")
