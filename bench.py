@@ -119,6 +119,41 @@ def robust_slope(run, n_short: int, n_long: int, estimates: int = 3, reps: int =
     return (slopes[(n - 1) // 2] + slopes[n // 2]) / 2
 
 
+def interleaved_slopes(runs, n_short: int, n_long: int, estimates: int = 3, reps: int = 4):
+    """Multi-variant ``robust_slope``: per-iteration time for EACH named run
+    in ``runs`` ({name: fn(chain_len)}), with the variants visited
+    round-robin inside every rep so chip clock drift hits all of them
+    equally (cross-process A/B comparisons drift 1.5-1.8x with the clock
+    state — docs/performance.md). Same hardening as ``robust_slope``:
+    min-reduced reps, median of ``estimates`` independent slopes,
+    non-positive estimates dropped. Assumes every run was already called
+    once at both chain lengths (compiled — trace-time feature flags must be
+    active at COMPILE time, so the tools own their compile loops). Returns
+    {name: median_seconds_per_iteration or None if all estimates were
+    non-positive (tunnel stall — rerun)}. Shared by the tools/*_ab.py
+    same-process harnesses."""
+    slopes = {v: [] for v in runs}
+    for _ in range(estimates):
+        best = {v: [float("inf"), float("inf")] for v in runs}
+        for _ in range(reps):
+            for v, run in runs.items():
+                t0 = time.perf_counter()
+                run(n_short)
+                best[v][0] = min(best[v][0], time.perf_counter() - t0)
+                t0 = time.perf_counter()
+                run(n_long)
+                best[v][1] = min(best[v][1], time.perf_counter() - t0)
+        for v in runs:
+            s = (best[v][1] - best[v][0]) / (n_long - n_short)
+            if s > 0:
+                slopes[v].append(s)
+    out = {}
+    for v, ss in slopes.items():
+        ss = sorted(ss)
+        out[v] = None if not ss else (ss[(len(ss) - 1) // 2] + ss[len(ss) // 2]) / 2
+    return out
+
+
 def flagship_config(seq_len: int, latents: int, remat: bool = False):
     from perceiver_io_tpu.models.text import CausalLanguageModelConfig
 

@@ -43,7 +43,7 @@ def main():
     p.add_argument("--reps", type=int, default=4)
     args = p.parse_args()
 
-    from bench import flagship_config
+    from bench import flagship_config, interleaved_slopes
     from perceiver_io_tpu.models.text import CausalLanguageModel
     from perceiver_io_tpu.training import TrainState, clm_loss_fn, make_optimizer
     from perceiver_io_tpu.training.loop import make_train_step
@@ -84,13 +84,10 @@ def main():
 
         def call(k):
             state, m = box["state"], None
-            t0 = time.perf_counter()
             for _ in range(k):
                 state, m = step(state, batch)
             _ = float(m["loss"])  # force through the tunnel
-            dt = time.perf_counter() - t0
             box["state"] = state
-            return dt
 
         return call
 
@@ -102,26 +99,14 @@ def main():
         call(n_long)
         print(f"{name}: compiled in {time.perf_counter() - t0:.0f}s", flush=True)
 
-    slopes = {v: [] for v in variants}
-    for _ in range(3):
-        best = {v: {"s": float("inf"), "l": float("inf")} for v in variants}
-        for _ in range(args.reps):
-            for v, call in variants.items():
-                best[v]["s"] = min(best[v]["s"], call(n_short))
-                best[v]["l"] = min(best[v]["l"], call(n_long))
-        for v in variants:
-            s = (best[v]["l"] - best[v]["s"]) / (n_long - n_short)
-            if s > 0:
-                slopes[v].append(s)
-
+    meds = interleaved_slopes(variants, n_short, n_long, reps=args.reps)
     tok = b * args.seq_len
     print(f"{'variant':<10} {'ms/step':>8} {'tok/s':>12}")
     for v in variants:
-        ss = sorted(slopes[v])
-        if not ss:
+        med = meds[v]
+        if med is None:
             print(f"{v:<10}  slope estimates non-positive — rerun")
             continue
-        med = (ss[(len(ss) - 1) // 2] + ss[len(ss) // 2]) / 2
         print(f"{v:<10} {med * 1e3:8.2f} {tok / med:12.0f}")
 
 

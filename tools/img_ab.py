@@ -18,6 +18,8 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from bench import interleaved_slopes  # noqa: E402  (repo root on sys.path above)
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -111,31 +113,13 @@ def main():
         runs[name](n_long)
         print(f"{name}: compiled in {time.perf_counter() - t0:.0f}s", flush=True)
 
-    times = {}
-    slopes = {v: [] for v in args.variants}
-    for est in range(3):
-        for v in args.variants:
-            times[v] = {"s": float("inf"), "l": float("inf")}
-        for _ in range(args.reps):
-            for v in args.variants:
-                t0 = time.perf_counter()
-                runs[v](n_short)
-                times[v]["s"] = min(times[v]["s"], time.perf_counter() - t0)
-                t0 = time.perf_counter()
-                runs[v](n_long)
-                times[v]["l"] = min(times[v]["l"], time.perf_counter() - t0)
-        for v in args.variants:
-            s = (times[v]["l"] - times[v]["s"]) / (n_long - n_short)
-            if s > 0:
-                slopes[v].append(s)
-
+    meds = interleaved_slopes(runs, n_short, n_long, reps=args.reps)
     print(f"{'variant':<16} {'ms/step':>8} {'img/s':>8}")
     for v in args.variants:
-        ss = sorted(slopes[v])
-        if not ss:
+        med = meds[v]
+        if med is None:
             print(f"{v:<16}  all slope estimates non-positive (tunnel stall?) — rerun")
             continue
-        med = (ss[(len(ss) - 1) // 2] + ss[len(ss) // 2]) / 2
         print(f"{v:<16} {med * 1e3:8.2f} {b / med:8.1f}")
 
 

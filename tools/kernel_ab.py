@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from bench import flagship_config
+from bench import flagship_config, interleaved_slopes
 
 jax.config.update("jax_compilation_cache_dir", "/tmp/jax_probe_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
@@ -98,31 +98,13 @@ def main():
         print(f"{name}: compiled in {time.perf_counter() - t0:.0f}s", flush=True)
     fa.set_fast_kernels(True)
 
-    times = {v: {"s": float("inf"), "l": float("inf")} for v in variants}
-    slopes = {v: [] for v in variants}
-    for est in range(3):
-        for v in variants:
-            times[v] = {"s": float("inf"), "l": float("inf")}
-        for _ in range(args.reps):
-            for v in variants:
-                t0 = time.perf_counter()
-                runs[v](n_short)
-                times[v]["s"] = min(times[v]["s"], time.perf_counter() - t0)
-                t0 = time.perf_counter()
-                runs[v](n_long)
-                times[v]["l"] = min(times[v]["l"], time.perf_counter() - t0)
-        for v in variants:
-            s = (times[v]["l"] - times[v]["s"]) / (n_long - n_short)
-            if s > 0:
-                slopes[v].append(s)
-
+    meds = interleaved_slopes(runs, n_short, n_long, reps=args.reps)
     print(f"{'variant':<28} {'ms/step':>8} {'tok/s':>12}")
     for v in variants:
-        ss = sorted(slopes[v])
-        if not ss:
+        med = meds[v]
+        if med is None:
             print(f"{v:<28}  all slope estimates non-positive (tunnel stall?) — rerun")
             continue
-        med = (ss[(len(ss) - 1) // 2] + ss[len(ss) // 2]) / 2
         print(f"{v:<28} {med * 1e3:8.3f} {b * n / med:12.0f}")
 
 

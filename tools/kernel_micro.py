@@ -19,14 +19,14 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from bench import interleaved_slopes  # noqa: E402  (repo root on sys.path above)
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 jax.config.update("jax_compilation_cache_dir", "/tmp/jax_probe_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-
-# (timing is self-contained: interleaved slopes, see below)
 
 # flagship attention geometries at batch 4 (16k ctx, 1024 latents, 8 x 64
 # heads, 0.5 prefix dropout -> CA kv 8704)
@@ -167,31 +167,12 @@ def main():
 
     n_short, n_long = 2, 2 + args.iters
 
-    # interleave ALL variants inside each rep — sequential per-variant
-    # robust_slope windows minutes apart are swamped by the chip's 1.5-1.8x
-    # burst-vs-sustained clock drift (observed: fwd+bwd reading "faster"
-    # than fwd alone)
-    inf = float("inf")
-    slopes = {k: [] for k in runs}
-    for _ in range(3):
-        times = {k: {"s": inf, "l": inf} for k in runs}
-        for _ in range(4):
-            for k, fn in runs.items():
-                t0 = time.perf_counter()
-                fn(n_short)
-                times[k]["s"] = min(times[k]["s"], time.perf_counter() - t0)
-                t0 = time.perf_counter()
-                fn(n_long)
-                times[k]["l"] = min(times[k]["l"], time.perf_counter() - t0)
-        for k in runs:
-            s = (times[k]["l"] - times[k]["s"]) / (n_long - n_short)
-            if s > 0:
-                slopes[k].append(s)
-
-    results = {}
-    for k in runs:
-        ss = sorted(slopes[k])
-        results[k] = (ss[(len(ss) - 1) // 2] + ss[len(ss) // 2]) / 2 if ss else inf
+    # interleave ALL variants inside each rep (bench.interleaved_slopes) —
+    # sequential per-variant robust_slope windows minutes apart are swamped
+    # by the chip's 1.5-1.8x burst-vs-sustained clock drift (observed:
+    # fwd+bwd reading "faster" than fwd alone)
+    meds = interleaved_slopes(runs, n_short, n_long)
+    results = {k: (float("inf") if m is None else m) for k, m in meds.items()}
 
     print(f"\n{'variant':<22} {'geom':<4} {'pass':<7} {'ms':>8} {'roofline':>9} {'% of ceil':>9}")
     for (vname, gname, cname), t in results.items():
