@@ -287,6 +287,7 @@ def decode_bench(args):
     config = flagship_config(args.seq_len, args.latents)
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
     cache_dtype = jnp.int8 if args.cache_dtype == "int8" else dtype
+    weight_dtype = jnp.int8 if getattr(args, "weight_dtype", "model") == "int8" else None
     model = CausalLanguageModel(config, dtype=dtype)
 
     b = args.batch_size
@@ -300,7 +301,7 @@ def decode_bench(args):
     fns = {
         k: make_generate_fn(
             model, args.latents, GenerationConfig(max_new_tokens=k, do_sample=True, top_k=10),
-            cache_dtype=cache_dtype,
+            cache_dtype=cache_dtype, weight_dtype=weight_dtype,
         )
         for k in (n_short, n_long)
     }
@@ -332,7 +333,29 @@ def decode_bench(args):
         2 * config.num_channels * csize + scale_bytes
     )
     step_bytes = n_params * dsize + b * (ca_window + sa_windows)
-    chip_bytes = n_params * dsize + b * (ca_window_chip + sa_windows_chip)
+    # chip-side weight bytes: int8 kernels store 1 byte + a f32 scale per
+    # output channel; everything else (embeddings, norms, biases) stays at
+    # model dtype. The BASELINE side always moves full-precision weights
+    # (the torch reference has no quantized inference), so — like the int8
+    # cache — int8 weights RAISE the bandwidth cap.
+    if weight_dtype is not None:
+        from perceiver_io_tpu.ops.quant import QuantizedTensor, quantize_weights
+
+        def leaf_bytes(x):
+            if isinstance(x, QuantizedTensor):
+                return x.q.size + x.scale.size * 4
+            return x.size * dsize
+
+        qtree = quantize_weights(params)
+        weight_bytes_chip = sum(
+            leaf_bytes(x)
+            for x in jax.tree.leaves(
+                qtree, is_leaf=lambda x: isinstance(x, QuantizedTensor)
+            )
+        )
+    else:
+        weight_bytes_chip = n_params * dsize
+    chip_bytes = weight_bytes_chip + b * (ca_window_chip + sa_windows_chip)
     a100_step_time = step_bytes / (A100_PEAK_BW * A100_BW_FRAC)
     # THIS chip's physical floor: the bytes it actually moves at 100% of v5e
     # bandwidth. vs_baseline is capped at a100_step_time/v5e_floor even at
@@ -346,6 +369,7 @@ def decode_bench(args):
         "metric": f"perceiver-ar-clm decode tokens/sec @{args.seq_len} ctx "
         f"(full sliding-window KV cache, {args.dtype}"
         + (", int8 cache" if cache_dtype == jnp.int8 else "")
+        + (", int8 weights" if weight_dtype is not None else "")
         + f", batch {b})",
         "value": round(b / per_token, 1),
         "unit": "tokens/sec",
@@ -359,8 +383,9 @@ def decode_bench(args):
 
 
 def extra_bench(args):
-    """Run the non-headline benches (decode b=1 and b=8, decode b=8 with the
-    int8 KV cache, image training)
+    """Run the non-headline benches (decode b=1 and b=8 in bf16, decode b=8
+    with the int8 KV cache, decode b=1 with int8 weights, decode b=8 with
+    both int8 stores, image training)
     and write them to one JSON artifact (``--out BENCH_extra_r<k>.json``) so
     decode/image regressions are visible round-over-round — the headline
     train metric is what the driver's plain ``python bench.py`` records."""
@@ -384,6 +409,17 @@ def extra_bench(args):
     a = copy.copy(args)
     a.batch_size, a.mode, a.cache_dtype = 8, "decode", "int8"
     results["decode_b8_int8"] = decode_bench(a)
+    flush(results)
+    # int8 WEIGHTS (per-output-channel kernels, ops/quant.py): at batch 1
+    # the decode step is weights-read-bound, so this is where the weight
+    # diet pays; the "full" row stacks both int8 stores at batch 8
+    a = copy.copy(args)
+    a.batch_size, a.mode, a.weight_dtype = 1, "decode", "int8"
+    results["decode_b1_int8w"] = decode_bench(a)
+    flush(results)
+    a = copy.copy(args)
+    a.batch_size, a.mode, a.cache_dtype, a.weight_dtype = 8, "decode", "int8", "int8"
+    results["decode_b8_int8_full"] = decode_bench(a)
     flush(results)
     a = copy.copy(args)
     # batch 16 is the largest the 224x224 Fourier config fits on one chip
@@ -418,6 +454,9 @@ def main():
     p.add_argument("--dtype", default="bfloat16")
     p.add_argument("--cache-dtype", choices=["model", "int8"], default="model",
                    help="decode KV-cache storage: model dtype or int8+per-token scales")
+    p.add_argument("--weight-dtype", choices=["model", "int8"], default="model",
+                   help="decode weight storage: model dtype or int8 kernels "
+                        "+ per-output-channel scales (ops/quant.py)")
     p.add_argument("--remat", action="store_true", help="activation checkpointing (needed for large seq/batch)")
     p.add_argument("--mode", choices=["train", "decode", "img", "extra"], default="train")
     p.add_argument("--out", default=None, help="extra mode: JSON artifact path (e.g. BENCH_extra_r3.json)")

@@ -48,6 +48,28 @@ class GenerationConfig:
     pad_token_id: int = 0
 
 
+def _maybe_quantize_weights(model, params, weight_dtype):
+    """``(decode_params, compute_dtype)`` — int8-quantized kernels and the
+    dtype to dequantize to inside the decode loop, or ``(params, None)``
+    passthrough (the None sentinel keeps the default path's tree untouched,
+    bit-for-bit)."""
+    if weight_dtype is None:
+        return params, None
+    if jnp.dtype(weight_dtype) != jnp.dtype(jnp.int8):
+        raise ValueError(f"weight_dtype must be None or jnp.int8, got {weight_dtype}")
+    from perceiver_io_tpu.ops.quant import quantize_weights
+
+    return quantize_weights(params), getattr(model, "dtype", jnp.float32)
+
+
+def _maybe_dequantize_weights(decode_params, compute_dtype):
+    if compute_dtype is None:
+        return decode_params
+    from perceiver_io_tpu.ops.quant import dequantize_weights
+
+    return dequantize_weights(decode_params, compute_dtype)
+
+
 def _shift_left_if_full(cache: KVCache) -> KVCache:
     """Drop the oldest slot when the cache is full (the fixed-capacity analog
     of the reference's ``[:, -max_len+1:]`` truncation)."""
@@ -132,6 +154,7 @@ def beam_search(
     pad_token_id: int = 0,
     pad_mask: Optional[jnp.ndarray] = None,
     cache_dtype=jnp.float32,
+    weight_dtype=None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Beam-search decoding over the fixed-capacity KV caches.
 
@@ -202,14 +225,17 @@ def beam_search(
 
     batch_base = jnp.repeat(jnp.arange(b) * num_beams, num_beams)  # (bb,)
 
+    decode_params, compute_dtype = _maybe_quantize_weights(model, params, weight_dtype)
+
     def step(carry, t):
         cache, seqs, beam_scores, token, done = carry
+        step_params = _maybe_dequantize_weights(decode_params, compute_dtype)
         # slide the self-attention windows when full, exactly as generate()
         # does (the CA cache cannot fill — validated above); positions keep
         # counting from the CA length, so beams stay aligned
         cache = (cache[0],) + tuple(_shift_left_if_full(c) for c in cache[1:])
         out = model.apply(
-            params,
+            step_params,
             token[:, None],
             prefix_len=0,
             pad_mask=pad_slots,
@@ -270,6 +296,7 @@ def make_generate_fn(
     num_latents: int = 1,
     config: Optional[GenerationConfig] = None,
     cache_dtype=jnp.float32,
+    weight_dtype=None,
 ):
     """Jit-compiled ``fn(params, input_ids, pad_mask, rng) -> tokens``.
 
@@ -290,6 +317,7 @@ def make_generate_fn(
             config=config,
             rng=rng,
             cache_dtype=cache_dtype,
+            weight_dtype=weight_dtype,
         )
 
     return fn
@@ -304,6 +332,7 @@ def generate(
     config: Optional[GenerationConfig] = None,
     rng: Optional[jax.Array] = None,
     cache_dtype=jnp.float32,
+    weight_dtype=None,
 ) -> jnp.ndarray:
     """Generate ``config.max_new_tokens`` continuation tokens.
 
@@ -312,6 +341,12 @@ def generate(
     :param num_latents: initial number of latent positions at the end of the
         prompt (reference: huggingface.py:187-230).
     :param pad_mask: boolean (B, S), True at (left) padding.
+    :param weight_dtype: ``jnp.int8`` stores the matmul kernels int8
+        (per-output-channel scales, ops/quant.py) for the DECODE loop,
+        halving its per-token weight read; the prompt pass stays full
+        precision (it is compute-bound). Dequantization happens inside the
+        scan body so the loop's HBM reads stay int8 (see ops/quant.py on
+        why XLA does not hoist it). ``None`` (default) = model precision.
     :return: (B, S + max_new_tokens) sequence including the prompt.
     """
     config = config or GenerationConfig()
@@ -360,8 +395,11 @@ def generate(
     ca_idx = jnp.arange(ca_capacity, dtype=jnp.int32)[None, :]
     sa_idx = jnp.arange(sa_capacity, dtype=jnp.int32)[None, :]
 
+    decode_params, compute_dtype = _maybe_quantize_weights(model, params, weight_dtype)
+
     def step(carry, _):
         cache, ca_start, sa_start, token, rng, done = carry
+        params = _maybe_dequantize_weights(decode_params, compute_dtype)
         ca_cache, sa_caches = cache[0], cache[1:]
 
         # slide: expire the oldest latent when the SA window is full, the

@@ -1,0 +1,119 @@
+"""Weight-only int8 decode (ops/quant.py + generation ``weight_dtype``).
+
+Contract mirror of tests/test_int8_cache.py for the OTHER half of decode
+HBM traffic: per-output-channel kernel quantization must bound the logit
+error at random init, leave non-kernel leaves untouched, and produce
+deterministic generations. The reference has no quantized inference
+(beyond-parity; reference decode loop: core/huggingface.py:158-185)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from perceiver_io_tpu.core.config import CausalSequenceModelConfig
+from perceiver_io_tpu.core.modules import CausalSequenceModel
+from perceiver_io_tpu.generation import GenerationConfig, generate, make_generate_fn
+from perceiver_io_tpu.ops.quant import (
+    QuantizedTensor,
+    dequantize_weights,
+    quantize_tensor,
+    quantize_weights,
+)
+
+CFG = CausalSequenceModelConfig(
+    vocab_size=64,
+    max_seq_len=48,
+    max_latents=12,
+    num_channels=32,
+    num_heads=4,
+    num_self_attention_layers=2,
+    output_norm=True,
+)
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = CausalSequenceModel(CFG)
+    x = jnp.zeros((2, 48), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), x, prefix_len=36)
+    return model, params
+
+
+def test_quantize_tensor_roundtrip_bound():
+    w = jnp.asarray(np.random.default_rng(0).normal(size=(128, 64)), jnp.float32)
+    qt = quantize_tensor(w)
+    assert qt.q.dtype == jnp.int8 and qt.scale.shape == (1, 64)
+    dq = qt.dequantize(jnp.float32)
+    # symmetric rounding: error is at most half a quantization step per column
+    err = jnp.abs(dq - w)
+    bound = qt.scale[0] * 0.5 + 1e-7
+    assert bool(jnp.all(err <= bound[None, :])), float(jnp.max(err / bound[None, :]))
+
+
+def test_quantize_weights_selects_kernels_only(model_and_params):
+    _, params = model_and_params
+    qtree = quantize_weights(params)
+    leaves = jax.tree_util.tree_leaves_with_path(
+        qtree, is_leaf=lambda x: isinstance(x, QuantizedTensor)
+    )
+    quantized = [p for p, v in leaves if isinstance(v, QuantizedTensor)]
+    passthrough = [p for p, v in leaves if not isinstance(v, QuantizedTensor)]
+    assert len(quantized) > 0
+    # every quantized path is a matmul kernel; embeddings/norms/biases pass through
+    for path in quantized:
+        assert path[-1].key == "kernel", path
+    for path in passthrough:
+        assert path[-1].key != "kernel", path
+    # dequantize restores plain arrays with the original tree structure
+    restored = dequantize_weights(qtree, jnp.float32)
+    assert jax.tree_util.tree_structure(restored) == jax.tree_util.tree_structure(params)
+
+
+def test_quantized_forward_logit_error_bounded(model_and_params):
+    """Same contract style as the int8 KV cache (<0.05 max logit delta at
+    random init): full forward with dequantized int8 kernels vs original."""
+    model, params = model_and_params
+    x = jnp.asarray(np.random.default_rng(1).integers(0, CFG.vocab_size, size=(2, 48)))
+    ref = model.apply(params, x, prefix_len=36).logits
+    dq = dequantize_weights(quantize_weights(params), jnp.float32)
+    got = model.apply(dq, x, prefix_len=36).logits
+    assert float(jnp.max(jnp.abs(got - ref))) < 0.05
+
+
+def test_generate_int8_weights_runs_and_is_deterministic(model_and_params):
+    model, params = model_and_params
+    prompt = jnp.asarray(np.random.default_rng(2).integers(0, CFG.vocab_size, size=(2, 40)))
+    config = GenerationConfig(max_new_tokens=8, do_sample=False)
+    fn = make_generate_fn(model, num_latents=4, config=config, weight_dtype=jnp.int8)
+    out1 = np.asarray(fn(params, prompt))
+    out2 = np.asarray(fn(params, prompt))
+    assert out1.shape == (2, 48)
+    np.testing.assert_array_equal(out1, out2)
+    assert ((out1 >= 0) & (out1 < CFG.vocab_size)).all()
+    # the prompt prefix is preserved verbatim
+    np.testing.assert_array_equal(out1[:, :40], np.asarray(prompt))
+
+
+def test_generate_int8_weights_matches_full_precision_closely(model_and_params):
+    """Greedy decode with int8 kernels agrees with full precision on most
+    steps at random init (logit deltas ~1e-2 can flip near-ties, so exact
+    token equality is not the contract — agreement rate is)."""
+    model, params = model_and_params
+    prompt = jnp.asarray(np.random.default_rng(3).integers(0, CFG.vocab_size, size=(4, 40)))
+    config = GenerationConfig(max_new_tokens=8, do_sample=False)
+    full = np.asarray(generate(model, params, prompt, num_latents=4, config=config))
+    q = np.asarray(
+        generate(model, params, prompt, num_latents=4, config=config, weight_dtype=jnp.int8)
+    )
+    agree = (full[:, 40:] == q[:, 40:]).mean()
+    assert agree >= 0.75, f"int8-weight decode agreement {agree:.2f}"
+
+
+def test_generate_rejects_unknown_weight_dtype(model_and_params):
+    model, params = model_and_params
+    prompt = jnp.zeros((1, 40), jnp.int32)
+    with pytest.raises(ValueError, match="weight_dtype"):
+        generate(model, params, prompt, num_latents=4, weight_dtype=jnp.float16)

@@ -85,17 +85,26 @@ prompt_fn = jax.jit(lambda p, xs, cache: model32.apply(
 step_fn = jax.jit(lambda p, tok, cache: model32.apply(
     p, tok, prefix_len=prefix, kv_cache=cache, decode=True))
 
-def cached_decode(dtype):
+def cached_decode(dtype, pp=p):
     cache = CausalSequenceModel.init_cache(cfg, 4, dtype=dtype)
-    out = prompt_fn(p, x[:, : prefix + 2], cache)
+    out = prompt_fn(pp, x[:, : prefix + 2], cache)
     logits, c = [out.logits], out.kv_cache
     for i in range(2, 2 + N_DEC):
-        o = step_fn(p, x[:, prefix + i : prefix + i + 1], c)
+        o = step_fn(pp, x[:, prefix + i : prefix + i + 1], c)
         logits.append(o.logits); c = o.kv_cache
     return jnp.concatenate(logits, 1)
 
 q = cached_decode(jnp.int8)
 f = cached_decode(jnp.float32)
+
+# weight-only int8 on TRAINED kernels (ops/quant.py): per-output-channel
+# scales must absorb trained-weight outliers the random-init contract test
+# never sees — reported alongside the cache numbers below
+from perceiver_io_tpu.ops.quant import dequantize_weights, quantize_weights  # noqa: E402
+
+pq = dequantize_weights(quantize_weights(p), jnp.float32)
+w = cached_decode(jnp.float32, pq)  # int8 weights, f32 cache
+wq = cached_decode(jnp.int8, pq)  # int8 weights + int8 cache
 sl = exact[:, : 2 + N_DEC]
 err = np.abs(np.asarray(q, np.float32) - np.asarray(sl, np.float32))
 err_f = np.abs(np.asarray(f, np.float32) - np.asarray(sl, np.float32))
@@ -106,7 +115,13 @@ def ce(lg):
     lp = jax.nn.log_softmax(jnp.asarray(lg))
     return float(-jnp.take_along_axis(lp, jnp.asarray(labels)[..., None], -1).mean())
 
+err_w = np.abs(np.asarray(w, np.float32) - np.asarray(sl, np.float32))
+agree_w = (np.argmax(np.asarray(w), -1) == np.argmax(np.asarray(sl), -1)).mean()
+
 print(f"trained-weights decode vs exact: int8 max|dlogit|={err.max():.4f} "
       f"mean={err.mean():.5f} (f32-cache control max={err_f.max():.2e}) "
       f"top1-agree={agree:.4f} CE exact={ce(sl):.5f} CE f32cache={ce(f):.5f} "
       f"CE int8={ce(q):.5f}", flush=True)
+print(f"trained-weights int8 WEIGHTS vs exact: max|dlogit|={err_w.max():.4f} "
+      f"mean={err_w.mean():.5f} top1-agree={agree_w:.4f} "
+      f"CE int8w={ce(w):.5f} CE int8w+int8kv={ce(wq):.5f}", flush=True)
