@@ -339,20 +339,16 @@ def decode_bench(args):
     # (the torch reference has no quantized inference), so — like the int8
     # cache — int8 weights RAISE the bandwidth cap.
     if weight_dtype is not None:
-        from perceiver_io_tpu.ops.quant import QuantizedTensor, quantize_weights
-
-        def leaf_bytes(x):
-            if isinstance(x, QuantizedTensor):
-                return x.q.size + x.scale.size * 4
+        # shape arithmetic only (same selection rule as quantize_weights:
+        # 2D+ leaves named "kernel" → 1 byte/elem + one f32 scale per
+        # output channel; everything else stays at model dtype)
+        def leaf_bytes(path, x):
+            if getattr(path[-1], "key", None) == "kernel" and x.ndim >= 2:
+                return x.size + x.shape[-1] * 4
             return x.size * dsize
 
-        qtree = quantize_weights(params)
-        weight_bytes_chip = sum(
-            leaf_bytes(x)
-            for x in jax.tree.leaves(
-                qtree, is_leaf=lambda x: isinstance(x, QuantizedTensor)
-            )
-        )
+        leaves = jax.tree_util.tree_leaves_with_path(params)
+        weight_bytes_chip = sum(leaf_bytes(p, x) for p, x in leaves)
     else:
         weight_bytes_chip = n_params * dsize
     chip_bytes = weight_bytes_chip + b * (ca_window_chip + sa_windows_chip)

@@ -28,14 +28,24 @@ from perceiver_io_tpu.hf.auto import from_pretrained
 from perceiver_io_tpu.hf.mask_filler import MaskFiller
 
 
-def _cached_generate_fn(cache: Dict[Any, Any], model, num_latents: int, gen_config: GenerationConfig):
+def _cached_generate_fn(
+    cache: Dict[Any, Any],
+    model,
+    num_latents: int,
+    gen_config: GenerationConfig,
+    cache_dtype=jnp.float32,
+    weight_dtype=None,
+):
     """Memoized jitted generation per sampling settings — the eager path
     costs ~20x per token on TPU (see make_generate_fn). Prompt-shape
     specialization is jit's own job; keying on it here would only duplicate
-    wrapper objects."""
+    wrapper objects. Storage dtypes are constructor-fixed per pipeline, so
+    the key stays sampling-settings only."""
     key = (num_latents, *dataclasses.astuple(gen_config))
     if key not in cache:
-        cache[key] = make_generate_fn(model, num_latents, gen_config)
+        cache[key] = make_generate_fn(
+            model, num_latents, gen_config, cache_dtype=cache_dtype, weight_dtype=weight_dtype
+        )
     return cache[key]
 
 
@@ -60,16 +70,29 @@ class TextGenerationPipeline:
     (reference: clm/huggingface.py text-generation registration +
     core/huggingface.py:187-230 generate(num_latents=...))."""
 
-    def __init__(self, model, params, tokenizer=None):
+    def __init__(self, model, params, tokenizer=None, cache_dtype=jnp.float32, weight_dtype=None):
+        """``cache_dtype=jnp.int8`` quantizes KV-cache storage (batched
+        serving), ``weight_dtype=jnp.int8`` the matmul kernels (latency-bound
+        small-batch serving) — the serving-level knobs from generation.py /
+        ops/quant.py; see the regime map in docs/performance.md."""
         from perceiver_io_tpu.data.text.tokenizer import ByteTokenizer
 
         self.model = model
         self.params = params
         self.tokenizer = tokenizer or ByteTokenizer()
+        self.cache_dtype = cache_dtype
+        self.weight_dtype = weight_dtype
         self._gen_cache: Dict[Any, Any] = {}
 
     def _generate(self, ids, pad_mask, num_latents: int, gen_config: GenerationConfig, seed: int):
-        fn = _cached_generate_fn(self._gen_cache, self.model, num_latents, gen_config)
+        fn = _cached_generate_fn(
+            self._gen_cache,
+            self.model,
+            num_latents,
+            gen_config,
+            cache_dtype=self.cache_dtype,
+            weight_dtype=self.weight_dtype,
+        )
         return fn(
             self.params,
             jnp.asarray(ids),
@@ -124,6 +147,8 @@ class TextGenerationPipeline:
                 num_beams=num_beams,
                 max_new_tokens=max_new_tokens,
                 pad_mask=None if pad_mask is None or not pad_mask.any() else jnp.asarray(pad_mask),
+                cache_dtype=self.cache_dtype,
+                weight_dtype=self.weight_dtype,
             )
             texts = self.tokenizer.batch_decode(np.asarray(out).tolist())
             return texts[0] if single else texts

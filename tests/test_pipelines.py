@@ -55,6 +55,16 @@ class TestTextGeneration:
         assert len(out) == 2
         assert out[1].startswith("longer prompt")
 
+    def test_int8_serving_dtypes(self, clm):
+        """The int8 storage knobs (KV cache / weights, ops/quant.py) are
+        reachable from the pipeline surface and keep greedy output textual."""
+        import jax.numpy as jnp
+
+        model, params = clm
+        p = TextGenerationPipeline(model, params, cache_dtype=jnp.int8, weight_dtype=jnp.int8)
+        out = p("Hello worl", max_new_tokens=6, do_sample=False)
+        assert isinstance(out, str) and out.startswith("Hello worl")
+
     @pytest.mark.slow
     def test_beam_search_option(self, clm):
         model, params = clm
