@@ -348,9 +348,13 @@ class SymbolicAudioGenerationPipeline:
     decoded notes / MIDI file / optional fluidsynth-rendered audio
     (reference: audio/symbolic/huggingface.py:63-190)."""
 
-    def __init__(self, model, params):
+    def __init__(self, model, params, cache_dtype=jnp.float32, weight_dtype=None):
+        """Same int8 serving knobs as :class:`TextGenerationPipeline`
+        (generation is the identical sliding-window decode loop)."""
         self.model = model
         self.params = params
+        self.cache_dtype = cache_dtype
+        self.weight_dtype = weight_dtype
         self._gen_cache: Dict[Any, Any] = {}
 
     def __call__(
@@ -389,7 +393,14 @@ class SymbolicAudioGenerationPipeline:
             top_k=top_k,
             top_p=top_p,
         )
-        fn = _cached_generate_fn(self._gen_cache, self.model, num_latents, gen_config)
+        fn = _cached_generate_fn(
+            self._gen_cache,
+            self.model,
+            num_latents,
+            gen_config,
+            cache_dtype=self.cache_dtype,
+            weight_dtype=self.weight_dtype,
+        )
         out = fn(self.params, jnp.asarray(prompt_ids), rng=jax.random.PRNGKey(seed))
         ids = np.asarray(out[0])
         ids = ids[ids != midi.PAD_ID]

@@ -310,3 +310,10 @@ class TestSymbolicAudioGeneration:
         out = p(prompt, max_new_tokens=16, top_k=5, seed=0)
         assert out.token_ids.shape[0] == len(prompt) + 16
         assert isinstance(out.notes, list)
+
+        # int8 serving knobs forward through the audio pipeline too
+        p8 = SymbolicAudioGenerationPipeline(
+            model, params, cache_dtype=jnp.int8, weight_dtype=jnp.int8
+        )
+        out8 = p8(prompt, max_new_tokens=8, top_k=5, seed=0)
+        assert out8.token_ids.shape[0] == len(prompt) + 8
