@@ -1,5 +1,6 @@
 """TPU kernels (Pallas) and fused ops."""
 
 from perceiver_io_tpu.ops.flash_attention import flash_attention, flash_supported
+from perceiver_io_tpu.ops.quant import dequantize_weights, quantize_weights
 
-__all__ = ["flash_attention", "flash_supported"]
+__all__ = ["flash_attention", "flash_supported", "quantize_weights", "dequantize_weights"]
