@@ -79,3 +79,40 @@ def test_all_estimates_corrupted_raises(clock):
     run = make_run(clock, per_step=0.005, stall_schedule=stalls)
     with pytest.raises(RuntimeError, match="non-positive"):
         bench.robust_slope(run, 2, 22, estimates=3, reps=2)
+
+
+# --- interleaved_slopes (the multi-variant harness shared by tools/*_ab.py) ---
+
+
+def test_interleaved_recovers_each_variant(clock):
+    runs = {"a": make_run(clock, per_step=0.005), "b": make_run(clock, per_step=0.008)}
+    meds = bench.interleaved_slopes(runs, 2, 22, estimates=3, reps=2)
+    assert meds["a"] == pytest.approx(0.005, rel=1e-9)
+    assert meds["b"] == pytest.approx(0.008, rel=1e-9)
+
+
+def test_interleaved_stall_on_one_variant_leaves_other_clean(clock):
+    # Call order per rep is a-short, a-long, b-short, b-long. Stall b's
+    # short chains in estimate 0 (per-variant call idxs 0 and 2 of the
+    # measurement phase): b's first estimate goes negative and is dropped;
+    # a must be untouched and b's median comes from its clean estimates.
+    runs = {
+        "a": make_run(clock, per_step=0.005),
+        "b": make_run(clock, per_step=0.008, stall_schedule={0: 2.0, 2: 2.0}),
+    }
+    meds = bench.interleaved_slopes(runs, 2, 22, estimates=3, reps=2)
+    assert meds["a"] == pytest.approx(0.005, rel=1e-9)
+    assert meds["b"] == pytest.approx(0.008, rel=1e-9)
+
+
+def test_interleaved_all_stalled_variant_returns_none(clock):
+    # every short chain of 'b' stalls -> all b estimates non-positive ->
+    # None (the tools print a rerun message), while 'a' still measures
+    stalls = {i: 5.0 for i in range(0, 12, 2)}
+    runs = {
+        "a": make_run(clock, per_step=0.005),
+        "b": make_run(clock, per_step=0.008, stall_schedule=stalls),
+    }
+    meds = bench.interleaved_slopes(runs, 2, 22, estimates=3, reps=2)
+    assert meds["a"] == pytest.approx(0.005, rel=1e-9)
+    assert meds["b"] is None
