@@ -493,3 +493,39 @@ def test_residuals_survive_noop_and_unprefetched_fits():
     trainer.fit(fresh(), it)
 
     assert seen == list(range(15)), seen
+
+
+def test_checkpoint_roundtrip_bf16_moments(tmp_path):
+    """Orbax save/restore must preserve the compact Adam state's bfloat16
+    moment dtype (the round-4 bench default): a restored state has to be
+    bit-identical — a silent upcast on restore would change subsequent
+    update numerics vs an uninterrupted run."""
+    from perceiver_io_tpu.training.loop import make_train_step
+
+    model, _ = tiny_classifier()
+    batch = toy_text_batch()
+    params = model.init(jax.random.PRNGKey(0), batch["x"])
+    tx = make_optimizer(1e-3, gradient_clip=1.0, moment_dtype="bfloat16")
+    state = TrainState.create(model.apply, params, tx, jax.random.PRNGKey(1))
+    step = make_train_step(classification_loss_fn(model.apply), donate=False)
+    state, _ = step(state, batch)
+
+    moment_dtypes = {
+        a.dtype for a in jax.tree.leaves(state.opt_state) if hasattr(a, "dtype") and a.ndim
+    }
+    assert jnp.dtype(jnp.bfloat16) in moment_dtypes
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    assert mgr.save(state, metrics={"val_loss": 1.0})
+    mgr.wait_until_finished()
+    restored = mgr.restore(
+        TrainState.create(model.apply, params, tx, jax.random.PRNGKey(1))
+    )
+    for got, want in zip(jax.tree.leaves(restored.opt_state), jax.tree.leaves(state.opt_state)):
+        if hasattr(want, "dtype"):
+            assert got.dtype == want.dtype
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    # and the restored state steps without dtype errors
+    _, metrics = step(restored, batch)
+    assert np.isfinite(float(metrics["loss"]))
