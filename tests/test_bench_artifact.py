@@ -1,0 +1,37 @@
+"""Pin on the committed round-4 bench artifact — its own module (not
+test_results_artifacts.py) so its skip condition is this artifact's
+presence, not flagship_convergence.json's."""
+
+import json
+import os
+
+import pytest
+
+
+def test_bench_extra_artifact_shape_and_int8_wins():
+    """The committed round-4 bench artifact must keep its row set and the
+    two int8 headline wins (decode b=8 int8 cache and decode b=1 int8
+    weights both beat the analytic baseline) — a bad regeneration (stalled
+    chip, wrong flags) would otherwise ship silently."""
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "BENCH_extra_r4.json"
+    )
+    if not os.path.exists(path):
+        pytest.skip("BENCH_extra_r4.json not generated yet")
+    d = json.load(open(path))
+    expected = {
+        "decode_b1",
+        "decode_b8",
+        "decode_b8_int8",
+        "decode_b1_int8w",
+        "decode_b8_int8_full",
+        "image_b16",
+    }
+    assert expected <= set(d), sorted(d)
+    for k in expected:
+        assert d[k]["value"] > 0, k
+    assert d["decode_b8_int8"]["vs_baseline"] > 1.0, d["decode_b8_int8"]
+    assert d["decode_b1_int8w"]["vs_baseline"] > 1.0, d["decode_b1_int8w"]
+    # decode rows self-describe their bandwidth ceilings (VERDICT r3 item 4)
+    for k in expected - {"image_b16"}:
+        assert "ceiling_fraction" in d[k] and "vs_baseline_cap" in d[k], k
