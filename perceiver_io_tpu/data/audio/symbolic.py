@@ -10,7 +10,6 @@ max_seq_len+1 and emits shifted (labels, input_ids, pad_mask)."""
 
 from __future__ import annotations
 
-import os
 import random
 from pathlib import Path
 from typing import Dict, List, Optional

@@ -37,7 +37,7 @@ stubs, so configs survive without the reference installed.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Tuple
 
 import numpy as np
 

@@ -280,8 +280,6 @@ def test_load_pretrained_from_orbax_training_checkpoint(tmp_path):
     """Warm starts can point straight at a training run's checkpoints dir (or
     the run dir containing it) — the analog of the reference's
     load-from-.ckpt path (reference: core/lightning.py:145-147)."""
-    import optax
-
     from perceiver_io_tpu.training import load_pretrained, make_optimizer
 
     config = TextClassifierConfig(

@@ -12,7 +12,6 @@ import contextlib
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from perceiver_io_tpu.core import modules
 from perceiver_io_tpu.core.config import ClassificationDecoderConfig
