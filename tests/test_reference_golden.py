@@ -260,10 +260,14 @@ def _fake_lightning_ckpt(ref_model, hparams):
     }
 
 
-def test_mlm_logits_match_reference(ref):
-    """Perceiver IO MLM (tied output adapter) against the reference's own
-    torch forward, through the production .ckpt import — including a padded
-    batch (reference: text/mlm/backend.py:37-89)."""
+@pytest.mark.parametrize("tied", [True, False], ids=["tied", "untied"])
+def test_mlm_logits_match_reference(ref, tied):
+    """Perceiver IO MLM against the reference's own torch forward, through
+    the production .ckpt import — including a padded batch, in BOTH output
+    head modes: tied (logits from the shared token embedding) and untied
+    (separate TokenOutputAdapter, selected in the reference by setting
+    ``decoder.num_output_query_channels``) — the untied import once placed
+    the output head in the wrong subtree (reference: text/mlm/backend.py:44-62)."""
     import perceiver.model.text.mlm as ref_mlm
     from perceiver.model.text.common import TextEncoderConfig as RefEnc
 
@@ -276,7 +280,10 @@ def test_mlm_logits_match_reference(ref):
         num_cross_attention_heads=4, num_self_attention_heads=4,
         num_self_attention_layers_per_block=2, num_self_attention_blocks=1,
     )
-    dec = ref_mlm.TextDecoderConfig(vocab_size=100, max_seq_len=32, num_cross_attention_heads=4)
+    dec = ref_mlm.TextDecoderConfig(
+        vocab_size=100, max_seq_len=32, num_cross_attention_heads=4,
+        num_output_query_channels=None if tied else 24,
+    )
     ref_config = ref_mlm.MaskedLanguageModelConfig(
         encoder=enc, decoder=dec, num_latents=8, num_latent_channels=48
     )
