@@ -13,6 +13,7 @@ from perceiver_io_tpu.hf.lightning_ckpt import (  # noqa: F401
     import_image_classifier_checkpoint,
     import_mlm_checkpoint,
     import_symbolic_audio_checkpoint,
+    import_timeseries_checkpoint,
     import_text_classifier_checkpoint,
     load_lightning_checkpoint,
     save_lightning_checkpoint,
@@ -42,6 +43,7 @@ __all__ = [
     "import_image_classifier_checkpoint",
     "import_mlm_checkpoint",
     "import_symbolic_audio_checkpoint",
+    "import_timeseries_checkpoint",
     "import_text_classifier_checkpoint",
     "load_lightning_checkpoint",
     "save_lightning_checkpoint",

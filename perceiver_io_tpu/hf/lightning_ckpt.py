@@ -477,6 +477,61 @@ def import_image_classifier_checkpoint(ckpt_or_path) -> Tuple[Any, Dict[str, Any
     return config, {"params": params}
 
 
+def import_timeseries_checkpoint(ckpt_or_path) -> Tuple[Any, Dict[str, Any]]:
+    """Reference root-app ``MultivariatePerceiver`` checkpoint → (our
+    ``TimeSeriesPerceiverConfig``, flax variables). Unlike the task-package
+    models the root app's LightningModule holds ``encoder``/``decoder``
+    directly (no ``model.`` wrapper prefix) and flat hyper-parameters
+    (reference: model.py:47-75)."""
+    from perceiver_io_tpu.models.timeseries import (
+        TimeSeriesDecoderConfig,
+        TimeSeriesEncoderConfig,
+        TimeSeriesPerceiverConfig,
+    )
+
+    ckpt = _load(ckpt_or_path)
+    sd = _backend_state_dict(ckpt)
+    hp = _hparams(ckpt)
+
+    pos_proj_w = _np(sd["encoder.input_adapter.pos_proj.weight"])  # (lat, 1+2*bands)
+    params = {
+        "input_adapter": {
+            "linear": _linear(sd, "encoder.input_adapter.linear"),
+            "pos_proj": {"kernel": pos_proj_w.T},  # bias-free (model.py:20)
+        },
+        "encoder": _encoder_params(sd, prefix="encoder"),
+        "decoder": {
+            "cross_attn": _cross_attention_layer(sd, "decoder.cross_attn"),
+            "output_query_provider": {
+                "query": _np(sd["decoder.output_query_provider._query"])
+            },
+            "output_adapter": {"linear": _linear(sd, "decoder.output_adapter.linear")},
+        },
+    }
+    _check_all_consumed(sd)
+
+    heads_ca = int(hp.get("num_cross_attention_heads", 1))
+    config = TimeSeriesPerceiverConfig(
+        encoder=TimeSeriesEncoderConfig.create(
+            num_input_channels=int(sd["encoder.input_adapter.linear.weight"].shape[1]),
+            in_len=int(hp["in_len"]),
+            num_frequency_bands=(int(pos_proj_w.shape[1]) - 1) // 2,
+            num_cross_attention_heads=heads_ca,
+            num_self_attention_heads=int(hp.get("num_self_attention_heads", 1)),
+            num_self_attention_layers_per_block=_num_block_layers(sd, "encoder.self_attn_1"),
+            num_self_attention_blocks=int(hp["num_layers"]),
+        ),
+        decoder=TimeSeriesDecoderConfig.create(
+            out_len=int(sd["decoder.output_query_provider._query"].shape[0]),
+            num_output_channels=int(sd["decoder.output_adapter.linear.weight"].shape[0]),
+            num_cross_attention_heads=heads_ca,
+        ),
+        num_latents=int(sd["encoder.latent_provider._query"].shape[0]),
+        num_latent_channels=int(sd["encoder.latent_provider._query"].shape[1]),
+    )
+    return config, {"params": params}
+
+
 # -------------------------------------------------------------------------------------------
 # Export: our Flax tree → reference-named state_dict (reverse seam)
 # -------------------------------------------------------------------------------------------

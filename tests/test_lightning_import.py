@@ -392,3 +392,46 @@ def test_lenient_unpickler_survives_missing_reference_package(tmp_path):
     enc = ckpt["hyper_parameters"]["encoder"]
     assert enc.vocab_size == V and enc.num_input_channels == C
     assert ckpt["hyper_parameters"]["num_latents"] == LAT
+
+
+def test_import_timeseries_checkpoint(tmp_path):
+    """Naming contract for the root-app MultivariatePerceiver importer —
+    unlike the task models the state dict has NO ``model.`` prefix and the
+    hyper-parameters are flat (reference: model.py:47-75)."""
+    from perceiver_io_tpu.hf.lightning_ckpt import import_timeseries_checkpoint
+    from perceiver_io_tpu.models.timeseries import TimeSeriesPerceiver
+
+    in_ch, in_len, out_len, bands = 3, 12, 8, 4
+    pos_ch = 1 + 2 * bands
+    sd = {
+        "encoder.latent_provider._query": t(LAT, C),
+        "encoder.input_adapter.position_encoding.position_encoding": t(in_len, pos_ch),  # buffer
+        "encoder.input_adapter.pos_proj.weight": t(C, pos_ch),  # bias-free (model.py:20)
+    }
+    sd.update(_linear("encoder.input_adapter.linear", in_ch, C))
+    sd.update(_cross_attn_layer("encoder.cross_attn_1", C))
+    for i in range(1):
+        sd.update(_self_attn_layer(f"encoder.self_attn_1.{i}", C))
+    sd.update(_cross_attn_layer("decoder.cross_attn", C))
+    sd["decoder.output_query_provider._query"] = t(out_len, C)
+    sd.update(_linear("decoder.output_adapter.linear", C, in_ch))
+
+    hp = {
+        "num_input_channels": in_ch, "in_len": in_len, "out_len": out_len,
+        "num_latents": LAT, "latent_channels": C, "num_layers": 2,
+        "learning_rate": 1e-4,
+        "num_cross_attention_heads": 1, "num_self_attention_heads": 1,
+    }
+    path = tmp_path / "ts.ckpt"
+    torch.save({"state_dict": sd, "hyper_parameters": hp}, path)
+
+    config, variables = import_timeseries_checkpoint(str(path))
+    assert config.encoder.num_frequency_bands == bands
+    assert config.encoder.num_self_attention_blocks == 2
+    assert config.decoder.out_len == out_len
+    model = TimeSeriesPerceiver(config)
+    x = jnp.asarray(rng.normal(size=(2, in_len, in_ch)), jnp.float32)
+    init = model.init(jax.random.PRNGKey(0), x)
+    assert_trees_match(variables, init)
+    out = model.apply(variables, x)
+    assert out.shape == (2, out_len, in_ch)

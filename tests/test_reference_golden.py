@@ -18,6 +18,8 @@ silently wrong":
   export mapping)
 - Perceiver IO image classifier (the reference's own Fourier position
   encoding ordering, vision/image_classifier/backend.py:30-92)
+- the root-level time-series app (1-D Fourier, add-form input adapter,
+  unprefixed state dict — model.py:14-114)
 
 Unlike tests/test_lightning_import.py (a naming contract over synthesized
 state dicts), these run the reference's own forward/backward — a shared
@@ -58,9 +60,11 @@ def ref():
     if "pytorch_lightning" not in sys.modules:
         pl = types.ModuleType("pytorch_lightning")
 
-        class _Module:
+        class _Module(torch.nn.Module):
+            # a real nn.Module so root-app LightningModules (model.py's
+            # MultivariatePerceiver) register submodules / eval() normally
             def __init__(self, *a, **k):
-                pass
+                super().__init__()
 
             @classmethod
             def __init_subclass__(cls, **k):
@@ -440,3 +444,39 @@ def test_image_classifier_logits_match_reference(ref):
         ref_logits = ref_model(torch.from_numpy(x)).numpy()
     got = model.apply(variables, jnp.asarray(x))
     np.testing.assert_allclose(np.asarray(got), ref_logits, atol=2e-4, rtol=2e-4)
+
+
+def test_timeseries_matches_reference(ref):
+    """The fork's root-level time-series app (MultivariatePerceiver) against
+    its own torch forward through the new timeseries checkpoint importer —
+    covers the 1-D Fourier position encoding, the add-not-concat input
+    adapter, and the root app's unprefixed state-dict layout
+    (reference: model.py:14-114)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "ref_root_model", REFERENCE_PATH + "/model.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    from perceiver_io_tpu.hf.lightning_ckpt import import_timeseries_checkpoint
+    from perceiver_io_tpu.models.timeseries import TimeSeriesPerceiver
+
+    torch.manual_seed(4)
+    hparams = dict(
+        num_input_channels=3, in_len=16, out_len=12, num_latents=8,
+        latent_channels=32, num_layers=2, learning_rate=1e-4,
+        num_cross_attention_heads=1, num_self_attention_heads=1,
+    )
+    ref_model = mod.MultivariatePerceiver(**hparams).eval()
+    ckpt = {"state_dict": dict(ref_model.state_dict()), "hyper_parameters": hparams}
+
+    config, variables = import_timeseries_checkpoint(ckpt)
+    model = TimeSeriesPerceiver(config)
+
+    x = np.random.default_rng(11).standard_normal((2, 16, 3)).astype(np.float32)
+    with torch.no_grad():
+        ref_out = ref_model(torch.from_numpy(x)).numpy()
+    got = model.apply(variables, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(got), ref_out, atol=2e-4, rtol=2e-4)
