@@ -83,6 +83,7 @@ def convert_training_checkpoint(kind: str, ckpt: str, save_dir: str):
         "txt_clf": hf.import_text_classifier_checkpoint,
         "img_clf": hf.import_image_classifier_checkpoint,
         "sam": hf.import_symbolic_audio_checkpoint,
+        "timeseries": hf.import_timeseries_checkpoint,
     }
     config, variables = importers[kind](ckpt)
     save_pretrained(save_dir, variables, config=config)
@@ -94,7 +95,7 @@ def main(argv=None):
     parser.add_argument("model", choices=[*CONVERTERS, "all", "training-checkpoint"])
     parser.add_argument("--save-dir", required=True)
     parser.add_argument("--repo-id", default=None, help="override source repo id or local path")
-    parser.add_argument("--kind", choices=["clm", "mlm", "txt_clf", "img_clf", "sam"],
+    parser.add_argument("--kind", choices=["clm", "mlm", "txt_clf", "img_clf", "sam", "timeseries"],
                         help="training-checkpoint model family")
     parser.add_argument("--ckpt", default=None, help="path to the Lightning .ckpt file")
     args = parser.parse_args(argv)

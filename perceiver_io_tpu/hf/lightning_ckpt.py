@@ -425,12 +425,19 @@ def import_text_classifier_checkpoint(ckpt_or_path) -> Tuple[Any, Dict[str, Any]
     return config, {"params": params}
 
 
-def _classification_decoder_params(sd) -> Dict[str, Any]:
+def _linear_head_decoder_params(sd, prefix: str = "1") -> Dict[str, Any]:
+    """Reference ``PerceiverDecoder`` with a linear output adapter → our
+    decoder subtree (shared by the classifier task models, prefix ``1``,
+    and the root-app time-series model, prefix ``decoder``)."""
     return {
-        "cross_attn": _cross_attention_layer(sd, "1.cross_attn"),
-        "output_query_provider": {"query": _np(sd["1.output_query_provider._query"])},
-        "output_adapter": {"linear": _linear(sd, "1.output_adapter.linear")},
+        "cross_attn": _cross_attention_layer(sd, f"{prefix}.cross_attn"),
+        "output_query_provider": {"query": _np(sd[f"{prefix}.output_query_provider._query"])},
+        "output_adapter": {"linear": _linear(sd, f"{prefix}.output_adapter.linear")},
     }
+
+
+# task-model call sites read as "the classification decoder"
+_classification_decoder_params = _linear_head_decoder_params
 
 
 def _classification_decoder_config(ckpt, sd, config_cls):
@@ -500,13 +507,7 @@ def import_timeseries_checkpoint(ckpt_or_path) -> Tuple[Any, Dict[str, Any]]:
             "pos_proj": {"kernel": pos_proj_w.T},  # bias-free (model.py:20)
         },
         "encoder": _encoder_params(sd, prefix="encoder"),
-        "decoder": {
-            "cross_attn": _cross_attention_layer(sd, "decoder.cross_attn"),
-            "output_query_provider": {
-                "query": _np(sd["decoder.output_query_provider._query"])
-            },
-            "output_adapter": {"linear": _linear(sd, "decoder.output_adapter.linear")},
-        },
+        "decoder": _linear_head_decoder_params(sd, prefix="decoder"),
     }
     _check_all_consumed(sd)
 
