@@ -77,23 +77,26 @@ class TokenInputAdapter(nn.Module):
         table = self.txt_embedding.embedding.astype(self.dtype)
         return embed_lookup(table, x)
 
+    def _pos_slice(self, n: int) -> jnp.ndarray:
+        """Position embeddings for ``arange(n)`` as a table *slice* (n, C),
+        whose gradient is a pad instead of a scatter-add. The general gather
+        path costs ~38% of a 16k-context train step in its backward scatter
+        alone (measured on v5e)."""
+        table = self.pos_embedding.embedding.astype(self.dtype)
+        pos_emb = table[: min(n, self.max_seq_len)]
+        if n > self.max_seq_len:
+            # clip parity with the gather path: positions past the table
+            # end repeat the last row
+            tail = jnp.broadcast_to(table[-1], (n - self.max_seq_len, table.shape[1]))
+            pos_emb = jnp.concatenate([pos_emb, tail], axis=0)
+        return pos_emb
+
     def embed(self, x: jnp.ndarray, abs_pos: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         if not self.abs_pos_emb:
             return self._tokens(x)
         if abs_pos is None:
-            # Positions are arange(n) (statically no padding): the lookup is a
-            # table *slice*, whose gradient is a pad instead of a scatter-add.
-            # The general gather path below costs ~38% of a 16k-context train
-            # step in its backward scatter alone (measured on v5e).
-            n = x.shape[1]
-            table = self.pos_embedding.embedding.astype(self.dtype)
-            pos_emb = table[: min(n, self.max_seq_len)]
-            if n > self.max_seq_len:
-                # clip parity with the gather path: positions past the table
-                # end repeat the last row
-                tail = jnp.broadcast_to(table[-1], (n - self.max_seq_len, table.shape[1]))
-                pos_emb = jnp.concatenate([pos_emb, tail], axis=0)
-            return self._tokens(x) + pos_emb[None]
+            # positions are statically arange(n) — no padding
+            return self._tokens(x) + self._pos_slice(x.shape[1])[None]
         if x.shape[1] < abs_pos.shape[1]:
             abs_pos = abs_pos[:, -x.shape[1] :]
         abs_pos = jnp.clip(abs_pos, 0, self.max_seq_len - 1)
@@ -127,6 +130,47 @@ class TokenInputAdapterWithRotarySupport(TokenInputAdapter):
             abs_pos = positions(x.shape[0], x.shape[1])
         frq = frequency_position_encoding(abs_pos, self.rotated_channels_per_head)
         return embedded, frq
+
+    def embed_compact(self, x: jnp.ndarray, keep_idx: jnp.ndarray, prefix_len: int):
+        """Embed the compact ``[kept-prefix; latents]`` sequence directly from
+        token ids — the prefix-dropout selection applied *before* embedding.
+
+        ``x`` (B, N) token ids with statically un-padded positions
+        (``arange(N)``); ``keep_idx`` (B, K) sorted unique prefix keep set.
+        Returns ``(embedded, frq)`` of length ``K + (N - prefix_len)`` —
+        bitwise the rows the full-length ``__call__(x, None)`` embedding
+        would yield at ``[keep_idx; prefix_len..N)``, because embedding is a
+        per-position table lookup and gather-then-add == add-then-gather.
+
+        The point is the backward: the full-length (B, N, C) embedding and
+        its dropout row-gather never materialize, so the gather's
+        inverse-gather VJP (~0.8 ms/step at the 16k flagship) disappears.
+        What remains is the token one-hot contraction over the *compact*
+        row count and a position-table VJP whose feature rows are gathered,
+        not scattered (ops/gathers.gather_table_rows — index-map inversion
+        via two tiny int scatters). Semantics: reference modules.py:809-830.
+        """
+        b, n = x.shape[0], x.shape[1]
+        ids_kept = jnp.take_along_axis(x[:, :prefix_len], keep_idx, axis=1)
+        ids = jnp.concatenate([ids_kept, x[:, prefix_len:]], axis=1)
+        tok = self._tokens(ids)
+        if self.abs_pos_emb:
+            from perceiver_io_tpu.ops.gathers import gather_table_rows
+
+            pos_full = self._pos_slice(n)  # (N, C), pad-backward slice
+            pos_kept = gather_table_rows(pos_full[:prefix_len], keep_idx)
+            pos_latent = jnp.broadcast_to(
+                pos_full[prefix_len:][None], (b, n - prefix_len, pos_full.shape[1])
+            )
+            emb = tok + jnp.concatenate([pos_kept, pos_latent], axis=1)
+        else:
+            emb = tok
+        pos_latent_idx = jnp.broadcast_to(
+            jnp.arange(prefix_len, n, dtype=keep_idx.dtype)[None], (b, n - prefix_len)
+        )
+        abs_pos = jnp.concatenate([keep_idx, pos_latent_idx], axis=1)
+        frq = frequency_position_encoding(abs_pos, self.rotated_channels_per_head)
+        return emb, frq
 
 
 class ClassificationOutputAdapter(nn.Module):

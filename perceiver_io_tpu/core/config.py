@@ -96,7 +96,7 @@ class PerceiverARConfig(_CreateMixin):
     self_attention_widening_factor: int = 4
     cross_attention_widening_factor: int = 4
     cross_attention_dropout: float = 0.5
-    prefix_dropout_mode: str = "gather"  # "gather" | "mask", see PerceiverAR
+    prefix_dropout_mode: str = "gather"  # "gather" | "gather_embed" | "mask", see PerceiverAR
     post_attention_dropout: float = 0.0
     residual_dropout: float = 0.0
     activation_checkpointing: bool = False

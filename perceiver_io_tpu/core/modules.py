@@ -808,11 +808,16 @@ class PerceiverAR(nn.Module):
     self_attention_widening_factor: int = 4
     cross_attention_widening_factor: int = 4
     cross_attention_dropout: float = 0.5
-    # "gather" (default): drop prefix positions by a static-count row gather —
-    # also shrinks the CA kernel's kv length by the dropped count. "mask":
-    # keep the full-length prefix and mask dropped positions out of the CA
-    # softmax (SURVEY §7.3) — numerically identical, measured slower at the
-    # 16k flagship (docs/performance.md round-4 A/B).
+    # "gather" (default): drop prefix positions by a static-count selection —
+    # also shrinks the CA kernel's kv length by the dropped count. On the
+    # statically un-padded path with a token adapter the selection is applied
+    # to token ids / position-table rows BEFORE embedding ("compact" route,
+    # round 5); otherwise to embedded rows. "gather_embed": force the
+    # embedded-row gather everywhere (the round-4 implementation, kept as the
+    # reproducible A/B lever — docs/performance.md). "mask": keep the
+    # full-length prefix and mask dropped positions out of the CA softmax
+    # (SURVEY §7.3) — numerically identical, measured slower at the 16k
+    # flagship (docs/performance.md round-4 A/B).
     prefix_dropout_mode: str = "gather"
     post_attention_dropout: float = 0.0
     residual_dropout: float = 0.0
@@ -822,7 +827,7 @@ class PerceiverAR(nn.Module):
     dtype: jnp.dtype = jnp.float32
 
     def setup(self):
-        if self.prefix_dropout_mode not in ("gather", "mask"):
+        if self.prefix_dropout_mode not in ("gather", "gather_embed", "mask"):
             raise ValueError(f"unknown prefix_dropout_mode: {self.prefix_dropout_mode!r}")
         num_channels = self.input_adapter.num_input_channels
         cross_attn_cls = _remat(
@@ -926,6 +931,46 @@ class PerceiverAR(nn.Module):
         if not 0 <= prefix_len < n:
             raise ValueError(f"prefix_len ({prefix_len}) out of valid range [0..{n})")
 
+        dropout_active = (
+            not deterministic and prefix_len > 0 and self.cross_attention_dropout > 0.0
+        )
+        # static keep count (training/prefix_dropout.prefix_keep_count)
+        keep = prefix_len - int(prefix_len * self.cross_attention_dropout)
+        if dropout_active and prefix_keep_idx is not None:
+            if prefix_keep_idx.shape[-1] != keep:
+                raise ValueError(
+                    f"prefix_keep_idx carries {prefix_keep_idx.shape[-1]} indices; "
+                    f"this config keeps {keep} of {prefix_len} prefix positions"
+                )
+
+        # Compact route (default "gather" mode, statically un-padded input,
+        # token adapter): apply the dropout selection to token ids and
+        # position-table rows BEFORE embedding, so the full-length (B, N, C)
+        # embedding and its row-gather (forward + inverse-gather backward,
+        # ~1.2 ms/step at the 16k flagship) never exist. Numerically the
+        # embedded-row gather below: embedding is a per-position lookup, so
+        # gather-then-embed == embed-then-gather row for row.
+        if (
+            dropout_active
+            and self.prefix_dropout_mode == "gather"
+            and pad_mask is None
+            and hasattr(self.input_adapter, "embed_compact")
+        ):
+            if prefix_keep_idx is not None:
+                keep_idx = prefix_keep_idx
+            else:
+                rand = jax.random.uniform(self.make_rng("dropout"), (b, prefix_len))
+                _, keep_idx = lax.top_k(rand, keep)
+                keep_idx = jnp.sort(keep_idx, axis=-1)
+            x_emb, frq = self.input_adapter.embed_compact(x, keep_idx, prefix_len)
+            x_prefix, x_latent = x_emb[:, :keep], x_emb[:, keep:]
+            frq_prefix, frq_latent = frq[:, :keep], frq[:, keep:]
+            return self._attend(
+                x_latent, x_prefix, frq_latent, frq_prefix,
+                pad_latent=None, pad_prefix=None,
+                kv_cache=kv_cache, deterministic=deterministic,
+            )
+
         # pad_mask None statically means positions are arange(n) — the adapter
         # then embeds positions via a table slice (scatter-free backward)
         if pad_mask is None:
@@ -939,21 +984,15 @@ class PerceiverAR(nn.Module):
         x_latent, x_prefix = x_emb[:, prefix_len:], x_emb[:, :prefix_len]
         frq_latent, frq_prefix = frq[:, prefix_len:], frq[:, :prefix_len]
 
-        if not deterministic and prefix_len > 0 and self.cross_attention_dropout > 0.0:
+        if dropout_active:
             # Static-count prefix dropout: keep `keep` positions, chosen
             # uniformly, order preserved (reference: modules.py:809-830).
-            keep = prefix_len - int(prefix_len * self.cross_attention_dropout)
             if prefix_keep_idx is not None:
-                if prefix_keep_idx.shape[-1] != keep:
-                    raise ValueError(
-                        f"prefix_keep_idx carries {prefix_keep_idx.shape[-1]} indices; "
-                        f"this config keeps {keep} of {prefix_len} prefix positions"
-                    )
                 keep_idx, rand = prefix_keep_idx, None
             else:
                 rand = jax.random.uniform(self.make_rng("dropout"), (b, prefix_len))
                 keep_idx = None
-                if self.prefix_dropout_mode == "gather":
+                if self.prefix_dropout_mode != "mask":
                     _, keep_idx = lax.top_k(rand, keep)
                     keep_idx = jnp.sort(keep_idx, axis=-1)
 
@@ -988,6 +1027,18 @@ class PerceiverAR(nn.Module):
                 if pad_prefix is not None:
                     pad_prefix = jnp.take_along_axis(pad_prefix, keep_idx, axis=1)
 
+        return self._attend(
+            x_latent, x_prefix, frq_latent, frq_prefix,
+            pad_latent=pad_latent, pad_prefix=pad_prefix,
+            kv_cache=kv_cache, deterministic=deterministic,
+        )
+
+    def _attend(
+        self, x_latent, x_prefix, frq_latent, frq_prefix,
+        *, pad_latent, pad_prefix, kv_cache, deterministic,
+    ) -> BlockOutput:
+        """Cross-attention over [prefix; latents] + the latent self-attention
+        stack — the shared tail of both `_forward` embedding routes."""
         rope_q = frq_latent
         rope_k_ca = jnp.concatenate([frq_prefix, frq_latent], axis=1)
         pad_ca = None if pad_prefix is None else jnp.concatenate([pad_prefix, pad_latent], axis=1)

@@ -106,16 +106,22 @@ def _gur_fwd(x, idx):
     return jnp.take_along_axis(x, idx[..., None], axis=1), (idx, x.shape)
 
 
-def _gur_bwd(res, g):
-    idx, x_shape = res
-    b, n, _ = x_shape
-    k = idx.shape[1]
-    # invert the (unique) index map: inv[j] = position of row j in the keep
-    # set (tiny int32 scatter), kept[j] = whether row j was selected
+def _invert_idx(idx: jnp.ndarray, n: int):
+    """Invert a (B, K) unique-per-row index map over rows [0, n): ``inv[b, j]``
+    = position of row j in ``idx[b]`` (two tiny int32 scatters), ``kept[b, j]``
+    = whether row j was selected."""
+    b, k = idx.shape
     inv = jnp.zeros((b, n), jnp.int32)
     inv = jax.vmap(lambda i, v: i.at[v].set(jnp.arange(k, dtype=jnp.int32)))(inv, idx)
     kept = jnp.zeros((b, n), bool)
     kept = jax.vmap(lambda m, v: m.at[v].set(True))(kept, idx)
+    return inv, kept
+
+
+def _gur_bwd(res, g):
+    idx, x_shape = res
+    b, n, _ = x_shape
+    inv, kept = _invert_idx(idx, n)
     d_x = jnp.take_along_axis(g, inv[..., None], axis=1)
     d_x = jnp.where(kept[..., None], d_x, 0)
     return d_x, _int_zero(idx)
@@ -129,3 +135,47 @@ def gather_rows(x: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
     if _PLAIN_MODE.get():
         return jnp.take_along_axis(x, idx[..., None], axis=1)
     return gather_unique_rows(x, idx)
+
+
+# ------------------------------------------------- shared-table row gathers
+
+
+@jax.custom_vjp
+def gather_sorted_table_rows(table: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """``table[idx]`` for a (N, C) table shared across the batch and (B, K)
+    **sorted unique-per-row** indices, with a scatter-free backward.
+
+    The gradient w.r.t. the table is ``d_table[p] = sum_b sum_k
+    [idx[b,k]==p] g[b,k]``. Because each row of ``idx`` is unique, the
+    big-tensor scatter-add becomes invert-the-index-map (two tiny int
+    scatters, as in :func:`gather_unique_rows`) + a row gather + a batch
+    sum — the gradient never scatters feature rows. (A searchsorted-based
+    membership test was tried first and rejected: XLA lowers it to a
+    13-iteration sequential while-loop of element gathers, 4.2 ms/step at
+    the 16k flagship vs ~0.1 ms for the int scatters.) Used by the compact
+    prefix-dropout embedding (core/adapter.py ``embed_compact``) where
+    ``idx`` is the dropout keep set over position-table rows."""
+    return jnp.take(table, idx, axis=0)
+
+
+def _gstr_fwd(table, idx):
+    return jnp.take(table, idx, axis=0), (idx, table.shape[0])
+
+
+def _gstr_bwd(res, g):
+    idx, n = res
+    inv, kept = _invert_idx(idx, n)
+    d_b = jnp.take_along_axis(g, inv[..., None], axis=1)  # (B, N, C)
+    d_table = jnp.where(kept[..., None], d_b, 0).sum(axis=0)
+    return d_table, _int_zero(idx)
+
+
+gather_sorted_table_rows.defvjp(_gstr_fwd, _gstr_bwd)
+
+
+def gather_table_rows(table: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """`gather_sorted_table_rows` unless tracing inside :func:`plain_gathers`
+    (the plain ``take`` keeps shard_map's varying-axes check happy)."""
+    if _PLAIN_MODE.get():
+        return jnp.take(table, idx, axis=0)
+    return gather_sorted_table_rows(table, idx)

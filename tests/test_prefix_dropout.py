@@ -11,6 +11,11 @@ random static-count keep subset):
   masked out of the CA softmax (SURVEY §7.3).
 - ``prefix_keep_idx=...``: the subset drawn on the host
   (training.prefix_dropout) instead of in-graph.
+
+"gather" on statically un-padded input takes the round-5 *compact* route
+(selection applied to token ids / position-table rows before embedding —
+core/adapter.py ``embed_compact``); ``prefix_dropout_mode="gather_embed"``
+pins the round-4 embedded-row gather, and the two must agree bitwise.
 """
 
 import jax
@@ -110,6 +115,70 @@ def test_gather_and_mask_agree_on_explicit_idx():
         rngs={"dropout": jax.random.PRNGKey(0)},
     )
     np.testing.assert_allclose(out_g.logits, out_m.logits, atol=1e-5)
+
+
+def test_compact_matches_embedded_gather_bitwise():
+    """The compact route (selection before embedding) must reproduce the
+    embedded-row gather exactly — gather-then-embed == embed-then-gather is
+    pure row selection, so values AND grads agree bitwise."""
+    rng = np.random.default_rng(6)
+    x = _batchish(rng)
+    compact = CausalLanguageModel(_config())  # "gather" → compact (no pad)
+    legacy = CausalLanguageModel(_config(prefix_dropout_mode="gather_embed"))
+    params = compact.init(jax.random.PRNGKey(0), x, prefix_len=16)
+    idx = jnp.asarray(sample_prefix_keep_idx(np.random.default_rng(5), 3, 16, 0.5))
+
+    def loss(model):
+        def f(p):
+            out = model.apply(
+                p, x, prefix_len=16, deterministic=False, prefix_keep_idx=idx,
+                rngs={"dropout": jax.random.PRNGKey(7)},
+            )
+            return (out.logits.astype(jnp.float32) ** 2).mean()
+
+        return f
+
+    l_c, g_c = jax.value_and_grad(loss(compact))(params)
+    l_l, g_l = jax.value_and_grad(loss(legacy))(params)
+    assert l_c == l_l
+    for a, b in zip(jax.tree.leaves(g_c), jax.tree.leaves(g_l)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # in-graph draw: same rng → same keep set → identical logits across routes
+    out_c = compact.apply(
+        params, x, prefix_len=16, deterministic=False, rngs={"dropout": jax.random.PRNGKey(9)}
+    )
+    out_l = legacy.apply(
+        params, x, prefix_len=16, deterministic=False, rngs={"dropout": jax.random.PRNGKey(9)}
+    )
+    np.testing.assert_array_equal(np.asarray(out_c.logits), np.asarray(out_l.logits))
+
+
+def test_gather_with_pad_mask_falls_back_and_agrees_with_mask_mode():
+    """With a pad mask the compact route does not apply (positions are not
+    statically arange); "gather" must fall back to the embedded-row gather
+    and still agree with mask mode on the same keep set."""
+    rng = np.random.default_rng(8)
+    x = _batchish(rng)
+    pad = np.zeros((3, 24), bool)
+    pad[0, :3] = True  # left padding
+    pad[1, :1] = True
+    pad_mask = jnp.asarray(pad)
+    gather = CausalLanguageModel(_config())
+    mask = CausalLanguageModel(_config(prefix_dropout_mode="mask"))
+    params = gather.init(jax.random.PRNGKey(0), x, prefix_len=16)
+    idx = jnp.asarray(sample_prefix_keep_idx(np.random.default_rng(5), 3, 16, 0.5))
+    out_g = gather.apply(
+        params, x, prefix_len=16, pad_mask=pad_mask, deterministic=False,
+        prefix_keep_idx=idx, rngs={"dropout": jax.random.PRNGKey(0)},
+    )
+    out_m = mask.apply(
+        params, x, prefix_len=16, pad_mask=pad_mask, deterministic=False,
+        prefix_keep_idx=idx, rngs={"dropout": jax.random.PRNGKey(0)},
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_g.logits), np.asarray(out_m.logits), atol=1e-5
+    )
 
 
 def test_keep_idx_wrong_count_raises():

@@ -114,6 +114,7 @@ def main():
     # the driver actually measures
     p.add_argument("--microbatch", type=int, default=2)
     p.add_argument("--dropout-sampling", choices=["host", "graph"], default="host")
+    p.add_argument("--dropout-mode", choices=["gather", "gather_embed", "mask"], default="gather")
     p.add_argument("--moment-dtype", choices=["float32", "bfloat16"], default="bfloat16")
     args = p.parse_args()
 
@@ -129,6 +130,7 @@ def main():
     from perceiver_io_tpu.training.loop import make_train_step
 
     config = flagship_config(args.seq_len, args.latents)
+    config.prefix_dropout_mode = args.dropout_mode
     model = CausalLanguageModel(config, dtype=jnp.bfloat16)
     b, n = args.batch_size, args.seq_len
     rng = np.random.default_rng(0)

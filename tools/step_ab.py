@@ -2,15 +2,19 @@
 the flagship train step (cross-process comparisons drift 1.5-1.8x with the
 chip clock — docs/performance.md):
 
-- ``graph``   — in-graph prefix-dropout draw (top_k + sort) + row gather
-                (the round-3 default)
+- ``graph``   — in-graph prefix-dropout draw (top_k + sort)
 - ``host``    — keep set sampled on the host, fed as ``prefix_keep_idx``
-                (training/prefix_dropout.py); device runs only the gather
+                (training/prefix_dropout.py)
 - ``mask``    — keep-mask form (SURVEY §7.3): full-length prefix, dropped
                 positions masked in the CA softmax (prefix_dropout_mode)
 - ``bf16m``   — in-graph draw + bf16 Adam moment storage
                 (optim.scale_by_adam_compact)
 - ``host+bf16m`` — both levers
+
+Since round 5, gather variants take the COMPACT route (selection before
+embedding — the current default); append ``_embed`` to any variant name
+(e.g. ``host+bf16m_embed``) to pin the round-4 embedded-row gather that the
+historical numbers in docs/performance.md were measured on.
 
     python tools/step_ab.py [--batch-size 4] [--steps 20] [--microbatch 2]
 """
@@ -65,7 +69,14 @@ def main():
     keep_idx = jnp.asarray(sample_prefix_keep_idx(rng, b, prefix_len, 0.5))
 
     def build(variant):
-        mode = "mask" if variant == "mask" else "gather"
+        # "…_embed" forces the round-4 embedded-row gather (prefix_dropout_mode
+        # "gather_embed"); plain gather variants take the round-5 compact route
+        if variant == "mask":
+            mode = "mask"
+        elif variant.endswith("_embed"):
+            mode = "gather_embed"
+        else:
+            mode = "gather"
         config = flagship_config(args.seq_len, args.latents)
         config.prefix_dropout_mode = mode
         model = CausalLanguageModel(config, dtype=jnp.bfloat16)
