@@ -93,7 +93,13 @@ def robust_slope(run, n_short: int, n_long: int, estimates: int = 3, reps: int =
     corrupted estimate (and a negative slope would report garbage
     throughput). Non-positive estimates are dropped outright. A
     single-estimate version of this measurement has been observed 20x off
-    during a multi-second tunnel stall."""
+    during a multi-second tunnel stall.
+
+    API asymmetry with :func:`interleaved_slopes` (intentional): this
+    single-run form RAISES when every estimate is non-positive, while the
+    multi-variant form returns ``None`` for the affected variant (one bad
+    variant must not void the others' measurements); callers of the
+    multi-variant form must handle ``None``."""
     run(n_short)  # compile
     run(n_long)
     slopes = []
@@ -339,16 +345,23 @@ def decode_bench(args):
     # (the torch reference has no quantized inference), so — like the int8
     # cache — int8 weights RAISE the bandwidth cap.
     if weight_dtype is not None:
-        # shape arithmetic only (same selection rule as quantize_weights:
-        # 2D+ leaves named "kernel" → 1 byte/elem + one f32 scale per
-        # output channel; everything else stays at model dtype)
-        def leaf_bytes(path, x):
-            if getattr(path[-1], "key", None) == "kernel" and x.ndim >= 2:
-                return x.size + x.shape[-1] * 4
+        # account the bytes from the ACTUAL quantized tree (ADVICE r4: an
+        # inline reimplementation of the selection rule would silently
+        # diverge if quantize_weights ever changed), evaluated shape-only
+        # via eval_shape — no device work
+        from perceiver_io_tpu.ops.quant import QuantizedTensor, quantize_weights
+
+        qtree = jax.eval_shape(quantize_weights, params)
+
+        def leaf_bytes(x):
+            if isinstance(x, QuantizedTensor):
+                return x.q.size * x.q.dtype.itemsize + x.scale.size * x.scale.dtype.itemsize
             return x.size * dsize
 
-        leaves = jax.tree_util.tree_leaves_with_path(params)
-        weight_bytes_chip = sum(leaf_bytes(p, x) for p, x in leaves)
+        weight_bytes_chip = sum(
+            leaf_bytes(x)
+            for x in jax.tree.leaves(qtree, is_leaf=lambda x: isinstance(x, QuantizedTensor))
+        )
     else:
         weight_bytes_chip = n_params * dsize
     chip_bytes = weight_bytes_chip + b * (ca_window_chip + sa_windows_chip)

@@ -39,9 +39,15 @@ def _cached_generate_fn(
     """Memoized jitted generation per sampling settings — the eager path
     costs ~20x per token on TPU (see make_generate_fn). Prompt-shape
     specialization is jit's own job; keying on it here would only duplicate
-    wrapper objects. Storage dtypes are constructor-fixed per pipeline, so
-    the key stays sampling-settings only."""
-    key = (num_latents, *dataclasses.astuple(gen_config))
+    wrapper objects. The storage dtypes ride in the key (ADVICE r4: they
+    are plain mutable pipeline attributes, and a mutation after a first
+    call must not serve a stale compiled fn)."""
+    key = (
+        num_latents,
+        jnp.dtype(cache_dtype).name,
+        None if weight_dtype is None else jnp.dtype(weight_dtype).name,
+        *dataclasses.astuple(gen_config),
+    )
     if key not in cache:
         cache[key] = make_generate_fn(
             model, num_latents, gen_config, cache_dtype=cache_dtype, weight_dtype=weight_dtype
