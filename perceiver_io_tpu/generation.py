@@ -25,6 +25,8 @@ roll-based slide (its window never exceeds ``max_seq_len``).
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 from dataclasses import dataclass
 from typing import Optional, Tuple
 
@@ -68,6 +70,85 @@ def _maybe_dequantize_weights(decode_params, compute_dtype):
     from perceiver_io_tpu.ops.quant import dequantize_weights
 
     return dequantize_weights(decode_params, compute_dtype)
+
+
+# LayerNorm scale/bias, projection biases, int8 scale planes — everything at
+# or under this element count rides the packed buffer
+_PACK_MAX_SIZE = 4096
+
+# trace-time lever (tools/decode_ab.py): None = auto — pack at batch >= 4,
+# where the scan's schedule-spread dominates (measured bf16 A/B: +12.5%
+# tok/s at b=8, +2.5% at b=4, -8% at b=1 — at batch 1 the loop is
+# latency-bound and the barrier serializes staging that previously
+# prefetched concurrently). True/False force.
+_PACK_SMALL = contextvars.ContextVar("generation_pack_small", default=None)
+_PACK_MIN_BATCH = 4
+
+
+@contextlib.contextmanager
+def pack_small_params(mode: Optional[bool]):
+    """Scoped toggle for the decode scan's small-parameter packing
+    (None = batch-size auto).
+
+    Read at **trace time** (the same contract as
+    ops.flash_attention.default_flash): a function already compiled by
+    ``make_generate_fn``/``jax.jit`` keeps whatever mode it was traced
+    with, and calling it inside this context has no effect. Build AND
+    first-call the generate fn inside the block (tools/decode_ab.py shows
+    the pattern)."""
+    token = _PACK_SMALL.set(mode)
+    try:
+        yield
+    finally:
+        _PACK_SMALL.reset(token)
+
+
+def _pack_enabled(batch_size: int) -> bool:
+    mode = _PACK_SMALL.get()
+    return batch_size >= _PACK_MIN_BATCH if mode is None else mode
+
+
+def _pack_small_params(params, max_size: int = _PACK_MAX_SIZE):
+    """Consolidate the tree's small float leaves into ONE flat f32 buffer.
+
+    The decode scan body reads dozens of tiny loop-invariant parameter
+    buffers (LayerNorm scales/biases, projection biases — f32[512], 2 KB
+    each); each one costs the scheduler a separate VMEM staging copy every
+    iteration (profiled: the dominant slice of the b=8 bf16 decode's ~12%
+    gap to its bandwidth floor, docs/performance.md). Packing them into one
+    buffer turns N copy-starts into one; the body re-slices views out of
+    the staged buffer (VMEM-cheap).
+
+    Returns ``(packed, unpack)`` with ``unpack(packed)`` rebuilding the full
+    tree (the large leaves ride in ``unpack``'s closure unchanged), or
+    ``(None, None)`` when nothing qualifies. ``unpack`` pins the buffer
+    behind an ``optimization_barrier`` so LICM cannot hoist the slices back
+    out of the loop into N separate buffers (which would undo the
+    consolidation).
+    """
+    flat, treedef = jax.tree_util.tree_flatten(params)
+    meta = []  # (flat index, shape, dtype, offset, size)
+    offset = 0
+    for i, x in enumerate(flat):
+        if (
+            hasattr(x, "dtype")
+            and jnp.issubdtype(x.dtype, jnp.floating)
+            and x.size <= max_size
+        ):
+            meta.append((i, x.shape, x.dtype, offset, x.size))
+            offset += x.size
+    if not meta:
+        return None, None
+    packed = jnp.concatenate([flat[i].astype(jnp.float32).reshape(-1) for i, *_ in meta])
+
+    def unpack(packed):
+        packed = lax.optimization_barrier(packed)
+        new = list(flat)
+        for i, shape, dtype, off, size in meta:
+            new[i] = packed[off : off + size].reshape(shape).astype(dtype)
+        return jax.tree_util.tree_unflatten(treedef, new)
+
+    return packed, unpack
 
 
 def _shift_left_if_full(cache: KVCache) -> KVCache:
@@ -226,10 +307,15 @@ def beam_search(
     batch_base = jnp.repeat(jnp.arange(b) * num_beams, num_beams)  # (bb,)
 
     decode_params, compute_dtype = _maybe_quantize_weights(model, params, weight_dtype)
+    if _pack_enabled(b * num_beams):
+        packed_small, unpack_small = _pack_small_params(decode_params)
+    else:
+        packed_small = unpack_small = None
 
     def step(carry, t):
         cache, seqs, beam_scores, token, done = carry
-        step_params = _maybe_dequantize_weights(decode_params, compute_dtype)
+        dp = decode_params if unpack_small is None else unpack_small(packed_small)
+        step_params = _maybe_dequantize_weights(dp, compute_dtype)
         # slide the self-attention windows when full, exactly as generate()
         # does (the CA cache cannot fill — validated above); positions keep
         # counting from the CA length, so beams stay aligned
@@ -396,10 +482,15 @@ def generate(
     sa_idx = jnp.arange(sa_capacity, dtype=jnp.int32)[None, :]
 
     decode_params, compute_dtype = _maybe_quantize_weights(model, params, weight_dtype)
+    if _pack_enabled(b):
+        packed_small, unpack_small = _pack_small_params(decode_params)
+    else:
+        packed_small = unpack_small = None
 
     def step(carry, _):
         cache, ca_start, sa_start, token, rng, done = carry
-        params = _maybe_dequantize_weights(decode_params, compute_dtype)
+        dp = decode_params if unpack_small is None else unpack_small(packed_small)
+        params = _maybe_dequantize_weights(dp, compute_dtype)
         ca_cache, sa_caches = cache[0], cache[1:]
 
         # slide: expire the oldest latent when the SA window is full, the

@@ -283,3 +283,46 @@ class TestBeamSearch:
             hits = np.nonzero(row == 3)[0]
             if hits.size:  # everything after the first EOS must be PAD
                 assert (row[hits[0] + 1 :] == 0).all()
+
+
+def test_packed_small_params_token_exact(model_and_params):
+    """The decode scan's small-parameter packing (round 5: one consolidated
+    f32 buffer re-sliced in the scan body) must be token-exact vs the
+    unpacked tree — the f32 pack/slice round-trip is bitwise — in both the
+    plain and int8-weight regimes."""
+    from perceiver_io_tpu.generation import pack_small_params
+
+    model, params = model_and_params
+    p = prompt(16)
+    cfg = GenerationConfig(max_new_tokens=6, do_sample=True, top_k=5)
+    for wd in (None, jnp.int8):
+        with pack_small_params(True):
+            on = np.asarray(
+                generate(model, params, p, num_latents=4, config=cfg,
+                         rng=jax.random.PRNGKey(3), weight_dtype=wd)
+            )
+        with pack_small_params(False):
+            off = np.asarray(
+                generate(model, params, p, num_latents=4, config=cfg,
+                         rng=jax.random.PRNGKey(3), weight_dtype=wd)
+            )
+        np.testing.assert_array_equal(on, off)
+
+
+def test_packed_small_params_beam_search_exact(model_and_params):
+    """beam_search carries its own copy of the packing wiring — pin its
+    sequence/score exactness too (packing auto-engages at
+    batch*num_beams >= 4 in production beam decoding)."""
+    from perceiver_io_tpu.generation import beam_search, pack_small_params
+
+    model, params = model_and_params
+    p = prompt(12)
+    out = {}
+    for mode in (True, False):
+        with pack_small_params(mode):
+            seqs, scores = beam_search(
+                model, params, p, num_latents=4, num_beams=3, max_new_tokens=6
+            )
+        out[mode] = (np.asarray(seqs), np.asarray(scores))
+    np.testing.assert_array_equal(out[True][0], out[False][0])
+    np.testing.assert_array_equal(out[True][1], out[False][1])

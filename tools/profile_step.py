@@ -41,7 +41,8 @@ def decode_profile(args):
     gen = make_generate_fn(
         model, args.latents,
         GenerationConfig(max_new_tokens=args.steps, do_sample=True, top_k=10),
-        cache_dtype=jnp.bfloat16,
+        cache_dtype=jnp.int8 if args.cache_dtype == "int8" else jnp.bfloat16,
+        weight_dtype=jnp.int8 if args.weight_dtype == "int8" else None,
     )
     float(gen(params, prompt)[0, -1])  # compile + warm
     jax.profiler.start_trace(args.out)
@@ -115,6 +116,8 @@ def main():
     p.add_argument("--microbatch", type=int, default=2)
     p.add_argument("--dropout-sampling", choices=["host", "graph"], default="host")
     p.add_argument("--dropout-mode", choices=["gather", "gather_embed", "mask"], default="gather")
+    p.add_argument("--cache-dtype", choices=["model", "int8"], default="model")
+    p.add_argument("--weight-dtype", choices=["model", "int8"], default="model")
     p.add_argument("--moment-dtype", choices=["float32", "bfloat16"], default="bfloat16")
     args = p.parse_args()
 
