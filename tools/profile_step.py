@@ -106,14 +106,14 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--seq-len", type=int, default=16384)
     p.add_argument("--latents", type=int, default=1024)
-    p.add_argument("--batch-size", type=int, default=4)
+    p.add_argument("--batch-size", type=int, default=32)
     p.add_argument("--steps", type=int, default=5)
     p.add_argument("--top", type=int, default=40)
     p.add_argument("--out", default="/tmp/prof_step")
     p.add_argument("--mode", choices=["train", "decode", "img"], default="train")
-    # match the bench.py round-4 defaults so the profile reflects the step
-    # the driver actually measures
-    p.add_argument("--microbatch", type=int, default=2)
+    # match the bench.py round-5 defaults (b32 in 8 chunks of 4) so the
+    # profile reflects the step the driver actually measures
+    p.add_argument("--microbatch", type=int, default=8)
     p.add_argument("--dropout-sampling", choices=["host", "graph"], default="host")
     p.add_argument("--dropout-mode", choices=["gather", "gather_embed", "mask"], default="gather")
     p.add_argument("--cache-dtype", choices=["model", "int8"], default="model")
