@@ -116,3 +116,18 @@ def test_interleaved_all_stalled_variant_returns_none(clock):
     meds = bench.interleaved_slopes(runs, 2, 22, estimates=3, reps=2)
     assert meds["a"] == pytest.approx(0.005, rel=1e-9)
     assert meds["b"] is None
+
+
+def test_auto_microbatch_always_divides():
+    """The derived chunk count must divide every batch size (an indivisible
+    pair silently disables chunking in the train path) and prefer chunks of
+    4 where possible."""
+    for b in range(1, 65):
+        mb = bench.auto_microbatch(b)
+        assert b % mb == 0, (b, mb)
+        chunk = b // mb
+        assert chunk in (1, 2, 4), (b, mb)
+        if b % 4 == 0:
+            assert chunk == 4, (b, mb)
+        elif b % 2 == 0:
+            assert chunk == 2, (b, mb)
