@@ -78,9 +78,9 @@ _PACK_MAX_SIZE = 4096
 
 # trace-time lever (tools/decode_ab.py): None = auto — pack at batch >= 4,
 # where the scan's schedule-spread dominates (measured bf16 A/B: +12.5%
-# tok/s at b=8, +2.5% at b=4, -8% at b=1 — at batch 1 the loop is
-# latency-bound and the barrier serializes staging that previously
-# prefetched concurrently). True/False force.
+# tok/s at b=8, +2.5% at b=4, -30% at b=2, -8% at b=1 — below the boundary
+# the loop is latency-bound and the barrier serializes staging that
+# previously prefetched concurrently). True/False force.
 _PACK_SMALL = contextvars.ContextVar("generation_pack_small", default=None)
 _PACK_MIN_BATCH = 4
 
