@@ -22,6 +22,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# the analytic Perceiver AR step-FLOPs model (reference: scaling/flops.py:7-88)
+# lives in utils/flops.py so the trainer's MFU telemetry shares it; re-exported
+# here for tools/perf_probe.py and historical callers
+from perceiver_io_tpu.utils.flops import train_step_flops  # noqa: F401
+from perceiver_io_tpu.utils.profiling import StepTimer, percentile
+
 # --- analytic-baseline assumptions (documented in BASELINE.md) -------------
 # The reference publishes no throughput numbers, so vs_baseline compares
 # against an ANALYTIC single-A100 estimate. Compute-bound modes assume the
@@ -65,11 +71,16 @@ def _enable_compile_cache():
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 
-def scan_step_time(step, state, batch, steps: int) -> float:
+def scan_step_time(step, state, batch, steps: int, timer: "StepTimer" = None) -> float:
     """Sustained per-step time of a train step: the whole k-step chain runs
     inside ONE jitted ``lax.scan`` (single dispatch — per-call latency through
     the axon tunnel has multi-ms jitter) and the step time is the
-    ``robust_slope`` between two chain lengths, so fixed costs cancel."""
+    ``robust_slope`` between two chain lengths, so fixed costs cancel.
+
+    ``timer``: optional ``StepTimer`` fed per-call wall times of the
+    already-compiled 2-step chain after the slope measurement — callers
+    divide by :data:`TIMER_CHAIN` for an approximate per-step distribution
+    (dispatch overhead included; the slope stays the headline number)."""
 
     @functools.partial(jax.jit, static_argnums=2)
     def run(state, batch, k):
@@ -80,10 +91,45 @@ def scan_step_time(step, state, batch, steps: int) -> float:
         _, losses = jax.lax.scan(body, state, None, length=k)
         return losses[-1]
 
-    return robust_slope(lambda k: float(run(state, batch, k)), 2, 2 + steps)
+    slope = robust_slope(lambda k: float(run(state, batch, k)), TIMER_CHAIN, TIMER_CHAIN + steps)
+    if timer is not None:
+        timer.start()
+        for _ in range(TIMER_REPS):
+            float(run(state, batch, TIMER_CHAIN))
+            timer.tick()
+    return slope
 
 
-def robust_slope(run, n_short: int, n_long: int, estimates: int = 3, reps: int = 4) -> float:
+# chain length / repetitions for the supplementary StepTimer percentile
+# summary (compiled programs only — the short chain robust_slope already built)
+TIMER_CHAIN = 2
+TIMER_REPS = 7  # warmup=1 discard leaves 6 samples
+
+
+def telemetry_fields(flops, step_time, step_times_s=None, times_key: str = "step_ms") -> dict:
+    """The ``telemetry`` block every bench result carries: device kind, MFU
+    against the obs.mfu per-device peak-FLOPs table (None off the table),
+    and a p50/p90/p99 summary of individual wall times when provided
+    (``step_times_s`` already normalized to per-step/per-token seconds)."""
+    from perceiver_io_tpu.obs.mfu import device_peak_flops
+
+    t = {"device_kind": jax.devices()[0].device_kind}
+    if flops is not None:
+        peak = device_peak_flops()
+        rate = flops / step_time
+        t["model_flops_per_sec"] = round(rate, 3)
+        t["peak_flops_per_device"] = peak
+        t["mfu"] = round(rate / peak, 4) if peak else None
+    if step_times_s:
+        t[times_key] = {
+            f"p{p}": round(percentile(step_times_s, p) * 1e3, 3) for p in (50, 90, 99)
+        }
+    return {"telemetry": t}
+
+
+def robust_slope(
+    run, n_short: int, n_long: int, estimates: int = 3, reps: int = 4, pair_sink=None
+) -> float:
     """Per-iteration time as the slope between two chain lengths, hardened
     against axon-tunnel jitter: short/long timings are interleaved (so clock
     drift hits both), min-reduced per estimate, and the **median** of several
@@ -108,10 +154,19 @@ def robust_slope(run, n_short: int, n_long: int, estimates: int = 3, reps: int =
         for _ in range(reps):
             t0 = time.perf_counter()
             run(n_short)
-            t_short = min(t_short, time.perf_counter() - t0)
+            dt_short = time.perf_counter() - t0
+            t_short = min(t_short, dt_short)
             t0 = time.perf_counter()
             run(n_long)
-            t_long = min(t_long, time.perf_counter() - t0)
+            dt_long = time.perf_counter() - t0
+            t_long = min(t_long, dt_long)
+            if pair_sink is not None and dt_long > dt_short:
+                # per-rep paired per-iteration sample (fixed costs cancel);
+                # telemetry percentiles come from these — no extra runs. A
+                # non-positive diff is a stall-corrupted rep: DROP it (as the
+                # slope estimates do), a clamped 0.0 would drag p50 toward an
+                # impossible zero latency
+                pair_sink.append((dt_long - dt_short) / (n_long - n_short))
         s = (t_long - t_short) / (n_long - n_short)
         if s > 0:
             slopes.append(s)
@@ -178,25 +233,6 @@ def flagship_config(seq_len: int, latents: int, remat: bool = False):
     )
 
 
-def train_step_flops(config, batch_size: int, prefix_dropout_keep: float) -> float:
-    """Analytic training FLOPs (fwd+bwd ~ 3x fwd matmuls), Perceiver AR cost
-    model: self-attention part over latents + cross-attention over the
-    (dropout-discounted) prefix (reference: scaling/flops.py:7-88)."""
-    lat, c, layers = config.max_latents, config.num_channels, config.num_self_attention_layers
-    prefix = (config.max_seq_len - lat) * prefix_dropout_keep
-    kv = prefix + lat
-    wf_sa, wf_ca = config.self_attention_widening_factor, config.cross_attention_widening_factor
-
-    # per-token matmul FLOPs (x2 for multiply-add)
-    ca_proj = 2 * lat * (4 * c * c) + 2 * prefix * (2 * c * c)  # q,o over latents; k,v over all kv
-    ca_attn = 2 * 2 * lat * kv * c
-    ca_mlp = 2 * lat * 2 * wf_ca * c * c
-    sa_proj = layers * 2 * lat * 4 * c * c
-    sa_attn = layers * 2 * 2 * lat * lat * c
-    sa_mlp = layers * 2 * lat * 2 * wf_sa * c * c
-    logits = 2 * lat * c * config.vocab_size
-    fwd = ca_proj + ca_attn + ca_mlp + sa_proj + sa_attn + sa_mlp + logits
-    return 3.0 * fwd * batch_size
 
 
 def image_bench(args):
@@ -247,7 +283,8 @@ def image_bench(args):
     state = TrainState.create(model.apply, params, tx, jax.random.PRNGKey(1))
     step = make_train_step(classification_loss_fn(model.apply), jit=False)
 
-    step_time = scan_step_time(step, state, batch, args.steps)
+    timer = StepTimer(warmup=1)
+    step_time = scan_step_time(step, state, batch, args.steps, timer=timer)
 
     # analytic step FLOPs (same style as train_step_flops): encoder CA over
     # the pixel array + the weight-shared SA stack; fwd+bwd ~ 3x fwd matmuls
@@ -278,6 +315,7 @@ def image_bench(args):
         "value": round(b / step_time, 2),
         "unit": "img/sec/chip",
         **_vs_baseline_fields(flops, step_time),
+        **telemetry_fields(flops, step_time, [t / TIMER_CHAIN for t in timer.steps]),
     }
     print(json.dumps(result))
     return result
@@ -315,7 +353,13 @@ def decode_bench(args):
     def run(k):
         return float(fns[k](params, prompt)[0, -1])
 
-    per_token = robust_slope(run, n_short, n_long)
+    # per-token distribution from the slope measurement's own PAIRED chains:
+    # every generate call re-runs the compute-bound prompt pass, so
+    # (t_long - t_short) / Δtokens cancels it — dividing one call by its
+    # token count would fold prefill/k into every "token" and contradict the
+    # slope headline, and re-running extra pairs would double bench time
+    token_times = []
+    per_token = robust_slope(run, n_short, n_long, pair_sink=token_times)
 
     # analytic A100 decode baseline: the decode hot loop is HBM-bandwidth
     # bound (reference loop: core/huggingface.py:158-185) — per-token traffic
@@ -386,6 +430,9 @@ def decode_bench(args):
         "vs_baseline": round(a100_step_time / per_token, 3),
         "vs_baseline_cap": round(a100_step_time / v5e_floor, 3),
         "ceiling_fraction": round(v5e_floor / per_token, 3),
+        # decode is bandwidth-bound: no MFU, but the per-token latency
+        # distribution (p50/p90/p99) rides along for serving comparisons
+        **telemetry_fields(None, per_token, token_times, times_key="token_ms"),
     }
     print(json.dumps(result))
     return result
@@ -637,7 +684,8 @@ def main():
         clm_loss_fn(model.apply, max_latents=args.latents), jit=False, microbatch=microbatch
     )
 
-    step_time = scan_step_time(step, state, batch, args.steps)
+    timer = StepTimer(warmup=1)
+    step_time = scan_step_time(step, state, batch, args.steps, timer=timer)
     tokens_per_sec = b * n / step_time
 
     # analytic A100 reference: same step FLOPs at MFU_BAR..MFU_LOW
@@ -650,6 +698,7 @@ def main():
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/sec/chip",
         **_vs_baseline_fields(flops, step_time),
+        **telemetry_fields(flops, step_time, [t / TIMER_CHAIN for t in timer.steps]),
     }
     print(json.dumps(result))
 

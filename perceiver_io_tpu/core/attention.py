@@ -307,9 +307,10 @@ class MultiHeadAttention(nn.Module):
         h = self.num_heads
         qk_per_head = self.qk_channels // h
 
-        q = self.q_proj(x_q)
-        k = self.k_proj(x_kv)
-        v = self.v_proj(x_kv)
+        with jax.named_scope("qkv_proj"):
+            q = self.q_proj(x_q)
+            k = self.k_proj(x_kv)
+            v = self.v_proj(x_kv)
 
         # Packed slots-major fused path: operands stay in the (B, N, H*D)
         # projection layout — the heads-major kernels below force a
@@ -340,27 +341,28 @@ class MultiHeadAttention(nn.Module):
                 k = k4.reshape(k.shape)
             start = kv_cache.length
             eff_len = start + x_kv.shape[1]
-            if kv_cache.quantized:
-                # rotate-then-quantize: rotation preserves per-token norms
-                # only approximately, so the scale is computed from the
-                # rotated keys that actually get stored
-                k_q, k_sc_new = quantize_kv(k)
-                v_q, v_sc_new = quantize_kv(v)
-                k_slots = lax.dynamic_update_slice(kv_cache.k, k_q, (0, start, 0))
-                v_slots = lax.dynamic_update_slice(kv_cache.v, v_q, (0, start, 0))
-                k_scale = lax.dynamic_update_slice(kv_cache.k_scale, k_sc_new, (0, start))
-                v_scale = lax.dynamic_update_slice(kv_cache.v_scale, v_sc_new, (0, start))
-            else:
-                k_slots = lax.dynamic_update_slice(
-                    kv_cache.k, k.astype(kv_cache.k.dtype), (0, start, 0)
+            with jax.named_scope("kv_cache_append"):
+                if kv_cache.quantized:
+                    # rotate-then-quantize: rotation preserves per-token norms
+                    # only approximately, so the scale is computed from the
+                    # rotated keys that actually get stored
+                    k_q, k_sc_new = quantize_kv(k)
+                    v_q, v_sc_new = quantize_kv(v)
+                    k_slots = lax.dynamic_update_slice(kv_cache.k, k_q, (0, start, 0))
+                    v_slots = lax.dynamic_update_slice(kv_cache.v, v_q, (0, start, 0))
+                    k_scale = lax.dynamic_update_slice(kv_cache.k_scale, k_sc_new, (0, start))
+                    v_scale = lax.dynamic_update_slice(kv_cache.v_scale, v_sc_new, (0, start))
+                else:
+                    k_slots = lax.dynamic_update_slice(
+                        kv_cache.k, k.astype(kv_cache.k.dtype), (0, start, 0)
+                    )
+                    v_slots = lax.dynamic_update_slice(
+                        kv_cache.v, v.astype(kv_cache.v.dtype), (0, start, 0)
+                    )
+                    k_scale = v_scale = None
+                new_cache = KVCache(
+                    k=k_slots, v=v_slots, length=eff_len, k_scale=k_scale, v_scale=v_scale
                 )
-                v_slots = lax.dynamic_update_slice(
-                    kv_cache.v, v.astype(kv_cache.v.dtype), (0, start, 0)
-                )
-                k_scale = v_scale = None
-            new_cache = KVCache(
-                k=k_slots, v=v_slots, length=eff_len, k_scale=k_scale, v_scale=v_scale
-            )
 
             # prefill (see prefill_mode): the caches entered empty, so the
             # attention over [0, eff_len) IS the attention over the fresh
@@ -482,47 +484,49 @@ class MultiHeadAttention(nn.Module):
         # regressing on the h^2 blowup.
         bd_fits = h * self.qk_channels <= 8192 and h * self.v_channels <= 8192
         if kv_cache is not None and n_q == 1 and h > 1 and bd_fits:
-            d_v = self.v_channels // h
-            qh = q[:, :, 0, :]  # (B, H, Dk)
-            eye = jnp.eye(h, dtype=qh.dtype)
-            qd = (qh[:, :, None, :] * eye[None, :, :, None]).reshape(b, h, h * qk_per_head)
-            quant = kv_cache.quantized
-            # int8 storage: the convert feeds the GEMM's operand stream (no
-            # materialized bf16 cache copy — measured, tools/int8_cache_probe),
-            # so HBM moves int8 bytes; the per-token scales fold into
-            # elementwise (B, H, M) ops outside both GEMMs.
-            k_op = k_slots.astype(qh.dtype) if quant else k_slots
-            scores = jnp.einsum(
-                "bhc,bjc->bhj", qd, k_op, preferred_element_type=jnp.float32
-            )
-            if quant:
-                scores = scores * k_scale[:, None, :].astype(jnp.float32)
-            scores = jnp.where(masked[:, :, 0, :], -jnp.finfo(jnp.float32).max, scores)
-            attn = jax.nn.softmax(scores)
-            attn = self.attn_dropout(attn, deterministic=deterministic)
-            if quant:
-                aw = (attn * v_scale[:, None, :].astype(jnp.float32)).astype(v.dtype)
-                v_op = v_slots.astype(v.dtype)
-            else:
-                aw, v_op = attn.astype(v_slots.dtype), v_slots
-            full = jnp.einsum(
-                "bhj,bjc->bhc", aw, v_op
-            )  # (B, H, H*Dv); row h's head-h slice is the wanted output
-            o_row = jnp.einsum("bhhc->bhc", full.reshape(b, h, h, d_v)).reshape(b, 1, self.v_channels)
-            return AttentionOutput(last_hidden_state=self.o_proj(o_row), kv_cache=new_cache)
+            with jax.named_scope("decode_attend"):
+                d_v = self.v_channels // h
+                qh = q[:, :, 0, :]  # (B, H, Dk)
+                eye = jnp.eye(h, dtype=qh.dtype)
+                qd = (qh[:, :, None, :] * eye[None, :, :, None]).reshape(b, h, h * qk_per_head)
+                quant = kv_cache.quantized
+                # int8 storage: the convert feeds the GEMM's operand stream (no
+                # materialized bf16 cache copy — measured, tools/int8_cache_probe),
+                # so HBM moves int8 bytes; the per-token scales fold into
+                # elementwise (B, H, M) ops outside both GEMMs.
+                k_op = k_slots.astype(qh.dtype) if quant else k_slots
+                scores = jnp.einsum(
+                    "bhc,bjc->bhj", qd, k_op, preferred_element_type=jnp.float32
+                )
+                if quant:
+                    scores = scores * k_scale[:, None, :].astype(jnp.float32)
+                scores = jnp.where(masked[:, :, 0, :], -jnp.finfo(jnp.float32).max, scores)
+                attn = jax.nn.softmax(scores)
+                attn = self.attn_dropout(attn, deterministic=deterministic)
+                if quant:
+                    aw = (attn * v_scale[:, None, :].astype(jnp.float32)).astype(v.dtype)
+                    v_op = v_slots.astype(v.dtype)
+                else:
+                    aw, v_op = attn.astype(v_slots.dtype), v_slots
+                full = jnp.einsum(
+                    "bhj,bjc->bhc", aw, v_op
+                )  # (B, H, H*Dv); row h's head-h slice is the wanted output
+                o_row = jnp.einsum("bhhc->bhc", full.reshape(b, h, h, d_v)).reshape(b, 1, self.v_channels)
+                return AttentionOutput(last_hidden_state=self.o_proj(o_row), kv_cache=new_cache)
 
         # kv operand subscripts: heads-major (b,h,j,c) without cache,
         # slots-major (b,j,h,c) with cache (the stored layout)
         kv_sub = "bhjc" if kv_cache is None else "bjhc"
 
         def attend(q_c, k_c, v_c):
-            scores = jnp.einsum(
-                f"bhic,{kv_sub}->bhij", q_c, k_c, preferred_element_type=jnp.float32
-            )
-            scores = jnp.where(masked, -jnp.finfo(jnp.float32).max, scores)
-            attn = jax.nn.softmax(scores)
-            attn = self.attn_dropout(attn, deterministic=deterministic)
-            return jnp.einsum(f"bhij,{kv_sub}->bhic", attn.astype(v_c.dtype), v_c)
+            with jax.named_scope("attend"):
+                scores = jnp.einsum(
+                    f"bhic,{kv_sub}->bhij", q_c, k_c, preferred_element_type=jnp.float32
+                )
+                scores = jnp.where(masked, -jnp.finfo(jnp.float32).max, scores)
+                attn = jax.nn.softmax(scores)
+                attn = self.attn_dropout(attn, deterministic=deterministic)
+                return jnp.einsum(f"bhij,{kv_sub}->bhic", attn.astype(v_c.dtype), v_c)
 
         chunk = self.max_heads_parallel or h
         head_axis = 1 if kv_cache is None else 2

@@ -302,11 +302,12 @@ class MLP(nn.Module):
             dtype=self.dtype,
             name=name,
         )
-        # name pinned: auto-naming would differ from nn.LayerNorm's
-        x = FusedLayerNorm(epsilon=LAYER_NORM_EPSILON, dtype=self.dtype, name="LayerNorm_0")(x)
-        x = dense(self.widening_factor * self.num_channels, "dense_1")(x)
-        x = nn.gelu(x, approximate=False)
-        x = dense(self.num_channels, "dense_2")(x)
+        with jax.named_scope("mlp"):
+            # name pinned: auto-naming would differ from nn.LayerNorm's
+            x = FusedLayerNorm(epsilon=LAYER_NORM_EPSILON, dtype=self.dtype, name="LayerNorm_0")(x)
+            x = dense(self.widening_factor * self.num_channels, "dense_1")(x)
+            x = nn.gelu(x, approximate=False)
+            x = dense(self.num_channels, "dense_2")(x)
         return x
 
 
@@ -693,20 +694,27 @@ class PerceiverEncoder(nn.Module):
             x_adapted = None
 
             def call_ca(layer, x_latent):
-                return layer.call_with_split_kv(
-                    x_latent, x_pix, enc, deterministic
-                ).last_hidden_state
+                with jax.named_scope("cross_attend"):
+                    return layer.call_with_split_kv(
+                        x_latent, x_pix, enc, deterministic
+                    ).last_hidden_state
 
         else:
-            x_adapted = self.input_adapter(x)
+            with jax.named_scope("input_adapter"):
+                x_adapted = self.input_adapter(x)
 
             def call_ca(layer, x_latent):
-                return layer(
-                    x_latent, x_adapted, None, pad_mask, None, None, None, deterministic
-                ).last_hidden_state
+                with jax.named_scope("cross_attend"):
+                    return layer(
+                        x_latent, x_adapted, None, pad_mask, None, None, None, deterministic
+                    ).last_hidden_state
+
+        def call_sa(block, x_latent):
+            with jax.named_scope("self_attend"):
+                return block(x_latent, deterministic=deterministic).last_hidden_state
 
         x_latent = call_ca(self.cross_attn_1, x_latent)
-        x_latent = self.self_attn_1(x_latent, deterministic=deterministic).last_hidden_state
+        x_latent = call_sa(self.self_attn_1, x_latent)
 
         cross_attn_n = self.cross_attn_n if self.extra_cross_attention_layer else self.cross_attn_1
         self_attn_n = self.self_attn_n if self.extra_self_attention_block else self.self_attn_1
@@ -714,7 +722,7 @@ class PerceiverEncoder(nn.Module):
         for i in range(1, self.num_self_attention_blocks):
             if i < self.num_cross_attention_layers:
                 x_latent = call_ca(cross_attn_n, x_latent)
-            x_latent = self_attn_n(x_latent, deterministic=deterministic).last_hidden_state
+            x_latent = call_sa(self_attn_n, x_latent)
 
         if return_adapted_input:
             return x_latent, x_adapted
@@ -766,10 +774,12 @@ class PerceiverDecoder(nn.Module):
             output_query = jnp.broadcast_to(
                 output_query, (x_latent.shape[0],) + output_query.shape[1:]
             )
-        output = self.cross_attn(
-            output_query, x_latent, None, None, None, None, None, deterministic
-        ).last_hidden_state
-        return self.output_adapter(output, **adapter_kwargs)
+        with jax.named_scope("cross_attend"):
+            output = self.cross_attn(
+                output_query, x_latent, None, None, None, None, None, deterministic
+            ).last_hidden_state
+        with jax.named_scope("output_adapter"):
+            return self.output_adapter(output, **adapter_kwargs)
 
 
 class PerceiverIO(nn.Module):
@@ -956,13 +966,15 @@ class PerceiverAR(nn.Module):
             and pad_mask is None
             and hasattr(self.input_adapter, "embed_compact")
         ):
-            if prefix_keep_idx is not None:
-                keep_idx = prefix_keep_idx
-            else:
-                rand = jax.random.uniform(self.make_rng("dropout"), (b, prefix_len))
-                _, keep_idx = lax.top_k(rand, keep)
-                keep_idx = jnp.sort(keep_idx, axis=-1)
-            x_emb, frq = self.input_adapter.embed_compact(x, keep_idx, prefix_len)
+            with jax.named_scope("prefix_dropout"):
+                if prefix_keep_idx is not None:
+                    keep_idx = prefix_keep_idx
+                else:
+                    rand = jax.random.uniform(self.make_rng("dropout"), (b, prefix_len))
+                    _, keep_idx = lax.top_k(rand, keep)
+                    keep_idx = jnp.sort(keep_idx, axis=-1)
+            with jax.named_scope("embed"):
+                x_emb, frq = self.input_adapter.embed_compact(x, keep_idx, prefix_len)
             x_prefix, x_latent = x_emb[:, :keep], x_emb[:, keep:]
             frq_prefix, frq_latent = frq[:, :keep], frq[:, keep:]
             return self._attend(
@@ -973,59 +985,61 @@ class PerceiverAR(nn.Module):
 
         # pad_mask None statically means positions are arange(n) — the adapter
         # then embeds positions via a table slice (scatter-free backward)
-        if pad_mask is None:
-            x_emb, frq = self.input_adapter(x, None)
-            pad_latent = pad_prefix = None
-        else:
-            shift = pad_mask.sum(axis=1, keepdims=True).astype(jnp.int32)
-            x_emb, frq = self.input_adapter(x, positions(b, n, shift=shift))
-            pad_latent, pad_prefix = pad_mask[:, prefix_len:], pad_mask[:, :prefix_len]
+        with jax.named_scope("embed"):
+            if pad_mask is None:
+                x_emb, frq = self.input_adapter(x, None)
+                pad_latent = pad_prefix = None
+            else:
+                shift = pad_mask.sum(axis=1, keepdims=True).astype(jnp.int32)
+                x_emb, frq = self.input_adapter(x, positions(b, n, shift=shift))
+                pad_latent, pad_prefix = pad_mask[:, prefix_len:], pad_mask[:, :prefix_len]
 
         x_latent, x_prefix = x_emb[:, prefix_len:], x_emb[:, :prefix_len]
         frq_latent, frq_prefix = frq[:, prefix_len:], frq[:, :prefix_len]
 
         if dropout_active:
-            # Static-count prefix dropout: keep `keep` positions, chosen
-            # uniformly, order preserved (reference: modules.py:809-830).
-            if prefix_keep_idx is not None:
-                keep_idx, rand = prefix_keep_idx, None
-            else:
-                rand = jax.random.uniform(self.make_rng("dropout"), (b, prefix_len))
-                keep_idx = None
-                if self.prefix_dropout_mode != "mask":
-                    _, keep_idx = lax.top_k(rand, keep)
-                    keep_idx = jnp.sort(keep_idx, axis=-1)
-
-            if self.prefix_dropout_mode == "mask":
-                # Keep-mask form (SURVEY §7.3): the prefix stays full length
-                # and dropped positions are masked out of the CA softmax —
-                # numerically the gathered softmax. Measured SLOWER than the
-                # gather at the 16k flagship: the gather also nearly halves
-                # the flash CA kernel work (kv 8704 vs 16384), which outweighs
-                # the gather machinery it removes (docs/performance.md,
-                # round-4 A/B table). Kept as an option and for the
-                # seq-parallel path, where masking is structurally required.
-                if rand is None:
-                    keep_mask = jnp.zeros((b, prefix_len), bool)
-                    keep_mask = keep_mask.at[jnp.arange(b)[:, None], keep_idx].set(True)
+            with jax.named_scope("prefix_dropout"):
+                # Static-count prefix dropout: keep `keep` positions, chosen
+                # uniformly, order preserved (reference: modules.py:809-830).
+                if prefix_keep_idx is not None:
+                    keep_idx, rand = prefix_keep_idx, None
                 else:
-                    # threshold at the keep-th largest uniform: the same keep
-                    # set top_k would select, without materializing indices
-                    thr, _ = lax.top_k(rand, keep)
-                    keep_mask = rand >= thr[:, -1:]
-                drop = ~keep_mask
-                pad_prefix = drop if pad_prefix is None else (pad_prefix | drop)
-                if pad_latent is None:
-                    pad_latent = jnp.zeros((b, n - prefix_len), bool)
-            else:
-                # gather-backward gather (ops/gathers.py): the scatter-add VJP
-                # of this row gather costs ~0.8 ms/step at the 16k flagship
-                from perceiver_io_tpu.ops.gathers import gather_rows
+                    rand = jax.random.uniform(self.make_rng("dropout"), (b, prefix_len))
+                    keep_idx = None
+                    if self.prefix_dropout_mode != "mask":
+                        _, keep_idx = lax.top_k(rand, keep)
+                        keep_idx = jnp.sort(keep_idx, axis=-1)
 
-                x_prefix = gather_rows(x_prefix, keep_idx)
-                frq_prefix = jnp.take_along_axis(frq_prefix, keep_idx[..., None], axis=1)
-                if pad_prefix is not None:
-                    pad_prefix = jnp.take_along_axis(pad_prefix, keep_idx, axis=1)
+                if self.prefix_dropout_mode == "mask":
+                    # Keep-mask form (SURVEY §7.3): the prefix stays full length
+                    # and dropped positions are masked out of the CA softmax —
+                    # numerically the gathered softmax. Measured SLOWER than the
+                    # gather at the 16k flagship: the gather also nearly halves
+                    # the flash CA kernel work (kv 8704 vs 16384), which outweighs
+                    # the gather machinery it removes (docs/performance.md,
+                    # round-4 A/B table). Kept as an option and for the
+                    # seq-parallel path, where masking is structurally required.
+                    if rand is None:
+                        keep_mask = jnp.zeros((b, prefix_len), bool)
+                        keep_mask = keep_mask.at[jnp.arange(b)[:, None], keep_idx].set(True)
+                    else:
+                        # threshold at the keep-th largest uniform: the same keep
+                        # set top_k would select, without materializing indices
+                        thr, _ = lax.top_k(rand, keep)
+                        keep_mask = rand >= thr[:, -1:]
+                    drop = ~keep_mask
+                    pad_prefix = drop if pad_prefix is None else (pad_prefix | drop)
+                    if pad_latent is None:
+                        pad_latent = jnp.zeros((b, n - prefix_len), bool)
+                else:
+                    # gather-backward gather (ops/gathers.py): the scatter-add VJP
+                    # of this row gather costs ~0.8 ms/step at the 16k flagship
+                    from perceiver_io_tpu.ops.gathers import gather_rows
+
+                    x_prefix = gather_rows(x_prefix, keep_idx)
+                    frq_prefix = jnp.take_along_axis(frq_prefix, keep_idx[..., None], axis=1)
+                    if pad_prefix is not None:
+                        pad_prefix = jnp.take_along_axis(pad_prefix, keep_idx, axis=1)
 
         return self._attend(
             x_latent, x_prefix, frq_latent, frq_prefix,
@@ -1054,24 +1068,26 @@ class PerceiverAR(nn.Module):
                 ca_capacity = ca_cache.capacity
                 pad_ca = jnp.pad(pad_ca, ((0, 0), (0, ca_capacity - pad_ca.shape[1])))
 
-        ca_out = self.cross_attention(
-            x_latent,
-            None,
-            x_prefix,
-            pad_ca,
-            rope_q,
-            rope_k_ca,
-            ca_cache,
-            deterministic,
-        )
-        sa_out = self.self_attention(
-            ca_out.last_hidden_state,
-            None,
-            frq_latent,
-            frq_latent,
-            sa_cache,
-            deterministic,
-        )
+        with jax.named_scope("cross_attend"):
+            ca_out = self.cross_attention(
+                x_latent,
+                None,
+                x_prefix,
+                pad_ca,
+                rope_q,
+                rope_k_ca,
+                ca_cache,
+                deterministic,
+            )
+        with jax.named_scope("self_attend"):
+            sa_out = self.self_attention(
+                ca_out.last_hidden_state,
+                None,
+                frq_latent,
+                frq_latent,
+                sa_cache,
+                deterministic,
+            )
 
         if kv_cache is None:
             new_cache = None
@@ -1220,16 +1236,19 @@ class PerceiverAR(nn.Module):
         n_total = ca_cache.length + n_x  # dynamic
         q_pos = positions(b, n_x, shift=shift, offset=n_total - n_x)
 
-        x_emb, frq_q = self.input_adapter(x, q_pos)
+        with jax.named_scope("embed"):
+            x_emb, frq_q = self.input_adapter(x, q_pos)
 
         x_prefix = jnp.zeros((b, 0, x_emb.shape[-1]), dtype=x_emb.dtype)
 
-        ca_out = self.cross_attention(
-            x_emb, None, x_prefix, pad_mask, frq_q, frq_q, ca_cache, deterministic
-        )
-        sa_out = self.self_attention(
-            ca_out.last_hidden_state, sa_pad_mask, frq_q, frq_q, sa_cache, deterministic
-        )
+        with jax.named_scope("cross_attend"):
+            ca_out = self.cross_attention(
+                x_emb, None, x_prefix, pad_mask, frq_q, frq_q, ca_cache, deterministic
+            )
+        with jax.named_scope("self_attend"):
+            sa_out = self.self_attention(
+                ca_out.last_hidden_state, sa_pad_mask, frq_q, frq_q, sa_cache, deterministic
+            )
         new_cache = (ca_out.kv_cache,) + tuple(sa_out.kv_cache)
         return BlockOutput(last_hidden_state=sa_out.last_hidden_state, kv_cache=new_cache)
 
@@ -1395,7 +1414,8 @@ class CausalSequenceModel(nn.Module):
             prefix_keep_idx=prefix_keep_idx,
         )
         h = out.last_hidden_state
-        if self.config.output_norm:
-            h = self.out_norm(h)
-        logits = self.output_adapter(h, attend=self.input_adapter.attend)
+        with jax.named_scope("logits"):
+            if self.config.output_norm:
+                h = self.out_norm(h)
+            logits = self.output_adapter(h, attend=self.input_adapter.attend)
         return CausalModelOutput(last_hidden_state=h, logits=logits, kv_cache=out.kv_cache)

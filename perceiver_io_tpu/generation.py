@@ -27,7 +27,8 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
-from dataclasses import dataclass
+import time
+from dataclasses import asdict, dataclass, replace
 from typing import Optional, Tuple
 
 import jax
@@ -163,6 +164,7 @@ def _shift_left_if_full(cache: KVCache) -> KVCache:
     return lax.cond(full, shift, lambda c: c, cache)
 
 
+@jax.named_scope("sample")
 def _sample(logits: jnp.ndarray, rng: jax.Array, config: GenerationConfig) -> jnp.ndarray:
     """Sample next-token ids from (B, V) logits."""
     if not config.do_sample:
@@ -271,7 +273,7 @@ def beam_search(
     bb = b * num_beams
     # prompt pass on B rows, then tile caches/logits to B*num_beams rows
     small_cache = CausalSequenceModel.init_cache(mcfg, b, dtype=cache_dtype)
-    with prefill_mode():
+    with jax.named_scope("prefill"), prefill_mode():
         out = model.apply(
             params, input_ids, prefix_len=prefix_len, pad_mask=pad_mask, kv_cache=small_cache
         )
@@ -312,6 +314,7 @@ def beam_search(
     else:
         packed_small = unpack_small = None
 
+    @jax.named_scope("decode")
     def step(carry, t):
         cache, seqs, beam_scores, token, done = carry
         dp = decode_params if unpack_small is None else unpack_small(packed_small)
@@ -472,7 +475,7 @@ def generate(
 
     # prompt pass (populates caches); prefill_mode routes its attention
     # through the flash kernels over the fresh k/v (see core/attention.py)
-    with prefill_mode():
+    with jax.named_scope("prefill"), prefill_mode():
         out = model.apply(params, input_ids, prefix_len=prefix_len, pad_mask=pad_mask, kv_cache=cache)
     rng, first_rng = jax.random.split(rng)
     next_token = _sample(out.logits[:, -1], first_rng, config)
@@ -488,36 +491,37 @@ def generate(
         packed_small = unpack_small = None
 
     def step(carry, _):
-        cache, ca_start, sa_start, token, rng, done = carry
-        dp = decode_params if unpack_small is None else unpack_small(packed_small)
-        params = _maybe_dequantize_weights(dp, compute_dtype)
-        ca_cache, sa_caches = cache[0], cache[1:]
+        with jax.named_scope("decode"):
+            cache, ca_start, sa_start, token, rng, done = carry
+            dp = decode_params if unpack_small is None else unpack_small(packed_small)
+            params = _maybe_dequantize_weights(dp, compute_dtype)
+            ca_cache, sa_caches = cache[0], cache[1:]
 
-        # slide: expire the oldest latent when the SA window is full, the
-        # oldest window position when the CA window is full (the analog of
-        # the reference's [:, -max_len+1:] truncation before appending).
-        # Expired slots are derived from the start counters, not carried.
-        ca_full = (ca_cache.length - ca_start) >= mcfg.max_seq_len
-        ca_start = ca_start + ca_full.astype(jnp.int32)
-        sa_full = (sa_caches[0].length - sa_start) >= mcfg.max_latents
-        sa_start = sa_start + sa_full.astype(jnp.int32)
+            # slide: expire the oldest latent when the SA window is full, the
+            # oldest window position when the CA window is full (the analog of
+            # the reference's [:, -max_len+1:] truncation before appending).
+            # Expired slots are derived from the start counters, not carried.
+            ca_full = (ca_cache.length - ca_start) >= mcfg.max_seq_len
+            ca_start = ca_start + ca_full.astype(jnp.int32)
+            sa_full = (sa_caches[0].length - sa_start) >= mcfg.max_latents
+            sa_start = sa_start + sa_full.astype(jnp.int32)
 
-        out = model.apply(
-            params,
-            token[:, None],
-            prefix_len=0,
-            pad_mask=pad_slots | (ca_idx < ca_start),
-            kv_cache=cache,
-            decode=True,
-            sa_pad_mask=sa_idx < sa_start,
-            pos_shift=pos_shift,
-        )
-        rng, step_rng = jax.random.split(rng)
-        sampled = _sample(out.logits[:, -1], step_rng, config)
-        if config.eos_token_id is not None:
-            sampled = jnp.where(done, config.pad_token_id, sampled)
-            done = done | (sampled == config.eos_token_id)
-        return (out.kv_cache, ca_start, sa_start, sampled, rng, done), sampled
+            out = model.apply(
+                params,
+                token[:, None],
+                prefix_len=0,
+                pad_mask=pad_slots | (ca_idx < ca_start),
+                kv_cache=cache,
+                decode=True,
+                sa_pad_mask=sa_idx < sa_start,
+                pos_shift=pos_shift,
+            )
+            rng, step_rng = jax.random.split(rng)
+            sampled = _sample(out.logits[:, -1], step_rng, config)
+            if config.eos_token_id is not None:
+                sampled = jnp.where(done, config.pad_token_id, sampled)
+                done = done | (sampled == config.eos_token_id)
+            return (out.kv_cache, ca_start, sa_start, sampled, rng, done), sampled
 
     done0 = jnp.zeros((b,), bool)
     if config.eos_token_id is not None:
@@ -532,3 +536,88 @@ def generate(
         tokens = next_token[:, None]
 
     return jnp.concatenate([input_ids, tokens], axis=1)
+
+
+@dataclass
+class GenerationStats:
+    """Host-measured serving telemetry for one generate call (the
+    prefill/decode latency split TPU serving comparisons hinge on)."""
+
+    batch: int
+    prompt_len: int
+    new_tokens: int
+    prefill_s: float  # prompt pass + first token, measured on its own program
+    decode_s: float  # the remaining new_tokens - 1 tokens
+    per_token_s: float  # decode_s / (new_tokens - 1)
+    tokens_per_sec: float  # batch * new_tokens / (prefill_s + decode_s)
+    compiled: bool  # True when THIS call paid a compile (timings include it)
+
+
+def make_instrumented_generate_fn(
+    model,
+    num_latents: int = 1,
+    config: Optional[GenerationConfig] = None,
+    cache_dtype=jnp.float32,
+    weight_dtype=None,
+    events=None,
+):
+    """``fn(params, input_ids, pad_mask, rng) -> (tokens, GenerationStats)``
+    — :func:`make_generate_fn` with the prefill/decode latency split measured
+    per call and (optionally) logged to an ``obs.events.EventLog``.
+
+    The whole decode loop is one compiled program (by design — see
+    :func:`make_generate_fn`), so the split cannot be timed inside it.
+    Instead a second compiled variant with ``max_new_tokens=1`` measures the
+    prefill (prompt pass + first token) on its own, and the full call's
+    remainder is decode time. That means **each call runs the prompt pass
+    twice** — this is the measurement wrapper for serving telemetry and
+    A/Bs, not the peak-throughput path. Compiles are tracked (and surfaced
+    as ``compile`` events): a call that compiled reports wall times
+    including the compile and says so in ``stats.compiled``.
+    """
+    config = config or GenerationConfig()
+    if config.max_new_tokens < 1:
+        raise ValueError("instrumented generation requires max_new_tokens >= 1")
+    from perceiver_io_tpu.obs.recompile import RecompileTracker
+
+    tracker = RecompileTracker(events=events)
+    prefill_fn = tracker.wrap(
+        make_generate_fn(
+            model, num_latents, replace(config, max_new_tokens=1), cache_dtype, weight_dtype
+        ),
+        "generate_prefill",
+    )
+    full_fn = tracker.wrap(
+        make_generate_fn(model, num_latents, config, cache_dtype, weight_dtype),
+        "generate_full",
+    )
+
+    def fn(params, input_ids, pad_mask=None, rng=None):
+        b, prompt_len = input_ids.shape
+        compiles_before = tracker.total_compiles
+        # timings force a HOST VALUE FETCH (float of one element), not
+        # block_until_ready: through the axon TPU tunnel block_until_ready
+        # is a no-op and would time only dispatch (see utils/profiling.py)
+        t0 = time.perf_counter()
+        float(prefill_fn(params, input_ids, pad_mask, rng)[0, -1])
+        prefill_s = time.perf_counter() - t0
+        t1 = time.perf_counter()
+        out = full_fn(params, input_ids, pad_mask, rng)
+        float(out[0, -1])
+        total_s = time.perf_counter() - t1
+        decode_s = max(total_s - prefill_s, 0.0)
+        stats = GenerationStats(
+            batch=b,
+            prompt_len=prompt_len,
+            new_tokens=config.max_new_tokens,
+            prefill_s=round(prefill_s, 6),
+            decode_s=round(decode_s, 6),
+            per_token_s=round(decode_s / max(config.max_new_tokens - 1, 1), 6),
+            tokens_per_sec=round(b * config.max_new_tokens / max(prefill_s + decode_s, 1e-9), 3),
+            compiled=tracker.total_compiles > compiles_before,
+        )
+        if events is not None:
+            events.emit("generate", **asdict(stats))
+        return out, stats
+
+    return fn

@@ -1,0 +1,34 @@
+"""Observability subsystem: structured run events, MFU/goodput accounting,
+recompile tracking, and labeled device-trace rollups.
+
+One measurement surface for every perf PR (ISSUE 1): the trainer emits
+``events.jsonl`` + ``run_manifest.json`` next to ``metrics.csv``; the
+benches report analytic MFU against a per-device peak-FLOPs table; traces
+captured with ``utils.profiling.trace`` aggregate by ``jax.named_scope``
+module instead of raw HLO op names (``obs.xplane``); and silent
+shape-driven recompiles surface as ``compile`` events
+(``obs.recompile``). Render a run directory with ``tools/obs_report.py``.
+"""
+
+from perceiver_io_tpu.obs.events import (  # noqa: F401
+    EventLog,
+    config_hash,
+    write_run_manifest,
+)
+from perceiver_io_tpu.obs.mfu import (  # noqa: F401
+    GoodputTracker,
+    clm_train_telemetry,
+    device_peak_flops,
+)
+from perceiver_io_tpu.obs.recompile import RecompileTracker, shape_signature  # noqa: F401
+
+__all__ = [
+    "EventLog",
+    "config_hash",
+    "write_run_manifest",
+    "GoodputTracker",
+    "clm_train_telemetry",
+    "device_peak_flops",
+    "RecompileTracker",
+    "shape_signature",
+]

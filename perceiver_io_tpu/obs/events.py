@@ -1,0 +1,159 @@
+"""Structured run events: a JSONL event sink + the run manifest.
+
+``events.jsonl`` is the machine-readable companion of ``metrics.csv`` — one
+JSON object per line, every line carrying ``ts`` (epoch seconds) and
+``event`` (the kind). The trainer emits ``fit_start`` / ``log`` /
+``compile`` / ``eval`` / ``generate`` / ``fit_end`` events through one
+:class:`EventLog`; ``tools/obs_report.py`` renders a run directory back
+into a summary table.
+
+``run_manifest.json`` pins what the run actually ran on: mesh shape,
+device kind/count, jax version, and a stable hash of the model/trainer
+configs — the context every perf number needs to be comparable later.
+
+Writes are gated to process 0 like ``training.metrics.MetricsLogger``
+(reference ``@rank_zero_only`` semantics): other processes get no-op sinks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import socket
+import time
+import warnings
+from typing import Dict, Optional
+
+
+class EventLog:
+    """Append-only JSONL event sink (``<log_dir>/events.jsonl``).
+
+    Each :meth:`emit` opens/appends/closes — crash-safe (a killed run keeps
+    every event already emitted) and cheap at the trainer's log-interval
+    event rate. Non-JSON values are stringified rather than raised on: a
+    telemetry write must never take the training loop down.
+    """
+
+    def __init__(
+        self, log_dir: str, filename: str = "events.jsonl", main_process: Optional[bool] = None
+    ):
+        if main_process is None:
+            from perceiver_io_tpu.parallel.dist import is_main_process
+
+            main_process = is_main_process()
+        self._active = bool(main_process)
+        self.log_dir = os.path.abspath(log_dir)
+        self.path = os.path.join(self.log_dir, filename)
+        if self._active:
+            try:
+                os.makedirs(self.log_dir, exist_ok=True)
+            except OSError as e:
+                # same contract as emit(): telemetry setup must never take
+                # the training loop down (read-only/dead log filesystem)
+                self._active = False
+                warnings.warn(f"EventLog disabled, cannot create {self.log_dir}: {e}")
+
+    def emit(self, event: str, **fields) -> None:
+        if not self._active:
+            return
+        row = {"ts": round(time.time(), 6), "event": str(event)}
+        row.update(fields)
+        try:
+            # strict JSON: NaN/Inf (a diverged loss is exactly the run this
+            # log diagnoses) become null, not the invalid-JSON NaN extension
+            # that breaks jq / JSON.parse consumers of events.jsonl
+            try:
+                line = json.dumps(row, default=str, allow_nan=False)
+            except ValueError:
+                line = json.dumps(_nan_to_none(row), default=str, allow_nan=False)
+            with open(self.path, "a") as f:
+                f.write(line + "\n")
+        except OSError as e:
+            # the never-take-the-loop-down contract: a dead log filesystem
+            # (disk full, run dir removed mid-run) deactivates the sink
+            # instead of killing a long training run over telemetry
+            self._active = False
+            warnings.warn(f"EventLog deactivated, cannot write {self.path}: {e}")
+
+    def close(self) -> None:  # symmetry with MetricsLogger; nothing buffered
+        pass
+
+
+def _nan_to_none(obj):
+    """Replace non-finite floats with None, recursively."""
+    if isinstance(obj, float):
+        return obj if obj == obj and obj not in (float("inf"), float("-inf")) else None
+    if isinstance(obj, dict):
+        return {k: _nan_to_none(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_nan_to_none(v) for v in obj]
+    return obj
+
+
+def _jsonable(obj):
+    """Best-effort JSON form of a config object (dataclass / dict / repr)."""
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {k: _jsonable(v) for k, v in dataclasses.asdict(obj).items()}
+    if isinstance(obj, dict):
+        return {str(k): _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    return str(obj)
+
+
+def config_hash(*objs) -> str:
+    """Stable short hash of one or more config objects — the run identity a
+    log row can be joined on (same configs, same hash, any process/host)."""
+    payload = json.dumps([_jsonable(o) for o in objs], sort_keys=True, default=str)
+    return hashlib.sha256(payload.encode()).hexdigest()[:12]
+
+
+def write_run_manifest(
+    log_dir: str,
+    mesh=None,
+    model_config=None,
+    trainer_config=None,
+    extra: Optional[Dict] = None,
+    main_process: Optional[bool] = None,
+    filename: str = "run_manifest.json",
+) -> Dict:
+    """Write ``run_manifest.json`` next to the event log; returns the
+    manifest dict (on every process — only process 0 writes)."""
+    import jax
+
+    devices = jax.devices()
+    manifest = {
+        "created_at": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+        "hostname": socket.gethostname(),
+        "jax_version": jax.__version__,
+        "backend": devices[0].platform,
+        "device_kind": devices[0].device_kind,
+        "device_count": len(devices),
+        "local_device_count": jax.local_device_count(),
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "mesh": None if mesh is None else {str(k): int(v) for k, v in mesh.shape.items()},
+        "config_hash": config_hash(model_config, trainer_config),
+        "model_config": _jsonable(model_config),
+        "trainer_config": _jsonable(trainer_config),
+    }
+    if extra:
+        manifest.update(_jsonable(extra))
+    if main_process is None:
+        from perceiver_io_tpu.parallel.dist import is_main_process
+
+        main_process = is_main_process()
+    if main_process:
+        try:
+            os.makedirs(os.path.abspath(log_dir), exist_ok=True)
+            with open(os.path.join(log_dir, filename), "w") as f:
+                json.dump(manifest, f, indent=2, default=str)
+        except OSError as e:
+            # same contract as EventLog.emit: a telemetry write must never
+            # take the training loop down
+            warnings.warn(f"run manifest not written to {log_dir}: {e}")
+    return manifest

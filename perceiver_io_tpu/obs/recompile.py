@@ -1,0 +1,113 @@
+"""Recompilation tracking — surface silent shape-driven jit cache misses.
+
+A jitted step that quietly retraces (a new batch shape, a donated buffer
+whose layout changed, a Python-object hash miss) costs seconds to minutes
+on TPU and is invisible in ``metrics.csv``: throughput just dips. The
+:class:`RecompileTracker` wraps compiled callables and watches the jit
+executable cache (``fn._cache_size()``) across calls — a size increase
+means THIS call compiled, its wall time is (trace + compile + dispatch)
+time, and the argument shape signature says what drove it. Each miss is
+emitted as a ``compile`` event and accounted against goodput.
+
+The first call's compile is expected; any later ``compile`` event on the
+same function is the smoking gun for a shape leak.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Callable, Dict, Optional
+
+
+def _cache_size(fn) -> Optional[int]:
+    """The jit executable-cache size, or None when ``fn`` does not expose
+    one (not a jit wrapper, or a future jax moved the attribute)."""
+    probe = getattr(fn, "_cache_size", None)
+    if probe is None:
+        return None
+    try:
+        return int(probe())
+    except Exception:
+        return None
+
+
+def shape_signature(args, kwargs=None, top: int = 8) -> Dict:
+    """Compact signature of a call's array arguments: leaf count and the
+    most common ``dtype[shape]`` strings — enough to diff two ``compile``
+    events and see which input changed shape."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves((args, kwargs or {}))
+    counter = collections.Counter()
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        if shape is None:
+            counter[type(leaf).__name__] += 1
+        else:
+            dtype = getattr(leaf, "dtype", None)
+            counter[f"{getattr(dtype, 'name', dtype)}{list(shape)}"] += 1
+    return {"leaves": len(leaves), "shapes": dict(counter.most_common(top))}
+
+
+class RecompileTracker:
+    """Wrap jitted callables; count and log their cache misses.
+
+    ``events`` (an ``obs.events.EventLog``) and ``goodput`` (an
+    ``obs.mfu.GoodputTracker``) are plain attributes so a long-lived
+    tracker — the Trainer wraps its steps once at construction — can be
+    pointed at each ``fit()``'s sinks.
+    """
+
+    def __init__(self, events=None, goodput=None):
+        self.events = events
+        self.goodput = goodput
+        self._state: Dict[str, Dict] = {}
+
+    def wrap(self, fn: Callable, name: str) -> Callable:
+        st = self._state.setdefault(
+            name, {"calls": 0, "compiles": 0, "compile_s": 0.0}
+        )
+
+        def wrapped(*args, **kwargs):
+            before = _cache_size(fn)
+            t0 = time.perf_counter()
+            out = fn(*args, **kwargs)
+            dt = time.perf_counter() - t0
+            st["calls"] += 1
+            after = _cache_size(fn)
+            if after is not None and before is not None:
+                compiled = after > before
+            else:
+                # no cache introspection: assume only the first call compiles
+                compiled = st["calls"] == 1
+            if compiled:
+                st["compiles"] += 1
+                st["compile_s"] += dt
+                if self.goodput is not None:
+                    self.goodput.add("compile", dt)
+                if self.events is not None:
+                    self.events.emit(
+                        "compile",
+                        fn=name,
+                        wall_s=round(dt, 6),
+                        n_compiles=st["compiles"],
+                        cache_size=after,
+                        arg_shapes=shape_signature(args, kwargs),
+                    )
+            return out
+
+        wrapped.__name__ = f"tracked_{name}"
+        wrapped.__wrapped__ = fn
+        return wrapped
+
+    def counts(self) -> Dict[str, int]:
+        return {name: st["compiles"] for name, st in self._state.items()}
+
+    @property
+    def total_compiles(self) -> int:
+        return sum(st["compiles"] for st in self._state.values())
+
+    @property
+    def total_compile_s(self) -> float:
+        return sum(st["compile_s"] for st in self._state.values())

@@ -1,0 +1,358 @@
+"""XSpace (xplane.pb) reader + per-scope rollup — aggregate device-op time
+from a ``jax.profiler.trace`` capture without TensorFlow/tensorboard.
+
+Lifted from ``tools/xplane.py`` (which now shims to this module) and grown
+into a library: besides the per-op totals the CLI always printed, the
+:func:`rollup` API aggregates event durations by the ``jax.named_scope`` /
+flax-module path embedded in XLA op names
+(``jit(train_step)/.../perceiver_ar/cross_attention/fusion.123``), so a
+captured trace reads by *module* ("cross_attention: 8.1 ms") instead of by
+raw HLO op name. The framework's scopes are threaded through
+``core/modules.py``, ``core/attention.py``, ``ops/flash_attention.py`` and
+``generation.py`` (prefill vs. decode).
+
+Wire-format notes (tensorflow/core/profiler/protobuf/xplane.proto):
+  XSpace:        planes = 1 (repeated XPlane)
+  XPlane:        id=1, name=2, lines=3 (repeated XLine),
+                 event_metadata=4 (map<int64, XEventMetadata>),
+                 stat_metadata=5 (map<int64, XStatMetadata{id=1, name=2}>)
+  XLine:         id=1, display_name? name=2/3, events=4 — fields probed
+  XEvent:        metadata_id=1, offset_ps=2, duration_ps=3,
+                 stats=4 (repeated XStat)
+  XEventMetadata: id=1, name=2, display_name=3, stats=5
+  XStat:         metadata_id=1, str_value=5, ref_value=7 (interned string:
+                 the stat_metadata entry's NAME is the value)
+
+The metadata name/display_name of a device-plane op event is the raw HLO
+instruction name ("fusion.123"); the framework path
+("jit(step)/.../cross_attend/fusion.123") rides in a stat whose
+stat-metadata name is ``tf_op`` / ``long_name`` / ``hlo_op`` — attached to
+the event or to its event metadata. The rollup resolves those stats so
+scopes work on real captures, not just on names that happen to contain "/".
+"""
+
+from __future__ import annotations
+
+import collections
+import glob
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Tuple
+
+
+def _varint(buf: bytes, i: int):
+    shift = result = 0
+    while True:
+        b = buf[i]
+        i += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, i
+        shift += 7
+
+
+def fields(buf: bytes):
+    """Yield (field_number, wire_type, value) over a protobuf message."""
+    i = 0
+    n = len(buf)
+    while i < n:
+        tag, i = _varint(buf, i)
+        fnum, wt = tag >> 3, tag & 7
+        if wt == 0:
+            val, i = _varint(buf, i)
+        elif wt == 2:
+            ln, i = _varint(buf, i)
+            val = buf[i : i + ln]
+            i += ln
+        elif wt == 5:
+            val = int.from_bytes(buf[i : i + 4], "little")
+            i += 4
+        elif wt == 1:
+            val = int.from_bytes(buf[i : i + 8], "little")
+            i += 8
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        yield fnum, wt, val
+
+
+# stat names that carry the framework op path (jax named_scope / module path)
+SCOPE_STAT_NAMES = frozenset({"tf_op", "long_name", "hlo_op", "op_name"})
+
+
+def _parse_stats(stats_msgs, stat_names):
+    """Resolve XStat messages against the plane's stat-metadata name table;
+    returns the best scope-path value found (str_value or interned
+    ref_value), or ''.
+
+    Scope-bearing stat names mix real framework paths (``tf_op`` /
+    ``op_name``) with ``hlo_op``, whose value is just the raw HLO
+    instruction name — so a value containing '/' wins regardless of the
+    stats' serialization order, and a bare op name is only the fallback."""
+    fallback = ""
+    for stat in stats_msgs:
+        mid = None
+        sval = ""
+        rval = None
+        for f, w, v in fields(stat):
+            if f == 1 and w == 0:
+                mid = v
+            elif f == 5 and w == 2:
+                sval = v.decode(errors="replace")
+            elif f == 7 and w == 0:
+                rval = v
+        if mid is None or stat_names.get(mid, "") not in SCOPE_STAT_NAMES:
+            continue
+        val = sval or (stat_names.get(rval, "") if rval is not None else "")
+        if "/" in val:
+            return val
+        if val and not fallback:
+            fallback = val
+    return fallback
+
+
+def parse_plane(plane: bytes):
+    name, metadata, _, lines, _ = parse_plane_full(plane)
+    return name, metadata, lines
+
+
+def parse_plane_full(plane: bytes):
+    """``(name, metadata, scope_hints, lines, stat_names)`` — ``metadata``
+    maps event-metadata id -> display name; ``scope_hints`` maps the ids
+    whose metadata stats carry a framework op path (``SCOPE_STAT_NAMES``)
+    to that path; ``stat_names`` is the plane's stat-metadata name table
+    (needed to resolve per-event stats)."""
+    name = ""
+    metadata = {}
+    lines = []
+    stat_names = {}
+    meta_stats = {}  # metadata id -> raw XStat messages (resolved after the scan)
+    for fnum, wt, val in fields(plane):
+        if fnum == 2 and wt == 2:
+            name = val.decode(errors="replace")
+        elif fnum == 3 and wt == 2:
+            lines.append(val)
+        elif fnum == 5 and wt == 2:
+            # stat_metadata map entry: key=1, value=2 XStatMetadata{id=1, name=2}
+            k = v = None
+            for f2, w2, v2 in fields(val):
+                if f2 == 1:
+                    k = v2
+                elif f2 == 2:
+                    v = v2
+            if k is not None and v is not None:
+                for f3, w3, v3 in fields(v):
+                    if f3 == 2 and w3 == 2:
+                        stat_names[k] = v3.decode(errors="replace")
+        elif fnum == 4 and wt == 2:
+            # map entry: key=1 varint, value=2 XEventMetadata
+            k = v = None
+            for f2, w2, v2 in fields(val):
+                if f2 == 1:
+                    k = v2
+                elif f2 == 2:
+                    v = v2
+            if k is not None and v is not None:
+                mname = ""
+                mdisplay = ""
+                stats = []
+                for f3, w3, v3 in fields(v):
+                    if f3 == 2 and w3 == 2:
+                        mname = v3.decode(errors="replace")
+                    elif f3 == 3 and w3 == 2:
+                        mdisplay = v3.decode(errors="replace")
+                    elif f3 == 5 and w3 == 2:
+                        stats.append(v3)
+                metadata[k] = mdisplay or mname
+                if stats:
+                    meta_stats[k] = stats
+    # stat_metadata can appear after event_metadata in the stream — resolve last
+    scope_hints = {}
+    for k, stats in meta_stats.items():
+        hint = _parse_stats(stats, stat_names)
+        if hint:
+            scope_hints[k] = hint
+    return name, metadata, scope_hints, lines, stat_names
+
+
+def parse_line_events(line: bytes):
+    """Yield (line_name, metadata_id, duration_ps) for each XEvent on the line."""
+    for lname, mid, dur, _ in iter_line_events(line):
+        yield lname, mid, dur
+
+
+def iter_line_events(line: bytes, stat_names: Optional[Dict[int, str]] = None):
+    """Yield (line_name, metadata_id, duration_ps, scope_hint) per XEvent —
+    ``scope_hint`` is the framework op path from the event's own stats
+    (resolved against ``stat_names``), or '' when absent."""
+    stat_names = stat_names or {}
+    lname = ""
+    evs = []
+    for fnum, wt, val in fields(line):
+        if fnum in (2, 11) and wt == 2:
+            lname = val.decode(errors="replace") or lname
+        elif fnum == 4 and wt == 2:  # XLine.events
+            mid = dur = 0
+            stats = []
+            for f2, w2, v2 in fields(val):
+                if f2 == 1:
+                    mid = v2
+                elif f2 == 3:
+                    dur = v2
+                elif f2 == 4 and w2 == 2:  # XEvent.stats
+                    stats.append(v2)
+            hint = _parse_stats(stats, stat_names) if stats else ""
+            evs.append((mid, dur, hint))
+    for mid, dur, hint in evs:
+        yield lname, mid, dur, hint
+
+
+def resolve_capture(path: str) -> str:
+    """A capture directory resolves to its newest ``*.xplane.pb``."""
+    if os.path.isdir(path):
+        pbs = sorted(glob.glob(os.path.join(path, "**", "*.xplane.pb"), recursive=True))
+        if not pbs:
+            raise FileNotFoundError(f"no xplane.pb under {path}")
+        path = pbs[-1]
+    return path
+
+
+@dataclass
+class PlaneSummary:
+    """Per-op totals for one XPlane — what the CLI has always printed —
+    plus the per-op framework scope paths the stats provided (empty when a
+    capture carries none)."""
+
+    name: str
+    per_op: "collections.Counter" = field(default_factory=collections.Counter)
+    counts: "collections.Counter" = field(default_factory=collections.Counter)
+    per_line: "collections.Counter" = field(default_factory=collections.Counter)
+    op_scopes: Dict[str, str] = field(default_factory=dict)
+
+    @property
+    def total_ps(self) -> int:
+        return sum(self.per_line.values())
+
+
+def iter_planes(path: str, line_filter: str = "") -> Iterator[PlaneSummary]:
+    """Per-op duration totals for every plane in a capture (file or dir)."""
+    path = resolve_capture(path)
+    with open(path, "rb") as f:
+        buf = f.read()
+    for fnum, wt, plane in fields(buf):
+        if fnum != 1 or wt != 2:
+            continue
+        name, metadata, scope_hints, lines, stat_names = parse_plane_full(plane)
+        summary = PlaneSummary(name=name)
+        for line in lines:
+            for lname, mid, dur, hint in iter_line_events(line, stat_names):
+                if line_filter and line_filter not in lname:
+                    continue
+                op = metadata.get(mid, f"#{mid}")
+                summary.per_op[op] += dur
+                summary.counts[op] += 1
+                summary.per_line[lname] += dur
+                hint = hint or scope_hints.get(mid, "")
+                if hint and op not in summary.op_scopes:
+                    summary.op_scopes[op] = hint
+        if summary.per_op:
+            yield summary
+
+
+UNSCOPED = "<unscoped>"
+
+
+def scope_of(op_name: str, depth: Optional[int] = None) -> str:
+    """The module-scope path of an XLA op name.
+
+    ``jit(train_step)/jit(main)/perceiver_ar/cross_attention/fusion.3`` →
+    ``perceiver_ar/cross_attention``: jit-wrapper components are dropped, the
+    final component (the raw HLO op) is dropped, and ``depth`` optionally
+    truncates to the leading components. Names with no scope path aggregate
+    under ``<unscoped>``.
+    """
+    parts = [p for p in op_name.split("/") if "jit(" not in p]
+    parts = parts[:-1]
+    if not parts:
+        return UNSCOPED
+    if depth is not None:
+        parts = parts[:depth]
+    return "/".join(parts)
+
+
+@dataclass
+class ScopeRollup:
+    """Per-scope aggregation of one plane's events."""
+
+    plane: str
+    # scope -> (total duration ps, event count)
+    scopes: Dict[str, Tuple[int, int]] = field(default_factory=dict)
+
+    @property
+    def total_ps(self) -> int:
+        return sum(d for d, _ in self.scopes.values())
+
+    def top(self, n: int = 30) -> List[Tuple[str, int, int]]:
+        rows = [(s, d, c) for s, (d, c) in self.scopes.items()]
+        rows.sort(key=lambda r: -r[1])
+        return rows[:n]
+
+
+def rollup_planes(
+    planes: List[PlaneSummary], depth: Optional[int] = None
+) -> List[ScopeRollup]:
+    """Aggregate already-parsed :class:`PlaneSummary` objects by named scope
+    — pure aggregation, no re-read of the capture (the parse dominates on
+    multi-hundred-MB captures, so callers holding planes reuse them)."""
+    out = []
+    for plane in planes:
+        scopes: Dict[str, List[int]] = {}
+        for op, dur in plane.per_op.items():
+            # prefer the stat-provided framework path (device planes name
+            # events by raw HLO op; the jax op_name path rides in a stat)
+            s = scope_of(plane.op_scopes.get(op, op), depth=depth)
+            agg = scopes.setdefault(s, [0, 0])
+            agg[0] += dur
+            agg[1] += plane.counts[op]
+        out.append(
+            ScopeRollup(plane=plane.name, scopes={s: (d, c) for s, (d, c) in scopes.items()})
+        )
+    return out
+
+
+def rollup(
+    path: str, depth: Optional[int] = None, line_filter: str = ""
+) -> List[ScopeRollup]:
+    """Aggregate a capture by named scope instead of raw op name.
+
+    The per-plane total equals :func:`iter_planes`'s (and the CLI's) total
+    exactly: every event lands in one scope bucket.
+    """
+    return rollup_planes(list(iter_planes(path, line_filter=line_filter)), depth=depth)
+
+
+def summarize(
+    path: str,
+    top: int = 30,
+    line_filter: str = "",
+    by_scope: bool = False,
+    depth: Optional[int] = None,
+    print_fn=print,
+) -> List[PlaneSummary]:
+    """Print per-plane totals (per-op, or per-scope with ``by_scope``) and
+    return the plane summaries — the ``tools/xplane.py`` CLI behavior as a
+    callable."""
+    resolved = resolve_capture(path)
+    size = os.path.getsize(resolved)
+    print_fn(f"{resolved} ({size/1e6:.0f} MB)")
+    planes = list(iter_planes(resolved, line_filter=line_filter))
+    scoped = rollup_planes(planes, depth=depth) if by_scope else None
+    for i, plane in enumerate(planes):
+        print_fn(f"\n=== plane: {plane.name} | lines: {dict(plane.per_line.most_common(6))}")
+        print_fn(f"    sum of event time: {plane.total_ps/1e9:.3f} ms")
+        if by_scope:
+            for s, d, c in scoped[i].top(top):
+                print_fn(f"  {d/1e9:9.3f} ms {c:6d}x  {s[:100]}")
+        else:
+            for op, d in plane.per_op.most_common(top):
+                print_fn(f"  {d/1e9:9.3f} ms {plane.counts[op]:6d}x  {op[:100]}")
+    return planes

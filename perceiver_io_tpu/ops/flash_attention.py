@@ -959,6 +959,7 @@ def packed_supported(num_heads: int, d_qk: int, d_v: int) -> bool:
     )
 
 
+@jax.named_scope("flash_attention_packed")
 def flash_attention_packed(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -1016,6 +1017,7 @@ def flash_attention_packed(
     return out[:, :nq, :]
 
 
+@jax.named_scope("flash_attention")
 def flash_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,

@@ -395,6 +395,11 @@ def run_training(
         loss_fn = ring_loss_builder(model, mesh)
     else:
         loss_fn = loss_builder(model.apply)
+    # analytic per-sample token/FLOP accounting for the MFU/throughput log
+    # columns — available for CLM-shaped configs, None (columns off) otherwise
+    from perceiver_io_tpu.obs import clm_train_telemetry
+
+    tokens_per_sample, flops_per_sample = clm_train_telemetry(model_config) or (None, None)
     trainer = Trainer(
         loss_fn,
         mesh=mesh,
@@ -406,6 +411,8 @@ def run_training(
             max_checkpoints=trainer_args.max_checkpoints,
             save_weights_only=trainer_args.save_weights_only,
             fsdp_min_weight_size=trainer_args.fsdp_min_weight_size,
+            tokens_per_sample=tokens_per_sample,
+            flops_per_sample=flops_per_sample,
         ),
         logger=logger,
         lr_schedule=schedule,

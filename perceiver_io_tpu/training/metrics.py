@@ -37,6 +37,23 @@ class MetricsLogger:
         self._csv_path = os.path.join(self.log_dir, "metrics.csv")
         self._keys = ["step", "time"]
         self._header_written = False
+        if self._active and os.path.exists(self._csv_path):
+            # resume into an existing metrics.csv: seed the key set and the
+            # header flag from the file, otherwise the first log after a
+            # restart appends a SECOND header row mid-file (and a widening
+            # key skips the rewrite because _header_written is still False)
+            with open(self._csv_path, newline="") as f:
+                header = next(csv.reader(f), None)
+            if header:
+                self._keys = list(header)
+                self._header_written = True
+                # damaged/foreign header missing the contract keys: widen it
+                # NOW via the same rewrite a new metric key triggers —
+                # appending to _keys alone would misalign every row after
+                missing = [k for k in ("step", "time") if k not in self._keys]
+                if missing:
+                    self._keys.extend(missing)
+                    self._rewrite_with_widened_header()
         self._tb = None
         if use_tensorboard and self._active:
             try:  # torch's tensorboard writer; optional

@@ -19,11 +19,25 @@ from typing import Callable, Dict, Iterable, Optional, Sequence
 import jax
 import numpy as np
 
+from perceiver_io_tpu.obs.events import EventLog, write_run_manifest
+from perceiver_io_tpu.obs.mfu import GoodputTracker, device_peak_flops
+from perceiver_io_tpu.obs.recompile import RecompileTracker
 from perceiver_io_tpu.parallel.mesh import AXIS_SEQ, shard_batch
 from perceiver_io_tpu.training.checkpoint import CheckpointManager
 from perceiver_io_tpu.training.loop import make_train_step, shard_train_state
 from perceiver_io_tpu.training.metrics import MetricsLogger
 from perceiver_io_tpu.training.state import TrainState
+
+
+def _leading_dim(batch) -> int:
+    """Batch size of a batch pytree: the leading dim of its first array leaf
+    (0 when the batch carries no arrays) — telemetry multiplies the
+    per-sample token/FLOP accounting by this."""
+    for leaf in jax.tree_util.tree_leaves(batch):
+        shape = getattr(leaf, "shape", None)
+        if shape:
+            return int(shape[0])
+    return 0
 
 
 @dataclass
@@ -42,6 +56,19 @@ class TrainerConfig:
     # host-side batch production overlapped with device compute via a
     # producer thread (data/loader.py PrefetchIterator); 0 disables
     prefetch_batches: int = 2
+    # --- telemetry (obs/) -------------------------------------------------
+    # structured events.jsonl + run_manifest.json next to metrics.csv
+    # (written only when a logger is attached)
+    events: bool = True
+    # analytic per-sample accounting for MFU/throughput log fields: latent
+    # tokens per sample and fwd+bwd model FLOPs per sample
+    # (obs.mfu.clm_train_telemetry derives both from a CLM config); None
+    # disables the tokens_per_sec / model_flops_per_sec / mfu columns
+    tokens_per_sample: Optional[int] = None
+    flops_per_sample: Optional[float] = None
+    # peak FLOP/s of one device for the MFU denominator; None = look the
+    # device kind up in obs.mfu.PEAK_FLOPS
+    peak_flops_per_device: Optional[float] = None
 
 
 class Trainer:
@@ -76,7 +103,13 @@ class Trainer:
         self.logger = logger
         self.lr_schedule = lr_schedule
         self.callbacks = list(callbacks)
-        self._train_step = make_train_step(loss_fn)
+        # recompile tracking wraps the steps ONCE here so the jit-cache
+        # watermark persists across sequential fit() calls — a recompile in
+        # fit #2 (resume with a new batch shape) is exactly what must surface
+        self.recompiles = RecompileTracker()
+        self._events: Optional[EventLog] = None
+        self._manifest_written = False
+        self._train_step = self.recompiles.wrap(make_train_step(loss_fn), "train_step")
         eval_fn = eval_loss_fn
         if eval_fn is None:
             # dropout must be off during validation (Lightning model.eval()
@@ -93,7 +126,7 @@ class Trainer:
             _, metrics = eval_fn(params, batch, rng)
             return metrics
 
-        self._eval_step = jax.jit(eval_step)
+        self._eval_step = self.recompiles.wrap(jax.jit(eval_step), "eval_step")
         # prefetch recovery across sequential fit() calls on the SAME
         # iterator object (resume, curriculum phases): batches the producer
         # pulled but fit() never consumed are re-injected next time instead
@@ -128,6 +161,17 @@ class Trainer:
     def _log(self, step: int, metrics: Dict[str, float]) -> None:
         if self.logger is not None:
             self.logger.log(step, metrics)
+
+    def _ensure_events(self) -> Optional[EventLog]:
+        """The run's event sink (events.jsonl beside metrics.csv), created on
+        first use; None when telemetry is off or no logger is attached."""
+        if not self.config.events or self.logger is None:
+            return None
+        if self._events is None:
+            self._events = EventLog(
+                self.logger.log_dir, main_process=getattr(self.logger, "_active", None)
+            )
+        return self._events
 
     # -- API --------------------------------------------------------------
 
@@ -166,105 +210,193 @@ class Trainer:
             if self.checkpoints.latest_step() is not None:
                 state = self.checkpoints.restore(state)
 
-        train_iter = iter(train_iter)
-        src = train_iter
-        if self._pending_prefetch is not None:
-            # a previous fit's producer outlived its bounded close() join
-            # (source iterator blocked); collect whatever it has since
-            # produced before touching the source again
-            self._pending_prefetch.close()
-            if self._pending_prefetch.alive():
-                raise RuntimeError(
-                    "the previous fit's prefetch producer is still blocked "
-                    "inside the training iterator; a second fit on it would "
-                    "race the producer thread"
-                )
-            self._residual_batches.extend(self._pending_prefetch.residual)
-            self._pending_prefetch = None
-        same_src = self._residual_src is not None and self._residual_src() is src
-        if not same_src:
-            # stale residuals belong to a different (gone) iterator — drop
-            # them rather than mix them into this fit's recovery deque
-            self._residual_batches.clear()
-        residual_dq = self._residual_batches if same_src else None
-        if residual_dq:
-            import itertools
-
-            def _drain(dq=residual_dq):
-                while dq:
-                    yield dq.popleft()
-
-            # lazy drain: unconsumed items REMAIN in the deque for the next fit
-            train_iter = itertools.chain(_drain(), train_iter)
-        prefetch = None
-        start_step = int(state.step)
-        if cfg.prefetch_batches > 0 and start_step < cfg.max_steps:
-            # only when steps will actually run — a no-op fit must not pull
-            # (and discard) items from a shared stateful iterator
-            from perceiver_io_tpu.data.loader import PrefetchIterator
-
-            train_iter = prefetch = PrefetchIterator(train_iter, depth=cfg.prefetch_batches)
-        window: list = []
-        t0 = time.time()
-        try:
-            for _ in range(start_step, cfg.max_steps):
-                batch = self._prepare_batch(next(train_iter))
-                state, metrics = self._train_step(state, batch)
-                window.append(metrics)
-                step = int(state.step)
-
-                if step % cfg.log_interval == 0 or step == cfg.max_steps:
-                    avg = {
-                        cfg.metric_prefix_train + k: float(np.mean([float(m[k]) for m in window]))
-                        for k in window[-1]
-                    }
-                    if self.lr_schedule is not None:
-                        avg["lr"] = float(self.lr_schedule(step))
-                    avg["steps_per_sec"] = len(window) / max(time.time() - t0, 1e-9)
-                    self._log(step, avg)
-                    window, t0 = [], time.time()
-
-                at_val = cfg.val_interval is not None and step % cfg.val_interval == 0
-                if (at_val or step == cfg.max_steps) and val_loader is not None:
-                    val_metrics = self.validate(state, val_loader)
-                    self._log(step, val_metrics)
-                    if self.checkpoints is not None:
-                        self.checkpoints.save(state, metrics=val_metrics, config=model_config)
-                    for cb in self.callbacks:
-                        cb(self, state, step)
-        finally:
-            if prefetch is not None:
-                prefetch.close()
-                # the prefetch pulled items ahead of the step loop — they
-                # logically precede anything still parked in the deque
-                self._residual_batches.extendleft(reversed(prefetch.residual))
-                if prefetch.alive():
-                    # producer stuck in the source iterator; hold the wrapper
-                    # so the next fit can harvest (and refuses to race it)
-                    self._pending_prefetch = prefetch
-                try:
-                    import weakref
-
-                    self._residual_src = weakref.ref(src)
-                except TypeError:  # not weakref-able (e.g. plain list_iterator)
-                    self._residual_src = None
-            # commit any in-flight async save even when the loop raises
-            # (callback/iterator error, KeyboardInterrupt) — otherwise a
-            # hard exit abandons the last checkpoint
-            if self.checkpoints is not None:
-                self.checkpoints.wait_until_finished()
-        if val_loader is None and self.checkpoints is not None:
-            # no validation: leave a final latest-state checkpoint via a
-            # monitor-free manager (Lightning save-last parity) so NaN metrics
-            # never pollute best-k retention
-            final_mngr = CheckpointManager(
-                self.config.checkpoint_dir,
-                max_to_keep=self.config.max_checkpoints,
-                monitor=None,
-                save_weights_only=self.config.save_weights_only,
+        # --- telemetry: event sink, run manifest, goodput, MFU inputs -----
+        events = self._ensure_events()
+        goodput = GoodputTracker()
+        self.recompiles.events = events
+        self.recompiles.goodput = goodput
+        if events is not None and not self._manifest_written:
+            write_run_manifest(
+                self.logger.log_dir,
+                mesh=self.mesh,
+                model_config=model_config,
+                trainer_config=cfg,
+                main_process=getattr(self.logger, "_active", None),
             )
-            final_mngr.save(state, config=model_config)
-            final_mngr.close()
+            self._manifest_written = True
+        n_dev = self.mesh.size if self.mesh is not None else 1
+        peak = cfg.peak_flops_per_device
+        if peak is None:
+            peak = device_peak_flops()
+        if events is not None:
+            events.emit("fit_start", start_step=int(state.step), max_steps=cfg.max_steps)
+
+        # an aborted run must still get its goodput/recompile audit, and
+        # a fit_start must always be paired with a fit_end — the try
+        # covers everything from iterator/prefetch setup (which can
+        # raise, e.g. a still-blocked previous producer) through the
+        # final checkpoint save. Except-and-reraise, NOT exc_info in a
+        # finally: that misfires when fit() runs inside a caller's
+        # except handler.
+        try:
+            train_iter = iter(train_iter)
+            src = train_iter
+            if self._pending_prefetch is not None:
+                # a previous fit's producer outlived its bounded close() join
+                # (source iterator blocked); collect whatever it has since
+                # produced before touching the source again
+                self._pending_prefetch.close()
+                if self._pending_prefetch.alive():
+                    raise RuntimeError(
+                        "the previous fit's prefetch producer is still blocked "
+                        "inside the training iterator; a second fit on it would "
+                        "race the producer thread"
+                    )
+                self._residual_batches.extend(self._pending_prefetch.residual)
+                self._pending_prefetch = None
+            same_src = self._residual_src is not None and self._residual_src() is src
+            if not same_src:
+                # stale residuals belong to a different (gone) iterator — drop
+                # them rather than mix them into this fit's recovery deque
+                self._residual_batches.clear()
+            residual_dq = self._residual_batches if same_src else None
+            if residual_dq:
+                import itertools
+
+                def _drain(dq=residual_dq):
+                    while dq:
+                        yield dq.popleft()
+
+                # lazy drain: unconsumed items REMAIN in the deque for the next fit
+                train_iter = itertools.chain(_drain(), train_iter)
+            prefetch = None
+            start_step = int(state.step)
+            if cfg.prefetch_batches > 0 and start_step < cfg.max_steps:
+                # only when steps will actually run — a no-op fit must not pull
+                # (and discard) items from a shared stateful iterator
+                from perceiver_io_tpu.data.loader import PrefetchIterator
+
+                train_iter = prefetch = PrefetchIterator(train_iter, depth=cfg.prefetch_batches)
+            window: list = []
+            window_samples = 0
+            # perf_counter, matching GoodputTracker's clock: the goodput
+            # subtraction must not mix monotonic and wall (NTP-steppable) time
+            t0 = time.perf_counter()
+            window_overhead0 = goodput.overhead()
+            try:
+                for _ in range(start_step, cfg.max_steps):
+                    batch = self._prepare_batch(next(train_iter))
+                    state, metrics = self._train_step(state, batch)
+                    window.append(metrics)
+                    window_samples += _leading_dim(batch)
+                    step = int(state.step)
+
+                    if step % cfg.log_interval == 0 or step == cfg.max_steps:
+                        avg = {
+                            cfg.metric_prefix_train + k: float(np.mean([float(m[k]) for m in window]))
+                            for k in window[-1]
+                        }
+                        if self.lr_schedule is not None:
+                            avg["lr"] = float(self.lr_schedule(step))
+                        # throughput/MFU over GROSS window wall time: a window
+                        # that absorbed a compile or eval reports the dip, and
+                        # the goodput column says how much of it was overhead
+                        elapsed = max(time.perf_counter() - t0, 1e-9)
+                        avg["steps_per_sec"] = len(window) / elapsed
+                        if cfg.tokens_per_sample:
+                            avg["tokens_per_sec"] = cfg.tokens_per_sample * window_samples / elapsed
+                        if cfg.flops_per_sample:
+                            flops_per_sec = cfg.flops_per_sample * window_samples / elapsed
+                            avg["model_flops_per_sec"] = flops_per_sec
+                            if peak:
+                                avg["mfu"] = flops_per_sec / (peak * n_dev)
+                        # per-WINDOW goodput (overhead delta since the last log
+                        # row), so the column attributes THIS window's dip; the
+                        # run-cumulative breakdown comes once, at fit_end
+                        window_overhead = goodput.overhead() - window_overhead0
+                        avg["goodput"] = min(
+                            max(elapsed - window_overhead, 0.0) / elapsed, 1.0
+                        )
+                        self._log(step, avg)
+                        if events is not None:
+                            events.emit("log", step=step, **avg)
+                        window, window_samples, t0 = [], 0, time.perf_counter()
+                        window_overhead0 = goodput.overhead()
+
+                    at_val = cfg.val_interval is not None and step % cfg.val_interval == 0
+                    if (at_val or step == cfg.max_steps) and val_loader is not None:
+                        # eval bucket = wall time MINUS any eval_step compile the
+                        # RecompileTracker already booked into the compile bucket,
+                        # so the two buckets never double-count the same seconds
+                        eval_t0 = time.perf_counter()
+                        compile_s0 = self.recompiles.total_compile_s
+                        val_metrics = self.validate(state, val_loader)
+                        goodput.add(
+                            "eval",
+                            (time.perf_counter() - eval_t0)
+                            - (self.recompiles.total_compile_s - compile_s0),
+                        )
+                        self._log(step, val_metrics)
+                        if events is not None:
+                            events.emit("eval", step=step, **val_metrics)
+                        if self.checkpoints is not None:
+                            with goodput.measure("checkpoint"):
+                                self.checkpoints.save(state, metrics=val_metrics, config=model_config)
+                        for cb in self.callbacks:
+                            cb(self, state, step)
+            finally:
+                if prefetch is not None:
+                    prefetch.close()
+                    # the prefetch pulled items ahead of the step loop — they
+                    # logically precede anything still parked in the deque
+                    self._residual_batches.extendleft(reversed(prefetch.residual))
+                    if prefetch.alive():
+                        # producer stuck in the source iterator; hold the wrapper
+                        # so the next fit can harvest (and refuses to race it)
+                        self._pending_prefetch = prefetch
+                    try:
+                        import weakref
+
+                        self._residual_src = weakref.ref(src)
+                    except TypeError:  # not weakref-able (e.g. plain list_iterator)
+                        self._residual_src = None
+                # commit any in-flight async save even when the loop raises
+                # (callback/iterator error, KeyboardInterrupt) — otherwise a
+                # hard exit abandons the last checkpoint
+                if self.checkpoints is not None:
+                    with goodput.measure("checkpoint"):
+                        self.checkpoints.wait_until_finished()
+            if val_loader is None and self.checkpoints is not None:
+                # no validation: leave a final latest-state checkpoint via a
+                # monitor-free manager (Lightning save-last parity) so NaN metrics
+                # never pollute best-k retention
+                final_mngr = CheckpointManager(
+                    self.config.checkpoint_dir,
+                    max_to_keep=self.config.max_checkpoints,
+                    monitor=None,
+                    save_weights_only=self.config.save_weights_only,
+                )
+                with goodput.measure("checkpoint"):
+                    final_mngr.save(state, config=model_config)
+                    final_mngr.close()
+        except BaseException:
+            if events is not None:
+                events.emit(
+                    "fit_end",
+                    step=int(state.step),
+                    aborted=True,
+                    recompiles=self.recompiles.counts(),
+                    **goodput.summary(),
+                )
+            raise
+        if events is not None:
+            events.emit(
+                "fit_end",
+                step=int(state.step),
+                aborted=False,
+                recompiles=self.recompiles.counts(),
+                **goodput.summary(),
+            )
         return state
 
     def close(self) -> None:
@@ -274,3 +406,5 @@ class Trainer:
         if self.checkpoints is not None:
             self.checkpoints.close()
             self.checkpoints = None
+        if self._events is not None:
+            self._events.close()

@@ -159,6 +159,36 @@ class ModelInfo:
         return self.compute_estimator.cross_attn(self.num_channels, prefix_dropout)
 
 
+def train_step_flops(config, batch_size: int, prefix_dropout_keep: float) -> float:
+    """Analytic training FLOPs (fwd+bwd ~ 3x fwd matmuls) for one step of a
+    Perceiver AR CLM config: self-attention part over latents +
+    cross-attention over the (dropout-discounted) prefix.
+
+    This is THE shared cost model for MFU across surfaces — ``bench.py``'s
+    telemetry block and the trainer's per-log-row ``mfu``
+    (``obs.mfu.clm_train_telemetry``) both use it, so the two numbers are
+    directly comparable for the same config on the same chip. Unlike the
+    reference :class:`ComputeEstimator` (kept for scaling-study parity) it
+    counts the CA q/o projections and CA MLP and honors the config's
+    widening factors.
+    """
+    lat, c, layers = config.max_latents, config.num_channels, config.num_self_attention_layers
+    prefix = (config.max_seq_len - lat) * prefix_dropout_keep
+    kv = prefix + lat
+    wf_sa, wf_ca = config.self_attention_widening_factor, config.cross_attention_widening_factor
+
+    # per-token matmul FLOPs (x2 for multiply-add)
+    ca_proj = 2 * lat * (4 * c * c) + 2 * prefix * (2 * c * c)  # q,o over latents; k,v over all kv
+    ca_attn = 2 * 2 * lat * kv * c
+    ca_mlp = 2 * lat * 2 * wf_ca * c * c
+    sa_proj = layers * 2 * lat * 4 * c * c
+    sa_attn = layers * 2 * 2 * lat * lat * c
+    sa_mlp = layers * 2 * lat * 2 * wf_sa * c * c
+    logits = 2 * lat * c * config.vocab_size
+    fwd = ca_proj + ca_attn + ca_mlp + sa_proj + sa_attn + sa_mlp + logits
+    return 3.0 * fwd * batch_size
+
+
 def num_training_tokens(num_steps: int, num_latents: int, batch_size: int) -> int:
     return batch_size * num_latents * num_steps
 

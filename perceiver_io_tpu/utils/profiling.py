@@ -7,7 +7,7 @@ from __future__ import annotations
 
 import contextlib
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional, Sequence
 
 
 @contextlib.contextmanager
@@ -55,5 +55,38 @@ class StepTimer:
             raise ValueError("No timed steps (after warmup discard)")
         return sum(steps) / len(steps)
 
+    def percentile(self, p: float) -> float:
+        """The p-th percentile (0..100) of the retained step times, linearly
+        interpolated between order statistics."""
+        steps = self.steps
+        if not steps:
+            raise ValueError("No timed steps (after warmup discard)")
+        return percentile(steps, p)
+
+    def summary(self) -> Dict[str, float]:
+        """The percentile summary the class docstring promises: p50/p90/p99
+        plus mean and sample count. (``bench.py`` builds its telemetry
+        percentiles from :func:`percentile` directly — its samples need
+        per-chain normalization before summarizing.)"""
+        return {
+            "mean": self.mean(),
+            "p50": self.percentile(50),
+            "p90": self.percentile(90),
+            "p99": self.percentile(99),
+            "n": float(len(self.steps)),
+        }
+
     def steps_per_sec(self) -> float:
         return 1.0 / self.mean()
+
+
+def percentile(values: Sequence[float], p: float) -> float:
+    """Linear-interpolation percentile of a non-empty sequence (numpy's
+    default method, with a ValueError contract on bad inputs)."""
+    if not values:
+        raise ValueError("percentile of an empty sequence")
+    if not 0.0 <= p <= 100.0:
+        raise ValueError(f"percentile p must be in [0, 100], got {p}")
+    import numpy as np
+
+    return float(np.percentile(list(values), p))

@@ -48,3 +48,38 @@ def test_bench_extra_artifact_shape_and_int8_wins():
     for k in expected - {"image_b16"}:
         cf, vs, cap = d[k]["ceiling_fraction"], d[k]["vs_baseline"], d[k]["vs_baseline_cap"]
         assert abs(cf - vs / cap) < 0.02, (k, cf, vs, cap)
+    # telemetry rides along from the first regeneration after the obs/ PR;
+    # when present it must be internally consistent (older artifacts skip)
+    for k, row in d.items():
+        t = row.get("telemetry")
+        if t is None:
+            continue
+        assert t["device_kind"], k
+        if "mfu" in t and t["mfu"] is not None:
+            assert t["mfu"] == pytest.approx(
+                t["model_flops_per_sec"] / t["peak_flops_per_device"], rel=0.01
+            ), k
+
+
+def test_bench_telemetry_fields_shape():
+    """The telemetry block every bench result carries (ISSUE 1 satellite):
+    MFU against the obs.mfu peak table plus the StepTimer percentile
+    summary — validated on synthetic numbers so no device work runs."""
+    import bench
+    from perceiver_io_tpu.obs.mfu import device_peak_flops
+
+    t = bench.telemetry_fields(1e12, 0.5, step_times_s=[0.4, 0.5, 0.6])["telemetry"]
+    assert t["model_flops_per_sec"] == pytest.approx(2e12)
+    peak = device_peak_flops()
+    assert t["peak_flops_per_device"] == peak
+    assert t["mfu"] == pytest.approx(2e12 / peak, rel=0.01)
+    assert t["step_ms"]["p50"] == pytest.approx(500.0)
+    assert t["step_ms"]["p50"] <= t["step_ms"]["p90"] <= t["step_ms"]["p99"]
+
+    # decode rows: no FLOPs model (bandwidth-bound), per-token latency only
+    td = bench.telemetry_fields(None, 0.01, step_times_s=[0.01], times_key="token_ms")[
+        "telemetry"
+    ]
+    assert "mfu" not in td and "model_flops_per_sec" not in td
+    assert td["token_ms"]["p99"] == pytest.approx(10.0)
+    assert td["device_kind"]

@@ -1,0 +1,519 @@
+"""Telemetry subsystem (obs/): a CPU-backed Trainer.fit run must produce
+events.jsonl + run_manifest.json with non-null MFU/throughput fields and a
+compile event; the xplane per-scope rollup must reproduce the raw per-op
+totals on a hand-built varint-encoded golden; MetricsLogger must survive a
+resume without corrupting its CSV; StepTimer delivers the percentile
+summary its docstring promises; obs_report renders it all."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from perceiver_io_tpu.models.text import CausalLanguageModel, CausalLanguageModelConfig
+from perceiver_io_tpu.obs import (
+    EventLog,
+    RecompileTracker,
+    clm_train_telemetry,
+    config_hash,
+    device_peak_flops,
+)
+from perceiver_io_tpu.obs.mfu import GoodputTracker
+from perceiver_io_tpu.training import (
+    MetricsLogger,
+    TrainState,
+    Trainer,
+    TrainerConfig,
+    clm_loss_fn,
+    make_optimizer,
+)
+
+
+def tiny_clm():
+    config = CausalLanguageModelConfig(
+        vocab_size=50, max_seq_len=24, max_latents=8, num_channels=32,
+        num_heads=4, num_self_attention_layers=2, cross_attention_dropout=0.5,
+    )
+    return CausalLanguageModel(config), config
+
+
+def clm_batch(config, batch=4, seed=0):
+    rng = np.random.default_rng(seed)
+    t = rng.integers(0, config.vocab_size, size=(batch, config.max_seq_len + 1))
+    return {
+        "labels": jnp.asarray(t[:, 1:]),
+        "input_ids": jnp.asarray(t[:, :-1]),
+        "pad_mask": None,
+    }
+
+
+def run_tiny_fit(tmp_path, max_steps=4, log_interval=2):
+    """A short CPU-backed training run with full telemetry (the ISSUE's
+    acceptance workload)."""
+    model, config = tiny_clm()
+    batch = clm_batch(config)
+    params = model.init(jax.random.PRNGKey(0), batch["input_ids"], prefix_len=16)
+    state = TrainState.create(model.apply, params, make_optimizer(1e-3), jax.random.PRNGKey(1))
+    tokens_per_sample, flops_per_sample = clm_train_telemetry(config)
+    logger = MetricsLogger(str(tmp_path), use_tensorboard=False)
+    trainer = Trainer(
+        clm_loss_fn(model.apply, max_latents=config.max_latents),
+        logger=logger,
+        config=TrainerConfig(
+            max_steps=max_steps,
+            log_interval=log_interval,
+            prefetch_batches=0,
+            tokens_per_sample=tokens_per_sample,
+            flops_per_sample=flops_per_sample,
+        ),
+    )
+    state = trainer.fit(state, iter([batch] * max_steps), model_config=config)
+    trainer.close()
+    logger.close()
+    return state
+
+
+def read_events(run_dir):
+    with open(os.path.join(str(run_dir), "events.jsonl")) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+# ---------------------------------------------------------------- trainer
+
+
+def test_trainer_emits_events_manifest_and_mfu(tmp_path):
+    run_tiny_fit(tmp_path)
+    events = read_events(tmp_path)
+    kinds = [e["event"] for e in events]
+    assert kinds[0] == "fit_start"
+    assert kinds[-1] == "fit_end"
+    assert "compile" in kinds  # the train step's first trace+compile surfaced
+
+    # every log row carries non-null throughput/MFU accounting
+    logs = [e for e in events if e["event"] == "log"]
+    assert len(logs) == 2  # steps 2 and 4 at log_interval=2
+    for row in logs:
+        assert row["tokens_per_sec"] > 0
+        assert row["model_flops_per_sec"] > 0
+        assert row["mfu"] > 0
+        assert 0.0 <= row["goodput"] <= 1.0
+        assert "train_loss" in row
+
+    # the same fields land in metrics.csv (the human-facing mirror)
+    import csv
+
+    with open(os.path.join(str(tmp_path), "metrics.csv"), newline="") as f:
+        rows = list(csv.DictReader(f))
+    assert rows and float(rows[-1]["mfu"]) > 0
+    assert float(rows[-1]["tokens_per_sec"]) > 0
+
+    # fit_end carries the goodput breakdown and the recompile audit
+    end = events[-1]
+    assert end["recompiles"]["train_step"] == 1
+    assert end["total_s"] > 0 and end["compile_s"] > 0
+    assert 0.0 <= end["goodput"] <= 1.0
+
+    manifest = json.load(open(os.path.join(str(tmp_path), "run_manifest.json")))
+    assert manifest["jax_version"] == jax.__version__
+    assert manifest["device_kind"]
+    assert manifest["device_count"] >= 1
+    assert manifest["mesh"] is None  # no mesh in this run
+    assert len(manifest["config_hash"]) == 12
+    # the hash is stable across identical configs
+    _, config = tiny_clm()
+    assert config_hash(config, None) == config_hash(config, None)
+
+
+def test_trainer_aborted_run_still_emits_fit_end(tmp_path):
+    """A run killed mid-loop must still leave the goodput/recompile audit —
+    it is exactly the run that needs diagnosing."""
+    model, config = tiny_clm()
+    batch = clm_batch(config)
+    params = model.init(jax.random.PRNGKey(0), batch["input_ids"], prefix_len=16)
+    state = TrainState.create(model.apply, params, make_optimizer(1e-3), jax.random.PRNGKey(1))
+    tokens_per_sample, flops_per_sample = clm_train_telemetry(config)
+    logger = MetricsLogger(str(tmp_path), use_tensorboard=False)
+    trainer = Trainer(
+        clm_loss_fn(model.apply, max_latents=config.max_latents),
+        logger=logger,
+        config=TrainerConfig(
+            max_steps=10, log_interval=2, prefetch_batches=0,
+            tokens_per_sample=tokens_per_sample, flops_per_sample=flops_per_sample,
+        ),
+    )
+    def dying_loader():
+        yield batch
+        yield batch
+        raise RuntimeError("data source died")
+
+    with pytest.raises(RuntimeError, match="data source died"):
+        trainer.fit(state, dying_loader(), model_config=config)
+    trainer.close()
+    logger.close()
+    end = [e for e in read_events(tmp_path) if e["event"] == "fit_end"]
+    assert len(end) == 1 and end[0]["aborted"] is True
+    assert end[0]["recompiles"]["train_step"] == 1
+    assert end[0]["compile_s"] > 0
+
+
+def test_trainer_telemetry_off_without_logger(tmp_path):
+    model, config = tiny_clm()
+    batch = clm_batch(config)
+    params = model.init(jax.random.PRNGKey(0), batch["input_ids"], prefix_len=16)
+    state = TrainState.create(model.apply, params, make_optimizer(1e-3), jax.random.PRNGKey(1))
+    trainer = Trainer(
+        clm_loss_fn(model.apply, max_latents=config.max_latents),
+        config=TrainerConfig(max_steps=1, log_interval=1, prefetch_batches=0),
+    )
+    trainer.fit(state, iter([batch]), model_config=config)
+    trainer.close()
+    assert not os.path.exists(os.path.join(str(tmp_path), "events.jsonl"))
+
+
+def test_clm_train_telemetry_matches_bench_cost_model():
+    """The trainer's MFU numerator and bench.py's telemetry block must share
+    ONE cost model, or the two surfaces report incomparable MFU for the
+    same config on the same chip."""
+    _, config = tiny_clm()
+    tokens, flops = clm_train_telemetry(config)
+    assert tokens == config.max_latents
+    from perceiver_io_tpu.utils.flops import train_step_flops
+
+    keep = 1.0 - config.cross_attention_dropout
+    assert flops == pytest.approx(train_step_flops(config, 1, prefix_dropout_keep=keep))
+    import bench
+
+    assert bench.train_step_flops is train_step_flops  # bench re-exports, not forks
+    # non-CLM configs have no analytic model: None, not a bogus number
+    assert clm_train_telemetry(object()) is None
+
+
+# ------------------------------------------------------------- recompiles
+
+
+def test_recompile_tracker_counts_shape_driven_recompiles(tmp_path):
+    events = EventLog(str(tmp_path), main_process=True)
+    tracker = RecompileTracker(events=events, goodput=GoodputTracker())
+    f = tracker.wrap(jax.jit(lambda x: x * 2), "f")
+    f(jnp.ones((2,)))
+    f(jnp.ones((2,)))  # cache hit: no event
+    f(jnp.ones((3,)))  # new shape: silent recompile surfaces
+    assert tracker.counts()["f"] == 2
+    compiles = [e for e in read_events(tmp_path) if e["event"] == "compile"]
+    assert len(compiles) == 2
+    # the shape signatures differ — that's what identifies the leak
+    assert compiles[0]["arg_shapes"] != compiles[1]["arg_shapes"]
+    assert all(c["wall_s"] >= 0 for c in compiles)
+    assert tracker.total_compile_s >= 0
+
+
+# ---------------------------------------------------------- xplane golden
+
+
+def _vint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _varint_field(fnum: int, n: int) -> bytes:
+    return _vint(fnum << 3) + _vint(n)
+
+
+def _len_field(fnum: int, payload: bytes) -> bytes:
+    return _vint((fnum << 3) | 2) + _vint(len(payload)) + payload
+
+
+def golden_xplane() -> (bytes, dict):
+    """A hand-encoded XSpace: one device plane, one "XLA Ops" line, six ops —
+    two with scope paths in their display names, one raw HLO op, one with
+    the path in an XEventMetadata ``tf_op`` stat (str_value), one with an
+    interned per-event stat (ref_value), one unscoped. Field numbers match
+    the parser's contract (obs/xplane.py wire-format notes)."""
+    ops = {
+        1: ("jit(train_step)/perceiver_ar/cross_attend/fusion.1", 3000),
+        2: ("jit(train_step)/perceiver_ar/cross_attend/dot.7", 1500),
+        3: ("jit(train_step)/perceiver_ar/self_attend/fusion.2", 2000),
+        4: ("copy.3", 500),
+        5: ("fusion.9", 1000),  # scope via metadata tf_op stat
+        6: ("dot.11", 250),  # scope via per-event interned ref stat
+    }
+    # stat_metadata: 50 = the "tf_op" stat key; 60 = an interned path string
+    ref_path = "jit(train_step)/decode/sample/dot.11"
+    stat_metadata = b"".join(
+        _len_field(5, _varint_field(1, sid) + _len_field(2, _varint_field(1, sid) + _len_field(2, sname.encode())))
+        for sid, sname in ((50, "tf_op"), (60, ref_path))
+    )
+
+    def event(mid, dur, stats=b""):
+        return _len_field(4, _varint_field(1, mid) + _varint_field(3, dur) + stats)
+
+    ref_stat = _len_field(4, _varint_field(1, 50) + _varint_field(7, 60))  # XEvent.stats
+    events = b"".join(
+        event(mid, dur, stats=ref_stat if mid == 6 else b"")
+        for mid, (_, dur) in ops.items()
+    )
+    line = _len_field(2, b"XLA Ops") + events
+
+    tf_op_stat = _len_field(
+        5, _varint_field(1, 50) + _len_field(5, b"jit(train_step)/perceiver_ar/mlp/fusion.9")
+    )  # XEventMetadata.stats
+
+    def meta(mid, name):
+        payload = _varint_field(1, mid) + _len_field(2, name.encode())
+        if mid == 5:
+            payload += tf_op_stat
+        return _len_field(4, _varint_field(1, mid) + _len_field(2, payload))
+
+    metadata = b"".join(meta(mid, name) for mid, (name, _) in ops.items())
+    plane = _len_field(2, b"/device:TPU:0") + _len_field(3, line) + metadata + stat_metadata
+    return _len_field(1, plane), ops
+
+
+def test_xplane_golden_parse_and_scope_rollup(tmp_path):
+    from perceiver_io_tpu.obs import xplane as ox
+
+    buf, ops = golden_xplane()
+    path = os.path.join(str(tmp_path), "golden.xplane.pb")
+    with open(path, "wb") as f:
+        f.write(buf)
+
+    # raw per-op totals (the tools/xplane.py view)
+    planes = list(ox.iter_planes(path))
+    assert len(planes) == 1
+    plane = planes[0]
+    assert plane.name == "/device:TPU:0"
+    total = sum(dur for _, dur in ops.values())
+    assert plane.total_ps == total == 8250
+    assert plane.per_op[ops[1][0]] == 3000
+    assert plane.per_line == {"XLA Ops": total}
+    # the stat-carried paths were resolved (metadata stat + interned event stat)
+    assert plane.op_scopes["fusion.9"] == "jit(train_step)/perceiver_ar/mlp/fusion.9"
+    assert plane.op_scopes["dot.11"] == "jit(train_step)/decode/sample/dot.11"
+
+    # per-scope rollup: aggregates by module path, reproduces the totals
+    rolls = ox.rollup(path)
+    assert len(rolls) == 1
+    scopes = rolls[0].scopes
+    assert scopes["perceiver_ar/cross_attend"] == (4500, 2)  # fusion.1 + dot.7
+    assert scopes["perceiver_ar/self_attend"] == (2000, 1)
+    assert scopes["perceiver_ar/mlp"] == (1000, 1)  # via XEventMetadata tf_op stat
+    assert scopes["decode/sample"] == (250, 1)  # via per-event ref stat
+    assert scopes[ox.UNSCOPED] == (500, 1)
+    assert rolls[0].total_ps == plane.total_ps  # acceptance: same totals
+
+    # depth truncation merges sibling scopes
+    deep = ox.rollup(path, depth=1)[0].scopes
+    assert deep["perceiver_ar"] == (7500, 4)
+
+    # the tools/xplane.py CLI entry resolves to the same numbers
+    import importlib.util
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "tools_xplane", os.path.join(root, "tools", "xplane.py")
+    )
+    tools_xplane = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tools_xplane)
+    out = []
+    cli_planes = tools_xplane.summarize(path, top=10, print_fn=out.append)
+    assert cli_planes[0].total_ps == rolls[0].total_ps
+    assert any("XLA Ops" in line for line in out)  # the CLI rendering ran
+
+
+def test_scope_of_rules():
+    from perceiver_io_tpu.obs.xplane import UNSCOPED, scope_of
+
+    assert scope_of("jit(f)/jit(main)/a/b/op") == "a/b"
+    assert scope_of("transpose(jit(f))/a/op") == "a"
+    assert scope_of("jit(f)/a/b/op", depth=1) == "a"
+    assert scope_of("fusion.12") == UNSCOPED
+    assert scope_of("jit(f)/op") == UNSCOPED
+
+
+# ------------------------------------------------------- metrics resume
+
+
+def test_metrics_logger_resume_keeps_single_header(tmp_path):
+    d = str(tmp_path)
+    l1 = MetricsLogger(d, use_tensorboard=False, main_process=True)
+    l1.log(1, {"a": 1.0})
+    l1.close()
+
+    # restart: a new logger against the same metrics.csv, with a widening key
+    l2 = MetricsLogger(d, use_tensorboard=False, main_process=True)
+    l2.log(2, {"a": 2.0, "b": 3.0})
+    l2.log(3, {"a": 4.0})
+    l2.close()
+
+    import csv
+
+    with open(os.path.join(d, "metrics.csv"), newline="") as f:
+        raw = f.read().splitlines()
+    # exactly one header row, first line, widened to include b
+    assert sum(1 for line in raw if line.startswith("step,")) == 1
+    header = raw[0].split(",")
+    assert "a" in header and "b" in header
+    with open(os.path.join(d, "metrics.csv"), newline="") as f:
+        rows = list(csv.DictReader(f))
+    assert [int(float(r["step"])) for r in rows] == [1, 2, 3]
+    assert rows[0]["b"] == ""  # pre-widening row backfilled empty
+    assert float(rows[1]["b"]) == 3.0
+
+
+def test_metrics_logger_resume_foreign_header_rewritten(tmp_path):
+    """A metrics.csv whose header lacks the step/time contract keys must be
+    rewritten on resume — appending to _keys alone would misalign rows."""
+    import csv
+
+    d = str(tmp_path)
+    path = os.path.join(d, "metrics.csv")
+    with open(path, "w", newline="") as f:
+        f.write("loss\n0.9\n")
+    logger = MetricsLogger(d, use_tensorboard=False, main_process=True)
+    logger.log(1, {"loss": 0.4})
+    logger.close()
+    with open(path, newline="") as f:
+        raw = f.read().splitlines()
+    header = raw[0].split(",")
+    assert header[0] == "loss" and "step" in header and "time" in header
+    assert len(raw) == 3  # one header + the old row + the new row
+    with open(path, newline="") as f:
+        rows = list(csv.DictReader(f))
+    assert float(rows[0]["loss"]) == 0.9 and rows[0]["step"] == ""
+    assert float(rows[1]["loss"]) == 0.4 and int(float(rows[1]["step"])) == 1
+
+
+# -------------------------------------------------------------- profiling
+
+
+def test_steptimer_percentile_summary():
+    from perceiver_io_tpu.utils.profiling import StepTimer, percentile
+
+    timer = StepTimer(warmup=1)
+    timer._times = [99.0] + [float(i) for i in range(1, 11)]  # warmup discarded
+    assert timer.percentile(50) == pytest.approx(5.5)
+    assert timer.percentile(0) == 1.0 and timer.percentile(100) == 10.0
+    s = timer.summary()
+    assert s["p50"] == pytest.approx(5.5)
+    assert s["p90"] == pytest.approx(9.1)
+    assert s["p99"] == pytest.approx(9.91)
+    assert s["mean"] == pytest.approx(5.5)
+    assert s["n"] == 10
+    with pytest.raises(ValueError):
+        StepTimer().percentile(50)
+    with pytest.raises(ValueError):
+        percentile([1.0], 150)
+
+
+# -------------------------------------------------------------- goodput
+
+
+def test_goodput_tracker_buckets():
+    t = [0.0]
+    clock = lambda: t[0]  # noqa: E731
+    g = GoodputTracker(clock=clock)
+    t[0] = 10.0
+    with g.measure("compile"):
+        t[0] = 12.0
+    with g.measure("eval"):
+        t[0] = 13.0
+    s = g.summary()
+    assert s["total_s"] == pytest.approx(13.0)
+    assert s["compile_s"] == pytest.approx(2.0)
+    assert s["eval_s"] == pytest.approx(1.0)
+    assert s["productive_s"] == pytest.approx(10.0)
+    assert s["goodput"] == pytest.approx(10.0 / 13.0, abs=1e-3)
+
+
+def test_device_peak_flops_table():
+    # the current (CPU) device resolves to the nominal placeholder entry
+    assert device_peak_flops() == 100e9
+
+    class Fake:
+        def __init__(self, kind, platform="tpu"):
+            self.device_kind = kind
+            self.platform = platform
+
+    assert device_peak_flops(Fake("TPU v5 lite")) == 197e12
+    assert device_peak_flops(Fake("TPU v4")) == 275e12
+    assert device_peak_flops(Fake("NVIDIA A100-SXM4-40GB", "gpu")) == 312e12
+    assert device_peak_flops(Fake("warp drive", "quantum")) is None
+
+
+# ------------------------------------------------------------ obs_report
+
+
+def test_obs_report_renders_run_summary(tmp_path):
+    run_tiny_fit(tmp_path)
+    import importlib.util
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "obs_report", os.path.join(root, "tools", "obs_report.py")
+    )
+    obs_report = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(obs_report)
+    text = obs_report.render(str(tmp_path))
+    assert "== manifest ==" in text
+    assert "jax_version" in text
+    assert "== compiles ==" in text and "train_step" in text
+    assert "mfu" in text and "tokens_per_sec" in text
+    assert "== goodput (fit_end) ==" in text
+    # no spurious recompile warning on a clean single-shape run
+    assert "WARNING: recompiles" not in text
+
+    # a RESUMED run appends a second legitimate first-compile (fresh process,
+    # n_compiles resets to 1) — still no leak warning; a genuine same-process
+    # recompile (n_compiles=2) must warn
+    with open(os.path.join(str(tmp_path), "events.jsonl"), "a") as f:
+        f.write(json.dumps({"ts": 0, "event": "compile", "fn": "train_step",
+                            "wall_s": 1.0, "n_compiles": 1}) + "\n")
+    assert "WARNING: recompiles" not in obs_report.render(str(tmp_path))
+    with open(os.path.join(str(tmp_path), "events.jsonl"), "a") as f:
+        f.write(json.dumps({"ts": 0, "event": "compile", "fn": "train_step",
+                            "wall_s": 1.0, "n_compiles": 2}) + "\n")
+    assert "WARNING: recompiles after the first on: train_step" in obs_report.render(str(tmp_path))
+
+
+# ------------------------------------------------------------ generation
+
+
+def test_instrumented_generation_stats_and_events(tmp_path):
+    from perceiver_io_tpu.generation import GenerationConfig, make_instrumented_generate_fn
+
+    model, config = tiny_clm()
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(rng.integers(0, config.vocab_size, size=(2, 12)))
+    params = model.init(jax.random.PRNGKey(0), prompt, prefix_len=8)
+    events = EventLog(str(tmp_path), main_process=True)
+    fn = make_instrumented_generate_fn(
+        model, num_latents=4, config=GenerationConfig(max_new_tokens=4), events=events
+    )
+    out, stats = fn(params, prompt)
+    assert out.shape == (2, 16)
+    assert stats.compiled  # first call pays the compiles
+    assert stats.prefill_s > 0 and stats.decode_s >= 0
+    assert stats.tokens_per_sec > 0
+    assert stats.batch == 2 and stats.prompt_len == 12 and stats.new_tokens == 4
+
+    out2, stats2 = fn(params, prompt)
+    assert not stats2.compiled  # warm call: no recompile
+    assert np.array_equal(np.asarray(out), np.asarray(out2))  # same rng default
+
+    evs = read_events(tmp_path)
+    gen_events = [e for e in evs if e["event"] == "generate"]
+    assert len(gen_events) == 2
+    assert gen_events[0]["per_token_s"] >= 0
+    # both compiled programs surfaced as compile events on the first call
+    compile_fns = {e["fn"] for e in evs if e["event"] == "compile"}
+    assert compile_fns == {"generate_prefill", "generate_full"}

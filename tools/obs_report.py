@@ -1,0 +1,169 @@
+"""Render a run directory's telemetry (``events.jsonl`` +
+``run_manifest.json``) into a plain-text run summary.
+
+    python tools/obs_report.py <run_dir> [--max-compile-rows N]
+
+Sections: the manifest (what the run ran on), event counts, compile events
+(the recompile audit — a second compile of the same function within one
+process is a shape leak; resumed runs legitimately append another first
+compile), the
+latest throughput/MFU/goodput log row, the goodput breakdown from
+``fit_end``, and generation latency stats. Stdlib-only: runs anywhere the
+run directory can be copied to.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Dict, List, Optional
+
+
+def load_events(run_dir: str) -> List[Dict]:
+    path = os.path.join(run_dir, "events.jsonl")
+    if not os.path.exists(path):
+        return []
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue  # a torn tail line from a killed run is expected
+    return events
+
+
+def load_manifest(run_dir: str) -> Optional[Dict]:
+    path = os.path.join(run_dir, "run_manifest.json")
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def _fmt(v) -> str:
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return str(v)
+
+
+def _table(rows: List[List[str]], header: List[str]) -> List[str]:
+    widths = [max(len(r[i]) for r in [header] + rows) for i in range(len(header))]
+    out = ["  ".join(h.ljust(w) for h, w in zip(header, widths))]
+    out.append("  ".join("-" * w for w in widths))
+    for r in rows:
+        out.append("  ".join(c.ljust(w) for c, w in zip(r, widths)))
+    return out
+
+
+def render(run_dir: str, max_compile_rows: int = 20) -> str:
+    """The run summary as one string (the CLI prints it; tests assert on it)."""
+    lines: List[str] = [f"run: {os.path.abspath(run_dir)}"]
+    manifest = load_manifest(run_dir)
+    if manifest is not None:
+        lines.append("")
+        lines.append("== manifest ==")
+        for key in (
+            "created_at",
+            "jax_version",
+            "backend",
+            "device_kind",
+            "device_count",
+            "process_count",
+            "mesh",
+            "config_hash",
+        ):
+            if key in manifest:
+                lines.append(f"  {key}: {_fmt(manifest[key])}")
+
+    events = load_events(run_dir)
+    if not events:
+        lines.append("\nno events.jsonl (telemetry off, or the run never logged)")
+        return "\n".join(lines)
+
+    counts: Dict[str, int] = {}
+    for e in events:
+        counts[e.get("event", "?")] = counts.get(e.get("event", "?"), 0) + 1
+    lines.append("")
+    lines.append("== events ==")
+    lines.append("  " + ", ".join(f"{k}: {v}" for k, v in sorted(counts.items())))
+
+    compiles = [e for e in events if e.get("event") == "compile"]
+    if compiles:
+        lines.append("")
+        lines.append("== compiles ==")
+        per_fn: Dict[str, List[float]] = {}
+        for e in compiles:
+            per_fn.setdefault(e.get("fn", "?"), []).append(float(e.get("wall_s", 0.0)))
+        rows = [
+            [fn, str(len(walls)), f"{sum(walls):.3f}s"]
+            for fn, walls in sorted(per_fn.items())
+        ]
+        lines.extend("  " + r for r in _table(rows[:max_compile_rows], ["fn", "count", "wall"]))
+        # shape-leak signal: an event's n_compiles counter > 1 means the SAME
+        # process compiled the same fn twice — a raw per-file count would
+        # false-positive on resumed runs, whose new process appends its own
+        # legitimate first compile to the shared events.jsonl
+        leaks = sorted({e.get("fn", "?") for e in compiles if e.get("n_compiles", 1) > 1})
+        if leaks:
+            lines.append(f"  WARNING: recompiles after the first on: {', '.join(leaks)}")
+
+    logs = [e for e in events if e.get("event") == "log"]
+    if logs:
+        last = logs[-1]
+        lines.append("")
+        lines.append(f"== latest log row (step {last.get('step')}) ==")
+        for key in sorted(last):
+            if key in ("ts", "event", "step"):
+                continue
+            lines.append(f"  {key}: {_fmt(last[key])}")
+
+    ends = [e for e in events if e.get("event") == "fit_end"]
+    if ends:
+        end = ends[-1]
+        lines.append("")
+        lines.append("== goodput (fit_end) ==")
+        for key in sorted(end):
+            if key in ("ts", "event"):
+                continue
+            lines.append(f"  {key}: {_fmt(end[key])}")
+
+    gens = [e for e in events if e.get("event") == "generate"]
+    if gens:
+        lines.append("")
+        lines.append(f"== generation ({len(gens)} calls) ==")
+        # steady-state stats exclude calls that paid a compile; when EVERY
+        # call compiled there is no steady state — say so instead of
+        # presenting compile-inflated latencies as clean numbers
+        warm = [g for g in gens if not g.get("compiled")]
+        if warm:
+            note = "  (warm calls only)" if len(warm) < len(gens) else ""
+        else:
+            warm = gens
+            note = "  (ALL calls paid a compile — latencies include it)"
+        for key in ("prefill_s", "per_token_s", "tokens_per_sec"):
+            vals = [float(g[key]) for g in warm if key in g]
+            if vals:
+                lines.append(
+                    f"  {key}: mean {sum(vals)/len(vals):.4g}  "
+                    f"min {min(vals):.4g}  max {max(vals):.4g}" + note
+                )
+    return "\n".join(lines)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("run_dir", help="directory holding events.jsonl / run_manifest.json")
+    p.add_argument(
+        "--max-compile-rows", type=int, default=20, help="cap on compile-table rows"
+    )
+    args = p.parse_args()
+    print(render(args.run_dir, max_compile_rows=args.max_compile_rows))
+
+
+if __name__ == "__main__":
+    main()

@@ -174,14 +174,17 @@ def main():
 
 
 def _summarize(args):
-    import importlib.util
+    from perceiver_io_tpu.obs.xplane import rollup_planes, summarize
 
-    spec = importlib.util.spec_from_file_location(
-        "xplane", os.path.join(os.path.dirname(os.path.abspath(__file__)), "xplane.py")
-    )
-    xplane = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(xplane)
-    xplane.summarize(args.out, args.top, "")
+    # raw per-op totals first, then the named-scope rollup (obs/xplane.py)
+    # from the SAME parsed planes — the scope view is what answers "which
+    # module did the time go to", and the parse dominates on big captures
+    planes = summarize(args.out, args.top, "")
+    print("\n--- per-scope rollup (jax.named_scope / module path) ---")
+    for roll in rollup_planes(planes):
+        print(f"\n=== plane: {roll.plane}")
+        for scope, dur, count in roll.top(args.top):
+            print(f"  {dur/1e9:9.3f} ms {count:6d}x  {scope[:100]}")
 
 
 if __name__ == "__main__":

@@ -1,145 +1,59 @@
-"""Minimal XSpace (xplane.pb) reader — aggregate device-op durations from a
-``jax.profiler.trace`` capture without TensorFlow/tensorboard installed.
+"""Minimal XSpace (xplane.pb) reader CLI — aggregate device-op durations from
+a ``jax.profiler.trace`` capture without TensorFlow/tensorboard installed.
 
-Wire-format notes (tensorflow/core/profiler/protobuf/xplane.proto):
-  XSpace:        planes = 1 (repeated XPlane)
-  XPlane:        id=1, name=2, lines=3 (repeated XLine),
-                 event_metadata=4 (map<int64, XEventMetadata>),
-                 stat_metadata=5
-  XLine:         id=1, display_name? name=2/3, events=6? — fields probed
-  XEvent:        metadata_id=1, offset_ps=2, duration_ps=3
-  XEventMetadata: id=1, name=2
+The implementation lives in ``perceiver_io_tpu/obs/xplane.py`` (this file
+shims to it so existing ``python tools/xplane.py <capture>`` invocations and
+importers keep working); the library adds a per-named-scope rollup on top of
+the raw per-op totals (``--by-scope``).
 
-Usage: python tools/xplane.py <capture_dir_or_pb> [--top 30]
+Usage: python tools/xplane.py <capture_dir_or_pb> [--top 30] [--by-scope]
 """
 
 from __future__ import annotations
 
 import argparse
-import collections
-import glob
+import importlib.util
 import os
+import sys
 
+# load obs/xplane.py BY PATH, not through the package: the tool's point is
+# reading a copied capture on any box with a bare python — importing
+# perceiver_io_tpu would execute the package __init__ and require jax/flax
+_impl_path = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "perceiver_io_tpu",
+    "obs",
+    "xplane.py",
+)
+_spec = importlib.util.spec_from_file_location("_obs_xplane", _impl_path)
+_impl = importlib.util.module_from_spec(_spec)
+sys.modules[_spec.name] = _impl  # dataclass decoration resolves via sys.modules
+_spec.loader.exec_module(_impl)
 
-def _varint(buf: bytes, i: int):
-    shift = result = 0
-    while True:
-        b = buf[i]
-        i += 1
-        result |= (b & 0x7F) << shift
-        if not b & 0x80:
-            return result, i
-        shift += 7
-
-
-def fields(buf: bytes):
-    """Yield (field_number, wire_type, value) over a protobuf message."""
-    i = 0
-    n = len(buf)
-    while i < n:
-        tag, i = _varint(buf, i)
-        fnum, wt = tag >> 3, tag & 7
-        if wt == 0:
-            val, i = _varint(buf, i)
-        elif wt == 2:
-            ln, i = _varint(buf, i)
-            val = buf[i : i + ln]
-            i += ln
-        elif wt == 5:
-            val = int.from_bytes(buf[i : i + 4], "little")
-            i += 4
-        elif wt == 1:
-            val = int.from_bytes(buf[i : i + 8], "little")
-            i += 8
-        else:
-            raise ValueError(f"unsupported wire type {wt}")
-        yield fnum, wt, val
-
-
-def parse_plane(plane: bytes):
-    name = ""
-    metadata = {}
-    lines = []
-    for fnum, wt, val in fields(plane):
-        if fnum == 2 and wt == 2:
-            name = val.decode(errors="replace")
-        elif fnum == 3 and wt == 2:
-            lines.append(val)
-        elif fnum == 4 and wt == 2:
-            # map entry: key=1 varint, value=2 XEventMetadata
-            k = v = None
-            for f2, w2, v2 in fields(val):
-                if f2 == 1:
-                    k = v2
-                elif f2 == 2:
-                    v = v2
-            if k is not None and v is not None:
-                mname = ""
-                mdisplay = ""
-                for f3, w3, v3 in fields(v):
-                    if f3 == 2 and w3 == 2:
-                        mname = v3.decode(errors="replace")
-                    elif f3 == 3 and w3 == 2:
-                        mdisplay = v3.decode(errors="replace")
-                metadata[k] = mdisplay or mname
-    return name, metadata, lines
-
-
-def parse_line_events(line: bytes):
-    """Yield (metadata_id, duration_ps) for each XEvent on the line."""
-    lname = ""
-    evs = []
-    for fnum, wt, val in fields(line):
-        if fnum in (2, 11) and wt == 2:
-            lname = val.decode(errors="replace") or lname
-        elif fnum == 4 and wt == 2:  # XLine.events
-            mid = dur = 0
-            for f2, w2, v2 in fields(val):
-                if f2 == 1:
-                    mid = v2
-                elif f2 == 3:
-                    dur = v2
-            evs.append((mid, dur))
-    for mid, dur in evs:
-        yield lname, mid, dur
-
-
-def summarize(path: str, top: int = 30, line_filter: str = ""):
-    if os.path.isdir(path):
-        pbs = sorted(glob.glob(os.path.join(path, "**", "*.xplane.pb"), recursive=True))
-        if not pbs:
-            raise FileNotFoundError(f"no xplane.pb under {path}")
-        path = pbs[-1]
-    buf = open(path, "rb").read()
-    print(f"{path} ({len(buf)/1e6:.0f} MB)")
-    for fnum, wt, plane in fields(buf):
-        if fnum != 1 or wt != 2:
-            continue
-        name, metadata, lines = parse_plane(plane)
-        per_op = collections.Counter()
-        counts = collections.Counter()
-        per_line = collections.Counter()
-        for line in lines:
-            for lname, mid, dur in parse_line_events(line):
-                if line_filter and line_filter not in lname:
-                    continue
-                op = metadata.get(mid, f"#{mid}")
-                per_op[op] += dur
-                counts[op] += 1
-                per_line[lname] += dur
-        if not per_op:
-            continue
-        total = sum(per_line.values())
-        print(f"\n=== plane: {name} | lines: {dict(per_line.most_common(6))}")
-        print(f"    sum of event time: {total/1e9:.3f} ms")
-        for op, d in per_op.most_common(top):
-            print(f"  {d/1e9:9.3f} ms {counts[op]:6d}x  {op[:100]}")
-
+PlaneSummary = _impl.PlaneSummary
+ScopeRollup = _impl.ScopeRollup
+fields = _impl.fields
+iter_planes = _impl.iter_planes
+parse_line_events = _impl.parse_line_events
+parse_plane = _impl.parse_plane
+resolve_capture = _impl.resolve_capture
+rollup = _impl.rollup
+rollup_planes = _impl.rollup_planes
+scope_of = _impl.scope_of
+summarize = _impl.summarize
 
 if __name__ == "__main__":
     p = argparse.ArgumentParser()
     p.add_argument("path")
     p.add_argument("--top", type=int, default=30)
     p.add_argument("--line", default="", help="only lines whose name contains this")
+    p.add_argument(
+        "--by-scope",
+        action="store_true",
+        help="aggregate by jax.named_scope / module path instead of raw HLO op name",
+    )
+    p.add_argument(
+        "--depth", type=int, default=None, help="truncate scope paths to this many components"
+    )
     args = p.parse_args()
-    summarize(args.path, args.top, args.line)
+    summarize(args.path, args.top, args.line, by_scope=args.by_scope, depth=args.depth)
