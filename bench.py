@@ -106,14 +106,29 @@ TIMER_CHAIN = 2
 TIMER_REPS = 7  # warmup=1 discard leaves 6 samples
 
 
+# pass/fail/skipped status of this invocation's kernel_smoke gate, recorded
+# in every emitted result so a --skip-smoke run is visible in committed
+# artifacts (ADVICE r5); None until main() resolves it (unit tests calling
+# telemetry_fields directly get no kernel_smoke key)
+_SMOKE_STATUS = None
+
+
 def telemetry_fields(flops, step_time, step_times_s=None, times_key: str = "step_ms") -> dict:
-    """The ``telemetry`` block every bench result carries: device kind, MFU
-    against the obs.mfu per-device peak-FLOPs table (None off the table),
-    and a p50/p90/p99 summary of individual wall times when provided
+    """The ``telemetry`` block every bench result carries: device kind, the
+    active trace-time kernel feature set (the A/B lever — so a committed
+    result self-describes which kernels produced it), MFU against the
+    obs.mfu per-device peak-FLOPs table (None off the table), and a
+    p50/p90/p99 summary of individual wall times when provided
     (``step_times_s`` already normalized to per-step/per-token seconds)."""
     from perceiver_io_tpu.obs.mfu import device_peak_flops
+    from perceiver_io_tpu.ops.flash_attention import fast_features
 
-    t = {"device_kind": jax.devices()[0].device_kind}
+    t = {
+        "device_kind": jax.devices()[0].device_kind,
+        "kernel_features": sorted(fast_features()),
+    }
+    if _SMOKE_STATUS is not None:
+        t["kernel_smoke"] = _SMOKE_STATUS
     if flops is not None:
         peak = device_peak_flops()
         rate = flops / step_time
@@ -503,6 +518,9 @@ def kernel_smoke() -> None:
 
     - packed flash attention (the flagship hot path) fwd AND bwd against
       the materialized-scores einsum reference,
+    - the two-segment packed kernels (the `fast_kernels` "twoseg" prefix
+      cross-attention route) fwd AND bwd against the packed concat path,
+      at an odd prefix length that straddles a kv block boundary,
     - heads-major flash attention fwd (the fallback layout),
     - the cached block-diagonal decode step (bf16 and int8 KV storage)
       against the module's own einsum fallback path (reached via a 2-token
@@ -510,7 +528,11 @@ def kernel_smoke() -> None:
     """
     t0 = time.perf_counter()
     from perceiver_io_tpu.core.attention import MultiHeadAttention, init_kv_cache, prefill_mode
-    from perceiver_io_tpu.ops.flash_attention import flash_attention, flash_attention_packed
+    from perceiver_io_tpu.ops.flash_attention import (
+        flash_attention,
+        flash_attention_packed,
+        flash_attention_packed_2seg,
+    )
 
     rng = np.random.default_rng(0)
     b, h, nq, nkv, d = 2, 4, 256, 512, 64
@@ -555,6 +577,45 @@ def kernel_smoke() -> None:
     o_hm = jax.jit(lambda a, c, w: flash_attention(a, c, w, causal=True, sm_scale=1.0))(q, k, v)
     err = float(jnp.abs(o_hm - o_ref).max())
     assert err < 2e-2, f"heads-major flash fwd diverges from einsum: max abs {err}"
+
+    # two-segment packed kernels vs the packed concat path: kv window of
+    # 456 = odd prefix 200 (straddles the 128-wide kv blocks, exercising the
+    # static tail mask) + the 256 latent rows — fwd and all five gradients
+    n_p = 200
+    kc, vc = packed(k)[:, : n_p + nq], packed(v)[:, : n_p + nq]
+    kp, kl = kc[:, :n_p], kc[:, n_p:]
+    vp, vl = vc[:, :n_p], vc[:, n_p:]
+
+    def loss_2seg(qp, kp_, vp_, kl_, vl_):
+        o = flash_attention_packed_2seg(
+            qp, kp_, vp_, kl_, vl_, num_heads=h, sm_scale=1.0, block_q=128, block_kv=128
+        )
+        return jnp.vdot(o.astype(jnp.float32), packed(cot).astype(jnp.float32))
+
+    def loss_cat(qp, kp_, vp_, kl_, vl_):
+        o = flash_attention_packed(
+            qp, jnp.concatenate([kp_, kl_], 1), jnp.concatenate([vp_, vl_], 1),
+            num_heads=h, causal=True, sm_scale=1.0, block_q=128, block_kv=128,
+        )
+        return jnp.vdot(o.astype(jnp.float32), packed(cot).astype(jnp.float32))
+
+    o_2s = jax.jit(
+        lambda a, c, w, e, f: flash_attention_packed_2seg(
+            a, c, w, e, f, num_heads=h, sm_scale=1.0, block_q=128, block_kv=128
+        )
+    )(packed(q), kp, vp, kl, vl)
+    o_cat = jax.jit(
+        lambda a, c, w: flash_attention_packed(
+            a, c, w, num_heads=h, causal=True, sm_scale=1.0, block_q=128, block_kv=128
+        )
+    )(packed(q), kc, vc)
+    err = float(jnp.abs(o_2s - o_cat).max())
+    assert err < 2e-2, f"two-segment flash fwd diverges from concat path: max abs {err}"
+    g_2s = jax.jit(jax.grad(loss_2seg, argnums=(0, 1, 2, 3, 4)))(packed(q), kp, vp, kl, vl)
+    g_ct = jax.jit(jax.grad(loss_cat, argnums=(0, 1, 2, 3, 4)))(packed(q), kp, vp, kl, vl)
+    for name, a, bb in zip(("dq", "dkp", "dvp", "dkl", "dvl"), g_2s, g_ct):
+        gerr = float(jnp.abs(jnp.asarray(a) - jnp.asarray(bb)).max())
+        assert gerr < 5e-2, f"two-segment flash bwd {name} diverges: max abs {gerr}"
 
     # cached decode: block-diagonal single-token step vs the einsum fallback
     # (2-token step, first query) — bf16 and int8 KV storage
@@ -619,16 +680,48 @@ def main():
     p.add_argument("--skip-smoke", action="store_true",
                    help="skip the Mosaic kernel-lowering smoke (VERDICT r4 item 8; "
                         "runs by default in every mode)")
+    p.add_argument("--kernel-features", default=None,
+                   help="trace-time flash kernel feature set for A/B runs: 'all', "
+                        "'none', or a comma list (e.g. 'twoseg') — see "
+                        "ops/flash_attention.py ALL_FEATURES; recorded in the "
+                        "result's telemetry block")
     p.add_argument("--out", default=None, help="extra mode: JSON artifact path (e.g. BENCH_extra_r3.json)")
     args = p.parse_args()
+
+    if args.kernel_features is not None:
+        from perceiver_io_tpu.ops.flash_attention import set_fast_kernels
+
+        mode = {"all": True, "none": False}.get(
+            args.kernel_features,
+            [f for f in args.kernel_features.split(",") if f],
+        )
+        set_fast_kernels(mode)
 
     if args.batch_size is None:
         args.batch_size = 32 if args.mode == "train" else 1
     if args.microbatch is None:
         args.microbatch = auto_microbatch(args.batch_size)
 
-    if not args.skip_smoke:
-        kernel_smoke()
+    global _SMOKE_STATUS
+    if args.skip_smoke:
+        _SMOKE_STATUS = "skipped"
+    else:
+        try:
+            kernel_smoke()
+            _SMOKE_STATUS = "passed"
+        except Exception as e:
+            # make the failure visible in a committed artifact when one is
+            # being written, then fail loudly — the smoke is a gate. The row
+            # keeps the successful artifacts' shape (telemetry.kernel_smoke)
+            # so consumers read one schema across pass/skip/fail.
+            if args.mode == "extra" and args.out:
+                with open(args.out, "w") as f:
+                    json.dump(
+                        {"kernel_smoke_failure": {"telemetry": {
+                            "kernel_smoke": "failed", "kernel_smoke_error": str(e)}}},
+                        f, indent=1,
+                    )
+            raise
 
     if args.mode == "extra":
         return extra_bench(args)

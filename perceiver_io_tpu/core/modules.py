@@ -36,6 +36,7 @@ from perceiver_io_tpu.core.attention import AttentionOutput, KVCache, MultiHeadA
 from perceiver_io_tpu.ops.layernorm import FusedLayerNorm
 from perceiver_io_tpu.core.config import CausalSequenceModelConfig
 from perceiver_io_tpu.core.position import positions
+from perceiver_io_tpu.utils.compat import axis_size
 
 LAYER_NORM_EPSILON = 1e-5  # match torch nn.LayerNorm default
 
@@ -125,6 +126,26 @@ class CrossAttention(nn.Module):
             use_flash=self.use_flash,
         )
 
+    def _two_segment_ok(self, x_q, x_kv_prefix, kv_cache, deterministic) -> bool:
+        """Gate for the two-segment kv route (the `fast_kernels` "twoseg"
+        feature): the prefix-mode causal cross-attention with no KV cache,
+        no active attention-prob dropout, and kernel-supported shapes. When
+        False the concat path below runs — the two are identical in
+        semantics, so the flag off reproduces the old path exactly."""
+        from perceiver_io_tpu.ops.flash_attention import fast_features
+
+        if "twoseg" not in fast_features():
+            return False
+        if kv_cache is not None or not self.causal_attention:
+            return False
+        if x_kv_prefix.shape[1] < 1:
+            return False
+        n_q = x_q.shape[1]
+        dropout_active = self.dropout > 0.0 and not deterministic
+        return self.attention.packed_route_ok(
+            n_q, x_kv_prefix.shape[1] + n_q, dropout_active
+        )
+
     def __call__(
         self,
         x_q,
@@ -138,6 +159,21 @@ class CrossAttention(nn.Module):
     ) -> AttentionOutput:
         x_q = self.q_norm(x_q)
         if x_kv is None:
+            if self._two_segment_ok(x_q, x_kv_prefix, kv_cache, deterministic):
+                # segmented route: the concatenated [prefix; latents] kv
+                # tensor (and its K/V projections) are never materialized —
+                # the Pallas kernels read the two segments as separate
+                # operands (ops/flash_attention.py two-segment path)
+                n_p = x_kv_prefix.shape[1]
+                return self.attention.two_segment(
+                    x_q,
+                    self.kv_norm(x_kv_prefix),
+                    pad_mask_prefix=None if pad_mask is None else pad_mask[:, :n_p],
+                    pad_mask_latent=None if pad_mask is None else pad_mask[:, n_p:],
+                    rope_q=rope_q,
+                    rope_k_prefix=None if rope_k is None else rope_k[:, :n_p],
+                    rope_k_latent=None if rope_k is None else rope_k[:, n_p:],
+                )
             x_kv_prefix = self.kv_norm(x_kv_prefix)
             x_kv = jnp.concatenate([x_kv_prefix, x_q], axis=1)
         else:
@@ -909,7 +945,15 @@ class PerceiverAR(nn.Module):
         through the input pipeline (training.prefix_dropout). The
         distribution is identical: a uniformly random size-``keep`` subset,
         exactly the reference's ``torch.topk``-of-uniforms draw
-        (reference: modules.py:814-819)."""
+        (reference: modules.py:814-819).
+
+        **Failure mode (host-supplied indices are trusted input):** the
+        gathers' scatter-free VJPs (`ops/gathers.py`) assume each row of
+        ``prefix_keep_idx`` is unique (and sorted, on the compact route). A
+        duplicated index does NOT error — the forward gathers the row twice
+        but the inverted-map backward credits only one copy, silently
+        corrupting d_embedding/d_position-table. Verify suspect pipelines
+        with ``ops.gathers.debug_unique_indices()``."""
         if decode and kv_cache is None:
             raise ValueError("decode=True requires kv_cache")
         if kv_cache is not None and not deterministic and self.cross_attention_dropout > 0.0:
@@ -1183,7 +1227,7 @@ class PerceiverAR(nn.Module):
             # the dense path's static-count keep set (see _forward), drawn
             # identically on every device from the replicated rng, then
             # sliced to this device's block
-            p_total = p_local * lax.axis_size(axis_name)
+            p_total = p_local * axis_size(axis_name)
             keep = p_total - int(p_total * self.cross_attention_dropout)
             rand = jax.random.uniform(self.make_rng("dropout"), (b, p_total))
             _, keep_idx = lax.top_k(rand, keep)
@@ -1347,7 +1391,7 @@ class CausalSequenceModel(nn.Module):
         """
         b, n_lat = latent_ids.shape
         p_local = prefix_ids_local.shape[1]
-        n_dev = lax.axis_size(axis_name)
+        n_dev = axis_size(axis_name)
         idx = lax.axis_index(axis_name)
         p_total = p_local * n_dev
 

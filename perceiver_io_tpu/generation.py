@@ -77,6 +77,15 @@ def _maybe_dequantize_weights(decode_params, compute_dtype):
 # or under this element count rides the packed buffer
 _PACK_MAX_SIZE = 4096
 
+# the pack stages leaves through ONE f32 buffer, so only dtypes whose
+# f32 round-trip is exact may ride it: f32 itself, and the sub-f32 floats
+# f32 embeds losslessly (bf16/f16). Anything else (f64 under x64, float8
+# variants, future dtypes) is left unpacked — correct, just not
+# consolidated — rather than silently rounded through f32 (ADVICE r5).
+_PACK_EXACT_DTYPES = frozenset(
+    jnp.dtype(d) for d in (jnp.float32, jnp.bfloat16, jnp.float16)
+)
+
 # trace-time lever (tools/decode_ab.py): None = auto — pack at batch >= 4,
 # where the scan's schedule-spread dominates (measured bf16 A/B: +12.5%
 # tok/s at b=8, +2.5% at b=4, -30% at b=2, -8% at b=1 — below the boundary
@@ -110,7 +119,9 @@ def _pack_enabled(batch_size: int) -> bool:
 
 
 def _pack_small_params(params, max_size: int = _PACK_MAX_SIZE):
-    """Consolidate the tree's small float leaves into ONE flat f32 buffer.
+    """Consolidate the tree's small float leaves into ONE flat f32 buffer
+    (only dtypes whose f32 round-trip is exact — see ``_PACK_EXACT_DTYPES``;
+    other float leaves stay unpacked).
 
     The decode scan body reads dozens of tiny loop-invariant parameter
     buffers (LayerNorm scales/biases, projection biases — f32[512], 2 KB
@@ -134,6 +145,7 @@ def _pack_small_params(params, max_size: int = _PACK_MAX_SIZE):
         if (
             hasattr(x, "dtype")
             and jnp.issubdtype(x.dtype, jnp.floating)
+            and jnp.dtype(x.dtype) in _PACK_EXACT_DTYPES
             and x.size <= max_size
         ):
             meta.append((i, x.shape, x.dtype, offset, x.size))

@@ -72,7 +72,13 @@ LOG2E = 1.4426950408889634  # log2(e)
 # different TPU generation); the default is the empty set, which reproduces
 # the round-2 kernels bit-for-bit. Read at TRACE time, like
 # set_default_flash. Full table in docs/performance.md.
-ALL_FEATURES = frozenset({"base2", "nobias", "fastmask", "slimstats"})
+#
+# "twoseg" is a STRUCTURAL feature, not a VPU trim: it routes the Perceiver
+# AR prefix cross-attention through the two-segment kernels below (kept
+# prefix and latent K/V as separate operands — the concatenated x_kv tensor
+# and its LayerNorm output are never materialized). Gated like the trims so
+# tools/step_ab.py can A/B it same-process; see docs/performance.md round 6.
+ALL_FEATURES = frozenset({"base2", "nobias", "fastmask", "slimstats", "twoseg"})
 # scoped per-context (contextvar, not a module global): a probe thread
 # toggling features cannot leak them into another thread's traces
 _FAST_FEATURES = contextvars.ContextVar("flash_fast_features", default=frozenset())
@@ -133,7 +139,9 @@ _VMEM_LIMIT = 100 * 1024 * 1024
 
 def _compiler_params(*dims: str):
     """Grid dimension semantics + raised VMEM ceiling (no-op in interpret)."""
-    return pltpu.CompilerParams(dimension_semantics=dims, vmem_limit_bytes=_VMEM_LIMIT)
+    from perceiver_io_tpu.utils.compat import pallas_compiler_params_cls
+
+    return pallas_compiler_params_cls()(dimension_semantics=dims, vmem_limit_bytes=_VMEM_LIMIT)
 
 
 def _dot(a, b, dims):
@@ -258,17 +266,26 @@ def _fwd_kernel(
 # ---------------------------------------------------------------------------
 
 
-def _recompute_p(q, k, bias_row, lse_col, iq, ikv, block_q, block_kv, offset, sm_scale, apply_mask, base2):
+def _recompute_p_keep(q, k, bias_row, lse_col, keep, sm_scale, base2):
     """Recompute the probability tile p = exp(s_masked - lse) (base-2 under
-    v2 — the lse residual is in matching units)."""
+    v2 — the lse residual is in matching units) from a caller-built keep
+    mask (None = no mask; the two-segment kernels build segment-local
+    masks — tail / latent-causal — in their dispatcher)."""
     s = _dot(q, k, ((1,), (1,)))
     s = s * (sm_scale * (LOG2E if base2 else 1.0))
     if bias_row is not None:
         s = s + bias_row
-    if apply_mask:
-        keep = _right_aligned_mask(s.shape[0], s.shape[1], iq, ikv, block_q, block_kv, offset)
+    if keep is not None:
         s = jnp.where(keep, s, MASK_VALUE)
     return _exp(s - lse_col, base2)
+
+
+def _recompute_p(q, k, bias_row, lse_col, iq, ikv, block_q, block_kv, offset, sm_scale, apply_mask, base2):
+    """`_recompute_p_keep` with the standard right-aligned causal keep mask."""
+    keep = None
+    if apply_mask:
+        keep = _right_aligned_mask(q.shape[0], k.shape[0], iq, ikv, block_q, block_kv, offset)
+    return _recompute_p_keep(q, k, bias_row, lse_col, keep, sm_scale, base2)
 
 
 def _dkv_kernel(
@@ -1014,6 +1031,611 @@ def flash_attention_packed(
         bias = bias[:, None, :]
 
     out = _flash_packed(qf, kf, vf, bias, causal, offset, sm_scale, block_q, block_kv, h, d_qk, d_v, v2)
+    return out[:, :nq, :]
+
+
+# ---------------------------------------------------------------------------
+# two-segment packed path (Perceiver AR prefix cross-attention)
+# ---------------------------------------------------------------------------
+#
+# The Perceiver AR cross-attention attends the latent queries to the LOGICAL
+# kv sequence [kept-prefix; latents]. The concat route materializes that
+# sequence — ``x_kv = concat(kv_norm(prefix), q_norm(latents))`` — plus its
+# K/V projections (~0.86 ms of async copy per chunk at the 16k flagship,
+# profiled) before the kernels start. The kernels below take the two
+# segments as SEPARATE operands: a kv-block index either reads from the
+# prefix refs or the latent refs (clamped BlockSpec index maps — Pallas only
+# re-fetches when a block index CHANGES, so the off-segment refs cost one
+# stale fetch per grid row, not a doubled stream), and the seam is handled
+# by a static tail mask on the last prefix block (the prefix pads to its own
+# block multiple) plus the standard right-aligned causal machinery in
+# LATENT-LOCAL coordinates: with n_latent_kv == n_q, query i sees logical kv
+# j iff j <= i + prefix_len, i.e. the whole prefix plus latent slots t <= i
+# — causal offset 0 in local coords, independent of the prefix length. Each
+# segment picks its own divisor block size, so the flagship geometry
+# (prefix 7680 / latents 1024) runs with zero kv padding.
+#
+# Semantics contract (pinned by tests/test_flash_twoseg.py): identical to
+# ``flash_attention_packed(q, concat(k_p, k_l), concat(v_p, v_l),
+# causal=True)`` up to online-softmax block-partitioning rounding — the same
+# tolerance class as changing block sizes on the concat path.
+
+
+def _twoseg_dispatch(body, iq, ikv, *, block_q, block_kv_p, block_kv_l, prefix_len, npb, fastmask):
+    """Run ``body(segment, keep_mask_or_None)`` for kv block ``ikv``:
+    segment 0 (prefix, fully visible, static tail mask on the last block
+    when the prefix is not a block multiple) or segment 1 (latents, causal
+    at offset 0 in latent-local block coordinates). ``segment`` is a static
+    Python int — the kernel body specializes its refs on it."""
+    tail_cols = prefix_len - (npb - 1) * block_kv_p
+    if tail_cols != block_kv_p:
+
+        def prefix_tail():
+            keep = lax.broadcasted_iota(jnp.int32, (block_q, block_kv_p), 1) < tail_cols
+            body(0, keep)
+
+        pl.when(ikv == npb - 1)(prefix_tail)
+        if npb > 1:
+            pl.when(ikv < npb - 1)(lambda: body(0, None))
+    else:
+        pl.when(ikv < npb)(lambda: body(0, None))
+
+    def latent():
+        ikv_l = ikv - npb
+        _causal_dispatch(
+            lambda m: body(
+                1,
+                _right_aligned_mask(block_q, block_kv_l, iq, ikv_l, block_q, block_kv_l, 0)
+                if m
+                else None,
+            ),
+            True,
+            fastmask,
+            iq,
+            ikv_l,
+            block_q,
+            block_kv_l,
+            0,
+        )
+
+    pl.when(ikv >= npb)(latent)
+
+
+def _fwd_2seg_kernel(
+    *refs,  # [bias_p?, bias_l?], q, k_p, v_p, k_l, v_l, o, lse, m_scr, l_scr, acc_scr
+    prefix_len: int,
+    num_prefix_blocks: int,
+    block_kv_p: int,
+    block_kv_l: int,
+    sm_scale: float,
+    num_kv_blocks: int,
+    num_heads: int,
+    d_qk: int,
+    d_v: int,
+    has_bias: bool,
+    v2: frozenset,
+):
+    # refs: bias_p (1, 1, bkv_p) / bias_l (1, 1, bkv_l) f32 when has_bias;
+    # q (1, block_q, h*d_qk); k_p/v_p (1, bkv_p, h*d); k_l/v_l (1, bkv_l, h*d);
+    # outs o (1, block_q, h*d_v), lse (1, block_q, h*RES_LANES) f32; scratch
+    # m/l (h, block_q, stat_lanes) f32, acc (h, block_q, d_v) f32
+    if has_bias:
+        bias_p_ref, bias_l_ref, q_ref, k_p_ref, v_p_ref, k_l_ref, v_l_ref = refs[:7]
+        o_ref, lse_ref, m_scr, l_scr, acc_scr = refs[7:]
+    else:
+        bias_p_ref = bias_l_ref = None
+        q_ref, k_p_ref, v_p_ref, k_l_ref, v_l_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+    iq, ikv = pl.program_id(1), pl.program_id(2)
+    h = num_heads
+    block_q = q_ref.shape[1]
+    score_scale = sm_scale * (LOG2E if "base2" in v2 else 1.0)
+
+    @pl.when(ikv == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    def _body(seg, keep):
+        if seg == 0:
+            k_ref, v_ref, bias_ref = k_p_ref, v_p_ref, bias_p_ref
+        else:
+            k_ref, v_ref, bias_ref = k_l_ref, v_l_ref, bias_l_ref
+        bias = bias_ref[0] if has_bias else None
+        for hh in range(h):
+            qh = q_ref[0, :, hh * d_qk : (hh + 1) * d_qk]
+            kh = k_ref[0, :, hh * d_qk : (hh + 1) * d_qk]
+            vh = v_ref[0, :, hh * d_v : (hh + 1) * d_v]
+            s = _dot(qh, kh, ((1,), (1,)))
+            s = s * score_scale
+            if has_bias:
+                s = s + bias
+            if keep is not None:
+                s = jnp.where(keep, s, MASK_VALUE)
+            m_prev = m_scr[hh]
+            l_prev = l_scr[hh]
+            m_curr = jnp.max(s, axis=1)[:, None]
+            m_next = jnp.maximum(m_prev, m_curr)
+            p = _exp(s - m_next[:, :1], "base2" in v2)
+            alpha = _exp(m_prev - m_next, "base2" in v2)
+            l_scr[hh] = alpha * l_prev + jnp.sum(p, axis=1)[:, None]
+            m_scr[hh] = m_next
+            o_curr = _dot(p.astype(vh.dtype), vh, ((1,), (0,)))
+            acc_scr[hh] = acc_scr[hh] * alpha[:, :1] + o_curr
+
+    _twoseg_dispatch(
+        _body, iq, ikv,
+        block_q=block_q, block_kv_p=block_kv_p, block_kv_l=block_kv_l,
+        prefix_len=prefix_len, npb=num_prefix_blocks, fastmask="fastmask" in v2,
+    )
+
+    @pl.when(ikv == num_kv_blocks - 1)
+    def _store():
+        for hh in range(h):
+            l = l_scr[hh]
+            l_inv = jnp.where(l == 0.0, 1.0, 1.0 / l)
+            o_ref[0, :, hh * d_v : (hh + 1) * d_v] = (
+                acc_scr[hh] * l_inv[:, :1]
+            ).astype(o_ref.dtype)
+            lse = m_scr[hh] + _log(jnp.where(l == 0.0, 1.0, l), "base2" in v2)
+            if lse.shape[1] != RES_LANES:
+                lse = lse[:, :RES_LANES]
+            lse_ref[0, :, hh * RES_LANES : (hh + 1) * RES_LANES] = lse
+
+
+def _dkv_2seg_kernel(
+    *refs,  # [bias_p?, bias_l?], q, k_p, v_p, k_l, v_l, do, lse, delta,
+    #         dk_p, dv_p, dk_l, dv_l, dk_scr, dv_scr
+    prefix_len: int,
+    num_prefix_blocks: int,
+    block_kv_p: int,
+    block_kv_l: int,
+    sm_scale: float,
+    num_q_blocks: int,
+    num_heads: int,
+    d_qk: int,
+    d_v: int,
+    has_bias: bool,
+    v2: frozenset,
+):
+    # scratch dk/dv are (h, max(bkv_p, bkv_l), d) f32; each segment reads and
+    # writes its own leading rows (static slices)
+    if has_bias:
+        bias_p_ref, bias_l_ref = refs[:2]
+        refs = refs[2:]
+    else:
+        bias_p_ref = bias_l_ref = None
+    (q_ref, k_p_ref, v_p_ref, k_l_ref, v_l_ref, do_ref, lse_ref, delta_ref,
+     dk_p_ref, dv_p_ref, dk_l_ref, dv_l_ref, dk_scr, dv_scr) = refs
+    ikv, iq = pl.program_id(1), pl.program_id(2)
+    h = num_heads
+    block_q = q_ref.shape[1]
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    def _body(seg, keep):
+        if seg == 0:
+            k_ref, v_ref, bias_ref, bkv = k_p_ref, v_p_ref, bias_p_ref, block_kv_p
+        else:
+            k_ref, v_ref, bias_ref, bkv = k_l_ref, v_l_ref, bias_l_ref, block_kv_l
+        bias = bias_ref[0] if has_bias else None
+        for hh in range(h):
+            qh = q_ref[0, :, hh * d_qk : (hh + 1) * d_qk]
+            kh = k_ref[0, :, hh * d_qk : (hh + 1) * d_qk]
+            vh = v_ref[0, :, hh * d_v : (hh + 1) * d_v]
+            doh = do_ref[0, :, hh * d_v : (hh + 1) * d_v]
+            lse = lse_ref[0, :, hh * RES_LANES : hh * RES_LANES + 1]
+            delta = delta_ref[0, :, hh * RES_LANES : hh * RES_LANES + 1]
+            p = _recompute_p_keep(qh, kh, bias, lse, keep, sm_scale, "base2" in v2)
+            dv_scr[hh, :bkv] += _dot(p.astype(doh.dtype), doh, ((0,), (0,)))
+            dp = _dot(doh, vh, ((1,), (1,)))
+            ds = p * (dp - delta) * sm_scale
+            dk_scr[hh, :bkv] += _dot(ds.astype(qh.dtype), qh, ((0,), (0,)))
+
+    _twoseg_dispatch(
+        _body, iq, ikv,
+        block_q=block_q, block_kv_p=block_kv_p, block_kv_l=block_kv_l,
+        prefix_len=prefix_len, npb=num_prefix_blocks, fastmask="fastmask" in v2,
+    )
+
+    @pl.when(iq == num_q_blocks - 1)
+    def _store():
+        def store_prefix():
+            for hh in range(h):
+                dk_p_ref[0, :, hh * d_qk : (hh + 1) * d_qk] = dk_scr[hh, :block_kv_p].astype(dk_p_ref.dtype)
+                dv_p_ref[0, :, hh * d_v : (hh + 1) * d_v] = dv_scr[hh, :block_kv_p].astype(dv_p_ref.dtype)
+
+        def store_latent():
+            for hh in range(h):
+                dk_l_ref[0, :, hh * d_qk : (hh + 1) * d_qk] = dk_scr[hh, :block_kv_l].astype(dk_l_ref.dtype)
+                dv_l_ref[0, :, hh * d_v : (hh + 1) * d_v] = dv_scr[hh, :block_kv_l].astype(dv_l_ref.dtype)
+
+        pl.when(ikv < num_prefix_blocks)(store_prefix)
+        pl.when(ikv >= num_prefix_blocks)(store_latent)
+
+
+def _dq_2seg_kernel(
+    *refs,  # [bias_p?, bias_l?], q, k_p, v_p, k_l, v_l, do, lse, delta, dq, dq_scr
+    prefix_len: int,
+    num_prefix_blocks: int,
+    block_kv_p: int,
+    block_kv_l: int,
+    sm_scale: float,
+    num_kv_blocks: int,
+    num_heads: int,
+    d_qk: int,
+    d_v: int,
+    has_bias: bool,
+    v2: frozenset,
+):
+    if has_bias:
+        bias_p_ref, bias_l_ref = refs[:2]
+        refs = refs[2:]
+    else:
+        bias_p_ref = bias_l_ref = None
+    (q_ref, k_p_ref, v_p_ref, k_l_ref, v_l_ref, do_ref, lse_ref, delta_ref,
+     dq_ref, dq_scr) = refs
+    iq, ikv = pl.program_id(1), pl.program_id(2)
+    h = num_heads
+    block_q = q_ref.shape[1]
+
+    @pl.when(ikv == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    def _body(seg, keep):
+        if seg == 0:
+            k_ref, v_ref, bias_ref = k_p_ref, v_p_ref, bias_p_ref
+        else:
+            k_ref, v_ref, bias_ref = k_l_ref, v_l_ref, bias_l_ref
+        bias = bias_ref[0] if has_bias else None
+        for hh in range(h):
+            qh = q_ref[0, :, hh * d_qk : (hh + 1) * d_qk]
+            kh = k_ref[0, :, hh * d_qk : (hh + 1) * d_qk]
+            vh = v_ref[0, :, hh * d_v : (hh + 1) * d_v]
+            doh = do_ref[0, :, hh * d_v : (hh + 1) * d_v]
+            lse = lse_ref[0, :, hh * RES_LANES : hh * RES_LANES + 1]
+            delta = delta_ref[0, :, hh * RES_LANES : hh * RES_LANES + 1]
+            p = _recompute_p_keep(qh, kh, bias, lse, keep, sm_scale, "base2" in v2)
+            dp = _dot(doh, vh, ((1,), (1,)))
+            ds = (p * (dp - delta) * sm_scale).astype(kh.dtype)
+            dq_scr[hh] += _dot(ds, kh, ((1,), (0,)))
+
+    _twoseg_dispatch(
+        _body, iq, ikv,
+        block_q=block_q, block_kv_p=block_kv_p, block_kv_l=block_kv_l,
+        prefix_len=prefix_len, npb=num_prefix_blocks, fastmask="fastmask" in v2,
+    )
+
+    @pl.when(ikv == num_kv_blocks - 1)
+    def _store():
+        for hh in range(h):
+            dq_ref[0, :, hh * d_qk : (hh + 1) * d_qk] = dq_scr[hh].astype(dq_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11, 12, 13, 14, 15))
+def _flash_packed_2seg(
+    q, k_p, v_p, k_l, v_l, bias_p, bias_l,
+    prefix_len, sm_scale, block_q, block_kv_p, block_kv_l, h, d_qk, d_v, v2,
+):
+    out, _ = _flash_packed_2seg_fwd_impl(
+        q, k_p, v_p, k_l, v_l, bias_p, bias_l,
+        prefix_len, sm_scale, block_q, block_kv_p, block_kv_l, h, d_qk, d_v, v2,
+    )
+    return out
+
+
+def _2seg_kv_specs(order, npb, nlb, block_kv_p, block_kv_l, width_p, width_l):
+    """BlockSpecs for the prefix/latent kv operand pair. ``order`` picks the
+    grid-axis layout: "ij" for the fwd/dq grid (b, i, j) and "ji" for the dkv
+    grid (b, j, i), with j the combined kv-block axis. The index maps CLAMP
+    into each segment, so during the other segment's blocks the index is
+    constant and the pipeline fetches nothing new."""
+    if order == "ij":
+        p_map = lambda b_, i, j: (b_, jnp.minimum(j, npb - 1), 0)  # noqa: E731
+        l_map = lambda b_, i, j: (b_, jnp.clip(j - npb, 0, nlb - 1), 0)  # noqa: E731
+    else:
+        p_map = lambda b_, j, i: (b_, jnp.minimum(j, npb - 1), 0)  # noqa: E731
+        l_map = lambda b_, j, i: (b_, jnp.clip(j - npb, 0, nlb - 1), 0)  # noqa: E731
+    return (
+        pl.BlockSpec((1, block_kv_p, width_p), p_map),
+        pl.BlockSpec((1, block_kv_l, width_l), l_map),
+        p_map,
+        l_map,
+    )
+
+
+def _2seg_bias_specs(order, npb, nlb, block_kv_p, block_kv_l):
+    if order == "ij":
+        return (
+            pl.BlockSpec((1, 1, block_kv_p), lambda b_, i, j: (b_, 0, jnp.minimum(j, npb - 1))),
+            pl.BlockSpec((1, 1, block_kv_l), lambda b_, i, j: (b_, 0, jnp.clip(j - npb, 0, nlb - 1))),
+        )
+    return (
+        pl.BlockSpec((1, 1, block_kv_p), lambda b_, j, i: (b_, 0, jnp.minimum(j, npb - 1))),
+        pl.BlockSpec((1, 1, block_kv_l), lambda b_, j, i: (b_, 0, jnp.clip(j - npb, 0, nlb - 1))),
+    )
+
+
+def _flash_packed_2seg_fwd_impl(
+    q, k_p, v_p, k_l, v_l, bias_p, bias_l,
+    prefix_len, sm_scale, block_q, block_kv_p, block_kv_l, h, d_qk, d_v, v2,
+):
+    b, nq, _ = q.shape
+    npb = k_p.shape[1] // block_kv_p
+    nlb = k_l.shape[1] // block_kv_l
+    grid = (b, nq // block_q, npb + nlb)
+    stat_lanes = RES_LANES if "slimstats" in v2 else LANES
+    has_bias = bias_p is not None
+
+    kp_spec, kl_spec, _, _ = _2seg_kv_specs("ij", npb, nlb, block_kv_p, block_kv_l, h * d_qk, h * d_qk)
+    vp_spec, vl_spec, _, _ = _2seg_kv_specs("ij", npb, nlb, block_kv_p, block_kv_l, h * d_v, h * d_v)
+    in_specs = []
+    inputs = []
+    if has_bias:
+        in_specs += list(_2seg_bias_specs("ij", npb, nlb, block_kv_p, block_kv_l))
+        inputs += [bias_p, bias_l]
+    in_specs += [
+        pl.BlockSpec((1, block_q, h * d_qk), lambda b_, i, j: (b_, i, 0)),
+        kp_spec, vp_spec, kl_spec, vl_spec,
+    ]
+    inputs += [q, k_p, v_p, k_l, v_l]
+
+    out, lse = pl.pallas_call(
+        functools.partial(
+            _fwd_2seg_kernel,
+            prefix_len=prefix_len,
+            num_prefix_blocks=npb,
+            block_kv_p=block_kv_p,
+            block_kv_l=block_kv_l,
+            sm_scale=sm_scale,
+            num_kv_blocks=grid[2],
+            num_heads=h,
+            d_qk=d_qk,
+            d_v=d_v,
+            has_bias=has_bias,
+            v2=v2,
+        ),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, block_q, h * d_v), lambda b_, i, j: (b_, i, 0)),
+            pl.BlockSpec((1, block_q, h * RES_LANES), lambda b_, i, j: (b_, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, nq, h * d_v), q.dtype),
+            jax.ShapeDtypeStruct((b, nq, h * RES_LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((h, block_q, stat_lanes), jnp.float32),
+            pltpu.VMEM((h, block_q, stat_lanes), jnp.float32),
+            pltpu.VMEM((h, block_q, d_v), jnp.float32),
+        ],
+        compiler_params=_compiler_params("parallel", "parallel", "arbitrary"),
+        interpret=_interpret_default(),
+    )(*inputs)
+    return out, lse
+
+
+def _flash_packed_2seg_fwd(
+    q, k_p, v_p, k_l, v_l, bias_p, bias_l,
+    prefix_len, sm_scale, block_q, block_kv_p, block_kv_l, h, d_qk, d_v, v2,
+):
+    out, lse = _flash_packed_2seg_fwd_impl(
+        q, k_p, v_p, k_l, v_l, bias_p, bias_l,
+        prefix_len, sm_scale, block_q, block_kv_p, block_kv_l, h, d_qk, d_v, v2,
+    )
+    lse_slim = lse.reshape(lse.shape[0], lse.shape[1], h, RES_LANES)[..., :1]
+    return out, (q, k_p, v_p, k_l, v_l, bias_p, bias_l, out, lse_slim)
+
+
+def _flash_packed_2seg_bwd(
+    prefix_len, sm_scale, block_q, block_kv_p, block_kv_l, h, d_qk, d_v, v2, residuals, g
+):
+    q, k_p, v_p, k_l, v_l, bias_p, bias_l, out, lse_slim = residuals
+    b, nq, _ = q.shape
+    if BWD_BLOCK_Q is not None:
+        block_q = min(block_q, BWD_BLOCK_Q)
+    if BWD_BLOCK_KV is not None:
+        block_kv_p = min(block_kv_p, BWD_BLOCK_KV)
+        block_kv_l = min(block_kv_l, BWD_BLOCK_KV)
+    npb = k_p.shape[1] // block_kv_p
+    nlb = k_l.shape[1] // block_kv_l
+    has_bias = bias_p is not None
+
+    lse = jnp.broadcast_to(lse_slim, (b, nq, h, RES_LANES)).reshape(b, nq, h * RES_LANES)
+    g4 = g.astype(jnp.float32).reshape(b, nq, h, d_v)
+    out4 = out.astype(jnp.float32).reshape(b, nq, h, d_v)
+    delta = jnp.sum(g4 * out4, axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (b, nq, h, RES_LANES)).reshape(b, nq, h * RES_LANES)
+
+    nqb = nq // block_q
+    inputs = ([bias_p, bias_l] if has_bias else []) + [q, k_p, v_p, k_l, v_l, g, lse, delta]
+
+    # dkv: grid (b, kv, q) — kv is marked "arbitrary" (not parallel like the
+    # single-segment kernels): the clamped output index maps revisit a block
+    # across the segment boundary, which requires sequential iteration order
+    kp_spec, kl_spec, p_map, l_map = _2seg_kv_specs(
+        "ji", npb, nlb, block_kv_p, block_kv_l, h * d_qk, h * d_qk
+    )
+    vp_spec, vl_spec, _, _ = _2seg_kv_specs("ji", npb, nlb, block_kv_p, block_kv_l, h * d_v, h * d_v)
+    dkv_in_specs = []
+    if has_bias:
+        dkv_in_specs += list(_2seg_bias_specs("ji", npb, nlb, block_kv_p, block_kv_l))
+    dkv_in_specs += [
+        pl.BlockSpec((1, block_q, h * d_qk), lambda b_, j, i: (b_, i, 0)),
+        kp_spec, vp_spec, kl_spec, vl_spec,
+        pl.BlockSpec((1, block_q, h * d_v), lambda b_, j, i: (b_, i, 0)),
+        pl.BlockSpec((1, block_q, h * RES_LANES), lambda b_, j, i: (b_, i, 0)),
+        pl.BlockSpec((1, block_q, h * RES_LANES), lambda b_, j, i: (b_, i, 0)),
+    ]
+    bkv_max = max(block_kv_p, block_kv_l)
+
+    dk_p, dv_p, dk_l, dv_l = pl.pallas_call(
+        functools.partial(
+            _dkv_2seg_kernel,
+            prefix_len=prefix_len,
+            num_prefix_blocks=npb,
+            block_kv_p=block_kv_p,
+            block_kv_l=block_kv_l,
+            sm_scale=sm_scale,
+            num_q_blocks=nqb,
+            num_heads=h,
+            d_qk=d_qk,
+            d_v=d_v,
+            has_bias=has_bias,
+            v2=v2,
+        ),
+        grid=(b, npb + nlb, nqb),
+        in_specs=dkv_in_specs,
+        out_specs=[
+            pl.BlockSpec((1, block_kv_p, h * d_qk), p_map),
+            pl.BlockSpec((1, block_kv_p, h * d_v), p_map),
+            pl.BlockSpec((1, block_kv_l, h * d_qk), l_map),
+            pl.BlockSpec((1, block_kv_l, h * d_v), l_map),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k_p.shape, k_p.dtype),
+            jax.ShapeDtypeStruct(v_p.shape, v_p.dtype),
+            jax.ShapeDtypeStruct(k_l.shape, k_l.dtype),
+            jax.ShapeDtypeStruct(v_l.shape, v_l.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((h, bkv_max, d_qk), jnp.float32),
+            pltpu.VMEM((h, bkv_max, d_v), jnp.float32),
+        ],
+        compiler_params=_compiler_params("parallel", "arbitrary", "arbitrary"),
+        interpret=_interpret_default(),
+    )(*inputs)
+
+    kp_spec, kl_spec, _, _ = _2seg_kv_specs(
+        "ij", npb, nlb, block_kv_p, block_kv_l, h * d_qk, h * d_qk
+    )
+    vp_spec, vl_spec, _, _ = _2seg_kv_specs("ij", npb, nlb, block_kv_p, block_kv_l, h * d_v, h * d_v)
+    dq_in_specs = []
+    if has_bias:
+        dq_in_specs += list(_2seg_bias_specs("ij", npb, nlb, block_kv_p, block_kv_l))
+    dq_in_specs += [
+        pl.BlockSpec((1, block_q, h * d_qk), lambda b_, i, j: (b_, i, 0)),
+        kp_spec, vp_spec, kl_spec, vl_spec,
+        pl.BlockSpec((1, block_q, h * d_v), lambda b_, i, j: (b_, i, 0)),
+        pl.BlockSpec((1, block_q, h * RES_LANES), lambda b_, i, j: (b_, i, 0)),
+        pl.BlockSpec((1, block_q, h * RES_LANES), lambda b_, i, j: (b_, i, 0)),
+    ]
+
+    (dq,) = pl.pallas_call(
+        functools.partial(
+            _dq_2seg_kernel,
+            prefix_len=prefix_len,
+            num_prefix_blocks=npb,
+            block_kv_p=block_kv_p,
+            block_kv_l=block_kv_l,
+            sm_scale=sm_scale,
+            num_kv_blocks=npb + nlb,
+            num_heads=h,
+            d_qk=d_qk,
+            d_v=d_v,
+            has_bias=has_bias,
+            v2=v2,
+        ),
+        grid=(b, nqb, npb + nlb),
+        in_specs=dq_in_specs,
+        out_specs=[pl.BlockSpec((1, block_q, h * d_qk), lambda b_, i, j: (b_, i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((b, nq, h * d_qk), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((h, block_q, d_qk), jnp.float32)],
+        compiler_params=_compiler_params("parallel", "parallel", "arbitrary"),
+        interpret=_interpret_default(),
+    )(*inputs)
+
+    return (
+        dq, dk_p, dv_p, dk_l, dv_l,
+        jnp.zeros_like(bias_p) if has_bias else None,
+        jnp.zeros_like(bias_l) if has_bias else None,
+    )
+
+
+_flash_packed_2seg.defvjp(_flash_packed_2seg_fwd, _flash_packed_2seg_bwd)
+
+
+@jax.named_scope("flash_attention_packed_2seg")
+def flash_attention_packed_2seg(
+    q: jnp.ndarray,
+    k_prefix: jnp.ndarray,
+    v_prefix: jnp.ndarray,
+    k_latent: jnp.ndarray,
+    v_latent: jnp.ndarray,
+    num_heads: int,
+    pad_mask_prefix: Optional[jnp.ndarray] = None,
+    pad_mask_latent: Optional[jnp.ndarray] = None,
+    sm_scale: float = 1.0,
+    block_q: Optional[int] = None,
+    block_kv: Optional[int] = None,
+) -> jnp.ndarray:
+    """Blockwise fused attention of ``q`` over the logical kv sequence
+    ``[prefix; latents]`` WITHOUT concatenating the segments.
+
+    The right-aligned causal mask is always applied (this is the Perceiver AR
+    prefix cross-attention): with ``n_latent_kv == n_q``, query *i* attends
+    the whole prefix plus latent slots ``t <= i`` — exactly the concat path's
+    ``j <= i + prefix_len``.
+
+    :param q: latent queries (B, Nq, H*Dqk), already scaled/rotated.
+    :param k_prefix: kept-prefix keys (B, Np, H*Dqk), Np >= 1, already rotated.
+    :param v_prefix: kept-prefix values (B, Np, H*Dv).
+    :param k_latent: latent keys (B, Nq, H*Dqk), already rotated.
+    :param v_latent: latent values (B, Nq, H*Dv).
+    :param pad_mask_prefix: optional (B, Np) boolean, True = padding slot.
+    :param pad_mask_latent: optional (B, Nq) boolean, True = padding slot.
+    :returns: (B, Nq, H*Dv) in q's dtype.
+
+    Each segment is padded to its own divisor block size; the seam (a prefix
+    that is not a block multiple) is masked with a STATIC tail mask on the
+    last prefix block, so no bias stream exists unless a pad mask does.
+    """
+    b, nq, cq = q.shape
+    n_p = k_prefix.shape[1]
+    n_l = k_latent.shape[1]
+    if n_l != nq:
+        raise ValueError(f"latent kv length ({n_l}) must equal query length ({nq})")
+    if n_p < 1:
+        raise ValueError("two-segment attention requires a non-empty prefix; "
+                         "use flash_attention_packed(causal=True) when prefix_len == 0")
+    h = num_heads
+    d_qk = cq // h
+    d_v = v_latent.shape[2] // h
+
+    block_q = _choose_block(nq, 1024 if block_q is None else block_q, exact=block_q is not None)
+    bkv_p = _choose_block(n_p, 2048 if block_kv is None else block_kv, exact=block_kv is not None)
+    bkv_l = _choose_block(n_l, 2048 if block_kv is None else block_kv, exact=block_kv is not None)
+
+    qf = _pad_to(q, 1, block_q)
+    kpf = _pad_to(k_prefix, 1, bkv_p)
+    vpf = _pad_to(v_prefix, 1, bkv_p)
+    klf = _pad_to(k_latent, 1, bkv_l)
+    vlf = _pad_to(v_latent, 1, bkv_l)
+
+    v2 = fast_features()
+    if pad_mask_prefix is not None or pad_mask_latent is not None:
+        # prefix pad slots beyond n_p are masked by the static tail mask and
+        # latent pad slots beyond n_l are causally invisible to every valid
+        # query row, so the biases only carry the user masks
+        bias_p = jnp.zeros((b, kpf.shape[1]), jnp.float32)
+        if pad_mask_prefix is not None:
+            bias_p = bias_p.at[:, :n_p].set(jnp.where(pad_mask_prefix, MASK_VALUE, 0.0))
+        bias_l = jnp.zeros((b, klf.shape[1]), jnp.float32)
+        if pad_mask_latent is not None:
+            bias_l = bias_l.at[:, :n_l].set(jnp.where(pad_mask_latent, MASK_VALUE, 0.0))
+        bias_p, bias_l = bias_p[:, None, :], bias_l[:, None, :]
+    else:
+        bias_p = bias_l = None
+
+    out = _flash_packed_2seg(
+        qf, kpf, vpf, klf, vlf, bias_p, bias_l,
+        n_p, sm_scale, block_q, bkv_p, bkv_l, h, d_qk, d_v, v2,
+    )
     return out[:, :nq, :]
 
 

@@ -51,6 +51,66 @@ def _int_zero(x):
     return np.zeros(x.shape, dtypes.float0)
 
 
+# --------------------------------------------------- debug uniqueness check
+#
+# The scatter-free VJPs below are only correct for UNIQUE row indices per
+# batch row: a duplicated index makes the forward gather emit the row twice,
+# but the inverted-map backward credits the gradient to ONE copy and silently
+# drops the other (no error, no NaN — just a wrong d_x/d_table). In-graph
+# draws (lax.top_k of uniforms) are unique by construction; HOST-supplied
+# index sets (`prefix_keep_idx`, training/prefix_dropout.py) are trusted
+# input. `debug_unique_indices()` turns on verification for traces/calls
+# inside the block — concrete operands are checked immediately, traced
+# operands via a host callback that raises at run time.
+
+_DEBUG_UNIQUE = contextvars.ContextVar("gathers_debug_unique", default=False)
+
+
+@contextlib.contextmanager
+def debug_unique_indices():
+    """Opt-in (trace-time, like `plain_gathers`): verify that index operands
+    of the scatter-free gather VJPs are unique per row (and sorted, for the
+    sorted-table variant). Off by default — the check is a host round-trip
+    per call, for debugging corrupted-gradient suspicions, not production."""
+    token = _DEBUG_UNIQUE.set(True)
+    try:
+        yield
+    finally:
+        _DEBUG_UNIQUE.reset(token)
+
+
+def _host_check_unique(idx, op_name: str, require_sorted: bool):
+    a = np.asarray(idx).reshape(-1, np.asarray(idx).shape[-1])
+    for r, row in enumerate(a):
+        if np.unique(row).size != row.size:
+            raise ValueError(
+                f"{op_name}: index row {r} contains duplicates — the "
+                "scatter-free VJP silently drops the gradient of all but one "
+                "copy of a duplicated row (see ops/gathers.py)"
+            )
+        if require_sorted and row.size > 1 and not (np.diff(row) > 0).all():
+            raise ValueError(
+                f"{op_name}: index row {r} is not sorted ascending — the "
+                "compact embedding route requires sorted keep sets"
+            )
+
+
+def _maybe_check_unique(idx, op_name: str, require_sorted: bool = False):
+    if not _DEBUG_UNIQUE.get():
+        return
+    from perceiver_io_tpu.utils.arrays import concrete_or_none
+
+    concrete = concrete_or_none(idx)
+    if concrete is None:
+        # traced: verify at run time on the host (the callback raising is
+        # how the error surfaces from a jitted program)
+        jax.debug.callback(
+            lambda a: _host_check_unique(a, op_name, require_sorted), idx
+        )
+    else:
+        _host_check_unique(concrete, op_name, require_sorted)
+
+
 # ---------------------------------------------------------------- embedding
 
 
@@ -134,6 +194,7 @@ def gather_rows(x: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
     """`gather_unique_rows` unless tracing inside :func:`plain_gathers`."""
     if _PLAIN_MODE.get():
         return jnp.take_along_axis(x, idx[..., None], axis=1)
+    _maybe_check_unique(idx, "gather_unique_rows")
     return gather_unique_rows(x, idx)
 
 
@@ -178,4 +239,5 @@ def gather_table_rows(table: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
     (the plain ``take`` keeps shard_map's varying-axes check happy)."""
     if _PLAIN_MODE.get():
         return jnp.take(table, idx, axis=0)
+    _maybe_check_unique(idx, "gather_sorted_table_rows", require_sorted=True)
     return gather_sorted_table_rows(table, idx)

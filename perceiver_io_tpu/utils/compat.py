@@ -1,0 +1,49 @@
+"""Accessors for jax/optax APIs that moved or were renamed across the
+releases this library spans (the graft container runs jax 0.4.37/older
+optax; the TPU-tunnel environments run newer). One module so the next
+rename is a one-line fix instead of a hunt across kernels, parallel
+wiring and the optimizer. Everything resolves lazily — importing this
+module pulls in neither pallas nor optax."""
+
+from __future__ import annotations
+
+import jax
+
+
+def pallas_compiler_params_cls():
+    """``pltpu.CompilerParams`` (new name) or ``pltpu.TPUCompilerParams``."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    return getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+
+
+def shard_map(*args, **kwargs):
+    """``jax.shard_map`` or its pre-promotion ``jax.experimental`` home."""
+    fn = getattr(jax, "shard_map", None)
+    if fn is None:
+        from jax.experimental.shard_map import shard_map as fn
+    return fn(*args, **kwargs)
+
+
+def axis_size(axis_name) -> int:
+    """Static size of a named mesh axis, inside ``shard_map``/``pmap``.
+
+    ``lax.axis_size`` where the jax release has it; on older releases
+    ``jax.core.axis_frame`` carries the size (either as the frame's ``size``
+    or, older still, as the bare int). Always a Python int — callers use it
+    in static shape arithmetic and validation."""
+    from jax import lax
+
+    size_fn = getattr(lax, "axis_size", None)
+    if size_fn is not None:
+        return size_fn(axis_name)
+    frame = jax.core.axis_frame(axis_name)
+    return frame if isinstance(frame, int) else frame.size
+
+
+def safe_increment(count):
+    """``optax.safe_increment`` or its old name ``safe_int32_increment``."""
+    import optax
+
+    fn = getattr(optax, "safe_increment", None) or optax.safe_int32_increment
+    return fn(count)

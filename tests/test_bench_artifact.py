@@ -83,3 +83,28 @@ def test_bench_telemetry_fields_shape():
     assert "mfu" not in td and "model_flops_per_sec" not in td
     assert td["token_ms"]["p99"] == pytest.approx(10.0)
     assert td["device_kind"]
+
+
+def test_bench_telemetry_records_kernel_features_and_smoke_status():
+    """Committed results must self-describe the A/B state that produced
+    them (ISSUE 2 satellites): the active trace-time kernel feature set,
+    and the kernel_smoke gate's pass/fail/skipped status once main()
+    resolves it (a --skip-smoke run is visible in the artifact)."""
+    import bench
+    from perceiver_io_tpu.ops.flash_attention import fast_kernels
+
+    t = bench.telemetry_fields(None, 0.01)["telemetry"]
+    assert t["kernel_features"] == []
+    assert "kernel_smoke" not in t  # unresolved outside main()
+
+    with fast_kernels({"twoseg"}):
+        t = bench.telemetry_fields(None, 0.01)["telemetry"]
+    assert t["kernel_features"] == ["twoseg"]
+
+    old = bench._SMOKE_STATUS
+    try:
+        bench._SMOKE_STATUS = "skipped"
+        t = bench.telemetry_fields(None, 0.01)["telemetry"]
+        assert t["kernel_smoke"] == "skipped"
+    finally:
+        bench._SMOKE_STATUS = old

@@ -4,6 +4,7 @@ gradients identical to XLA's scatter-add versions."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from perceiver_io_tpu.ops.gathers import embed_lookup, gather_unique_rows, small_vocab_embed
 
@@ -119,3 +120,37 @@ def test_gather_table_rows_plain_mode_passthrough():
     with plain_gathers():
         out = gather_table_rows(table, idx)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(jnp.take(table, idx, axis=0)))
+
+
+def test_debug_unique_indices_catches_duplicates_and_unsorted():
+    """The opt-in debug check (ADVICE r5): host-supplied index sets with a
+    duplicated row entry silently corrupt the scatter-free VJPs' gradients
+    (the inverted map credits only one copy) — under
+    ``debug_unique_indices()`` they must raise instead."""
+    from perceiver_io_tpu.ops.gathers import (
+        debug_unique_indices,
+        gather_rows,
+        gather_table_rows,
+    )
+
+    x = jnp.asarray(rng.normal(size=(2, 10, 4)), jnp.float32)
+    table = jnp.asarray(rng.normal(size=(10, 4)), jnp.float32)
+    good = jnp.asarray(np.sort(np.stack([rng.permutation(10)[:5] for _ in range(2)]), axis=-1))
+    dup = good.at[0, 1].set(good[0, 0])
+    unsorted = good[:, ::-1]
+
+    # off by default: duplicates pass through unchecked (trusted input)
+    gather_rows(x, dup)
+
+    with debug_unique_indices():
+        gather_rows(x, good)
+        gather_table_rows(table, good)
+        with pytest.raises(ValueError, match="duplicates"):
+            gather_rows(x, dup)
+        with pytest.raises(ValueError, match="duplicates"):
+            gather_table_rows(table, dup)
+        with pytest.raises(ValueError, match="sorted"):
+            gather_table_rows(table, unsorted)
+        # unsortedness is allowed for the batch-row gather (only uniqueness
+        # is load-bearing there)
+        gather_rows(x, unsorted)

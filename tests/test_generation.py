@@ -326,3 +326,34 @@ def test_packed_small_params_beam_search_exact(model_and_params):
         out[mode] = (np.asarray(seqs), np.asarray(scores))
     np.testing.assert_array_equal(out[True][0], out[False][0])
     np.testing.assert_array_equal(out[True][1], out[False][1])
+
+
+def test_pack_small_params_skips_inexact_float_dtypes():
+    """The pack stages leaves through ONE f32 buffer, so only dtypes whose
+    f32 round-trip is exact may ride it (ADVICE r5): f32/bf16/f16 pack,
+    anything else (here: float8) stays an unpacked leaf — and the rebuilt
+    tree is bitwise the original either way."""
+    from perceiver_io_tpu.generation import _pack_small_params
+
+    f8 = jnp.float8_e4m3fn
+    tree = {
+        "ln_scale": jnp.linspace(0.5, 1.5, 64, dtype=jnp.float32),
+        "bias_bf16": jnp.linspace(-1, 1, 32).astype(jnp.bfloat16),
+        "bias_f16": jnp.linspace(-2, 2, 32).astype(jnp.float16),
+        "scales_f8": jnp.linspace(0.1, 2.0, 16).astype(f8),
+        "big": jnp.zeros((128, 128), jnp.float32),  # over the size cap
+        "ids": jnp.arange(8, dtype=jnp.int32),
+    }
+    packed, unpack = _pack_small_params(tree)
+    # only the exact-round-trip float leaves were consolidated
+    assert packed.size == 64 + 32 + 32
+    rebuilt = unpack(packed)
+    for key, leaf in tree.items():
+        assert rebuilt[key].dtype == leaf.dtype, key
+        np.testing.assert_array_equal(
+            np.asarray(rebuilt[key]).view(np.uint8), np.asarray(leaf).view(np.uint8),
+            err_msg=key,
+        )
+    # a tree with ONLY inexact float leaves packs nothing at all
+    packed_none, unpack_none = _pack_small_params({"s": jnp.ones((4,), f8)})
+    assert packed_none is None and unpack_none is None
