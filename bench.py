@@ -112,6 +112,12 @@ TIMER_REPS = 7  # warmup=1 discard leaves 6 samples
 # telemetry_fields directly get no kernel_smoke key)
 _SMOKE_STATUS = None
 
+# the graphlint static-analysis verdict on the flagship train/decode graphs
+# (analysis/flagship.py, micro geometry — structure-only, seconds), same
+# record-in-every-artifact contract as kernel_smoke; None until main()
+# resolves it (or forever, for unit callers of telemetry_fields)
+_GRAPHLINT_STATUS = None
+
 
 def telemetry_fields(flops, step_time, step_times_s=None, times_key: str = "step_ms") -> dict:
     """The ``telemetry`` block every bench result carries: device kind, the
@@ -129,6 +135,8 @@ def telemetry_fields(flops, step_time, step_times_s=None, times_key: str = "step
     }
     if _SMOKE_STATUS is not None:
         t["kernel_smoke"] = _SMOKE_STATUS
+    if _GRAPHLINT_STATUS is not None:
+        t["graphlint"] = _GRAPHLINT_STATUS
     if flops is not None:
         peak = device_peak_flops()
         rate = flops / step_time
@@ -680,6 +688,10 @@ def main():
     p.add_argument("--skip-smoke", action="store_true",
                    help="skip the Mosaic kernel-lowering smoke (VERDICT r4 item 8; "
                         "runs by default in every mode)")
+    p.add_argument("--skip-graphlint", action="store_true",
+                   help="skip the static-analysis gate over the flagship "
+                        "train/decode graphs (analysis/, tools/graphlint.py; "
+                        "runs by default in every mode)")
     p.add_argument("--kernel-features", default=None,
                    help="trace-time flash kernel feature set for A/B runs: 'all', "
                         "'none', or a comma list (e.g. 'twoseg') — see "
@@ -722,6 +734,18 @@ def main():
                         f, indent=1,
                     )
             raise
+
+    global _GRAPHLINT_STATUS
+    if args.skip_graphlint:
+        _GRAPHLINT_STATUS = {"status": "skipped"}
+    else:
+        # unlike kernel_smoke this gate never raises: a lint FAILURE is a
+        # recorded verdict in the artifact (the CI-facing hard gate is
+        # `tasks.py graphlint` / tools/graphlint.py --fail-on error)
+        from perceiver_io_tpu.analysis.flagship import graphlint_telemetry
+
+        _GRAPHLINT_STATUS = graphlint_telemetry()
+        print(f"graphlint {_GRAPHLINT_STATUS['status']}", flush=True)
 
     if args.mode == "extra":
         return extra_bench(args)

@@ -1,0 +1,50 @@
+"""Static analysis of the compiled train & decode graphs (graphlint).
+
+What XLA actually compiles is the artifact this reproduction optimizes —
+and regressions there (f32 upcasts, weights baked in as constants, a
+re-materialized kv concat, dropped buffer donation, an implicit all-gather)
+are invisible to output-equivalence tests. This package lints jaxprs and
+lowered/compiled HLO of any jitted function against declared intent:
+
+    from perceiver_io_tpu import analysis
+    report = analysis.check(step_fn, (state, batch),
+                            rules=("hot-concat", "callback-in-jit"),
+                            policy=analysis.LintPolicy(...))
+    assert report.ok()
+
+Entry points: :func:`check` (pytest/programmatic), ``tools/graphlint.py``
+(CLI over the flagship functions), the trainer's ``graphlint`` event
+(obs/events.py) and bench.py's ``telemetry.graphlint`` block. Rule catalog
+and allowlist syntax: docs/static-analysis.md.
+"""
+
+from perceiver_io_tpu.analysis.check import GraphLintError, Report, check
+from perceiver_io_tpu.analysis.graph import (
+    AvalInfo,
+    ConstInfo,
+    OpNode,
+    collective_counts,
+    count_output_aliases,
+    iter_consts,
+    iter_ops,
+    trace,
+)
+from perceiver_io_tpu.analysis.rules import RULES, LintPolicy, Violation, register_rule
+
+__all__ = [
+    "AvalInfo",
+    "ConstInfo",
+    "GraphLintError",
+    "LintPolicy",
+    "OpNode",
+    "RULES",
+    "Report",
+    "Violation",
+    "check",
+    "collective_counts",
+    "count_output_aliases",
+    "iter_consts",
+    "iter_ops",
+    "register_rule",
+    "trace",
+]

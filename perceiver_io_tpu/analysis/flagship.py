@@ -1,0 +1,230 @@
+"""Graphlint targets for the flagship workload: the 16k Perceiver AR CLM
+train step, prefill, and decode functions (the programs BASELINE.json and
+bench.py measure).
+
+``tools/graphlint.py`` (CLI), bench.py's ``telemetry.graphlint`` block and
+``tests/test_analysis.py``'s real-graph smoke all build the SAME functions
+through :func:`build_targets`, so the lint gate and the measured program
+can't drift apart. Geometries:
+
+- ``micro`` — the flagship architecture at toy sizes (same op structure,
+  same scopes, seconds to compile on CPU). Graph-shape rules are geometry-
+  invariant, so this is the default gate everywhere.
+- ``flagship`` — the real 16384/1024 single-chip geometry (bench.py
+  ``flagship_config`` numbers); trace is fine anywhere, compiling it is a
+  TPU-sized job.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Sequence, Tuple
+
+from perceiver_io_tpu.analysis.check import Report, check
+from perceiver_io_tpu.analysis.rules import LintPolicy
+
+# the known-good allowlist for DEFAULT kernel features:
+# - kv_concat: the concat prefix route (core/modules.py CrossAttention
+#   "kv_concat" scope) is the default until twoseg graduates from its
+#   staged A/B (PR 2, docs/performance.md) — under features=("twoseg",)
+#   the scope disappears from the trace entirely, which is the point;
+# - perceiver_ar._attend: the RoPE frequency-table [prefix; latents]
+#   concat — a true sequence-axis concat, but of a (B, N, head_dim/2)
+#   table (~1 MB f32 at 16k vs the kv build's 64 MB), reviewed and accepted
+DEFAULT_ALLOW: Tuple[str, ...] = (
+    "hot-concat:*kv_concat*",
+    "hot-concat:*perceiver_ar._attend",
+)
+
+GEOMETRIES = {
+    # same architecture/op structure as the flagship, toy sizes; latents
+    # stay >= 128 so the flash kernel routes (flash_supported) remain
+    # eligible when a feature-set lint forces flash on
+    "micro": dict(seq_len=512, latents=128, channels=64, heads=4, layers=2,
+                  batch=2, decode_tokens=8),
+    # bench.py flagship_config numbers (single v5e chip, 37M params)
+    "flagship": dict(seq_len=16384, latents=1024, channels=512, heads=8,
+                     layers=8, batch=4, decode_tokens=8),
+}
+
+
+@dataclasses.dataclass
+class LintTarget:
+    name: str
+    fn: object
+    args: tuple
+    policy: LintPolicy
+    allow: Tuple[str, ...]
+
+
+def _clm_config(g: dict, remat: bool = False):
+    from perceiver_io_tpu.models.text import CausalLanguageModelConfig
+
+    return CausalLanguageModelConfig(
+        vocab_size=262,
+        max_seq_len=g["seq_len"],
+        max_latents=g["latents"],
+        num_channels=g["channels"],
+        num_heads=g["heads"],
+        num_self_attention_layers=g["layers"],
+        cross_attention_dropout=0.5,
+        activation_checkpointing=remat,
+    )
+
+
+def build_targets(
+    geometry: str = "micro",
+    targets: Sequence[str] = ("train", "prefill", "decode"),
+    dtype=None,
+    collective_budget: Optional[Dict[str, int]] = None,
+) -> Dict[str, LintTarget]:
+    """Build the flagship functions and their lint policies.
+
+    Trace-time kernel features (``fast_kernels``) must be active around BOTH
+    this call and the subsequent ``check`` — callers own the feature
+    context, exactly as tools/step_ab.py does for its variants."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from perceiver_io_tpu.models.text import CausalLanguageModel
+    from perceiver_io_tpu.training import TrainState, clm_loss_fn, make_optimizer
+    from perceiver_io_tpu.training.loop import make_train_step
+
+    g = GEOMETRIES[geometry]
+    dtype = jnp.bfloat16 if dtype is None else dtype
+    config = _clm_config(g)
+    model = CausalLanguageModel(config, dtype=dtype)
+    b, n = g["batch"], g["seq_len"]
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, config.vocab_size, size=(b, n + 1))
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.asarray(tokens[:, : g["latents"] + 1]), prefix_len=1
+    )
+
+    backend = jax.default_backend()
+    # bf16 models must keep their projection matmuls bf16; the attention
+    # kernels' f32 score/accumulator islands are deliberate numerics and
+    # live outside these scopes
+    bf16_scopes = ("*qkv_proj*",) if dtype == jnp.bfloat16 else ()
+
+    out: Dict[str, LintTarget] = {}
+    if "train" in targets:
+        from perceiver_io_tpu.training.prefix_dropout import sample_prefix_keep_idx
+
+        prefix_len = n - g["latents"]
+        batch = {
+            "labels": jnp.asarray(tokens[:, 1:]),
+            "input_ids": jnp.asarray(tokens[:, :-1]),
+            "pad_mask": None,
+            "prefix_keep_idx": jnp.asarray(
+                sample_prefix_keep_idx(rng, b, prefix_len, config.cross_attention_dropout)
+            ),
+        }
+        tx = make_optimizer(1e-3, gradient_clip=1.0, moment_dtype="bfloat16")
+        state = TrainState.create(model.apply, params, tx, jax.random.PRNGKey(1))
+        step = make_train_step(clm_loss_fn(model.apply, max_latents=g["latents"]))
+        out["train"] = LintTarget(
+            name="train_step",
+            fn=step,
+            args=(state, batch),
+            policy=LintPolicy(
+                bf16_scopes=bf16_scopes,
+                # the train step donates its state; XLA:CPU does not commit
+                # donation (and utils/compat.py deliberately drops it there)
+                expect_donation=backend != "cpu",
+                collective_budget=collective_budget,
+            ),
+            allow=DEFAULT_ALLOW,
+        )
+
+    if "prefill" in targets or "decode" in targets:
+        from perceiver_io_tpu.generation import GenerationConfig, make_generate_fn
+
+        prompt = jnp.asarray(rng.integers(0, config.vocab_size, size=(b, n)))
+        for tgt, new_tokens in (("prefill", 1), ("decode", g["decode_tokens"])):
+            if tgt not in targets:
+                continue
+            fn = make_generate_fn(
+                model,
+                g["latents"],
+                GenerationConfig(max_new_tokens=new_tokens, do_sample=True, top_k=10),
+                cache_dtype=dtype,
+            )
+            out[tgt] = LintTarget(
+                name=tgt,
+                fn=fn,
+                args=(params, prompt),
+                policy=LintPolicy(
+                    bf16_scopes=bf16_scopes,
+                    collective_budget=collective_budget,
+                ),
+                allow=DEFAULT_ALLOW,
+            )
+    return out
+
+
+def lint_flagship(
+    geometry: str = "micro",
+    targets: Sequence[str] = ("train", "prefill", "decode"),
+    rules: Optional[Sequence[str]] = None,
+    allow: Sequence[str] = (),
+    compiled: Optional[bool] = None,
+    collective_budget: Optional[Dict[str, int]] = None,
+    features: Optional[Sequence[str]] = None,
+) -> Dict[str, Report]:
+    """Lint the flagship functions; returns ``{target: Report}``.
+
+    ``features``: trace-time kernel feature set to lint under (e.g.
+    ``("twoseg",)``); ``None`` keeps the ambient/default set. Feature sets
+    only exist on the flash kernel routes, which auto-enable on TPU only —
+    so an explicit ``features`` also forces flash on (interpret-capable
+    trace off-TPU), making the linted graph match the TPU program the
+    feature set actually changes."""
+    import contextlib
+
+    from perceiver_io_tpu.ops.flash_attention import default_flash, fast_kernels
+
+    if features is not None:
+        ctx: contextlib.AbstractContextManager = contextlib.ExitStack()
+        ctx.enter_context(default_flash(True))
+        ctx.enter_context(fast_kernels(set(features)))
+    else:
+        ctx = contextlib.nullcontext()
+    with ctx:
+        built = build_targets(geometry, targets, collective_budget=collective_budget)
+        return {
+            key: check(
+                t.fn,
+                t.args,
+                rules=rules,
+                allow=tuple(t.allow) + tuple(allow),
+                policy=t.policy,
+                compiled=compiled,
+                name=t.name,
+            )
+            for key, t in built.items()
+        }
+
+
+def graphlint_telemetry(geometry: str = "micro") -> dict:
+    """The ``telemetry.graphlint`` block for bench.py results: lint the
+    flagship train + decode graphs at micro sizes and summarize. Mirrors
+    ``kernel_smoke``'s contract — never raises; a failure is recorded."""
+    try:
+        reports = lint_flagship(geometry=geometry, targets=("train", "decode"))
+    except Exception as e:  # noqa: BLE001 — telemetry must not kill the bench
+        return {"status": "error", "error": str(e)}
+    status = "passed" if all(r.ok() for r in reports.values()) else "failed"
+    return {
+        "status": status,
+        "targets": {
+            k: {
+                "errors": r.count("error"),
+                "warnings": r.count("warn"),
+                "allowed": len(r.allowed),
+                "violations": [v.key for v in r.violations],
+            }
+            for k, r in reports.items()
+        },
+    }

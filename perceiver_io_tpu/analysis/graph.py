@@ -1,0 +1,240 @@
+"""Normalize compiled-graph artifacts into streams the lint rules consume.
+
+Three views of one jitted function, increasingly late in the pipeline:
+
+- **jaxpr** (``trace`` + ``iter_ops`` / ``iter_consts``): every equation of
+  the ``ClosedJaxpr`` — including the bodies of ``pjit`` / ``scan`` /
+  ``cond`` / ``custom_vjp`` calls — flattened into :class:`OpNode` records
+  carrying the primitive name, the ``jax.named_scope`` path the op was
+  traced under (PR 1 threads these through the model), operand/result
+  shapes+dtypes, and the eqn params. Closed-over array constants become
+  :class:`ConstInfo` records (a weight baked into the graph shows up here,
+  not in the arguments).
+- **lowered StableHLO** (``lower``): the pre-optimization module text, plus
+  any "donated buffers were not usable" warnings jax emits while lowering
+  (XLA:CPU drops donation at this point — the warning is the only trace).
+- **compiled HLO** (``compile_text``): the post-optimization executable
+  text — the only place GSPMD-inserted collectives and committed
+  input/output buffer aliases exist (``collective_counts`` /
+  ``count_output_aliases`` parse it).
+
+Everything here is read-only inspection: no rule logic, no severities —
+that lives in :mod:`perceiver_io_tpu.analysis.rules`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+import warnings
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import jax
+
+
+@dataclasses.dataclass(frozen=True)
+class AvalInfo:
+    """Shape/dtype of one operand or result."""
+
+    shape: Tuple[int, ...]
+    dtype: str
+
+    @property
+    def numel(self) -> int:
+        n = 1
+        for d in self.shape:
+            n *= int(d)
+        return n
+
+
+@dataclasses.dataclass(frozen=True)
+class OpNode:
+    """One jaxpr equation, with scope attribution."""
+
+    primitive: str
+    scope: str  # named_scope path, e.g. "prefill/cross_attend"; "" at top
+    invars: Tuple[AvalInfo, ...]
+    outvars: Tuple[AvalInfo, ...]
+    params: Dict[str, Any]  # eqn params with nested jaxprs stripped
+    depth: int  # nesting depth of enclosing call equations
+
+
+@dataclasses.dataclass(frozen=True)
+class ConstInfo:
+    """One closed-over array constant of the traced graph."""
+
+    shape: Tuple[int, ...]
+    dtype: str
+    nbytes: int
+    scope: str  # name stack of the call eqn whose body closes over it
+
+
+def _aval_info(v) -> Optional[AvalInfo]:
+    aval = getattr(v, "aval", None)
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return None
+    return AvalInfo(tuple(int(d) for d in shape), str(dtype))
+
+
+def _scope_of(eqn) -> str:
+    stack = getattr(eqn.source_info, "name_stack", None)
+    return "" if stack is None else str(stack)
+
+
+def _join_scope(outer: str, inner: str) -> str:
+    if not outer:
+        return inner
+    if not inner or inner == outer or inner.startswith(outer + "/"):
+        # inner stacks usually repeat the full path already — don't double it
+        return inner or outer
+    return f"{outer}/{inner}"
+
+
+def _sub_jaxprs(value) -> List[jax.core.Jaxpr]:
+    """Jaxpr bodies hiding in one eqn param value (pjit/scan carry a
+    ClosedJaxpr, cond a tuple of branches, custom_vjp nested callables)."""
+    out: List[jax.core.Jaxpr] = []
+    if isinstance(value, jax.core.ClosedJaxpr):
+        out.append(value.jaxpr)
+    elif isinstance(value, jax.core.Jaxpr):
+        out.append(value)
+    elif isinstance(value, (tuple, list)):
+        for v in value:
+            out.extend(_sub_jaxprs(v))
+    return out
+
+
+def trace(fn, *args, **kwargs) -> jax.core.ClosedJaxpr:
+    """``jax.make_jaxpr`` with kwargs support — the jaxpr view of ``fn``.
+
+    Trace-time feature flags (``fast_kernels`` etc.) must be active around
+    this call, exactly as they must be active around ``jax.jit``."""
+    if kwargs:
+        return jax.make_jaxpr(lambda *a: fn(*a, **kwargs))(*args)
+    return jax.make_jaxpr(fn)(*args)
+
+
+def iter_ops(closed: jax.core.ClosedJaxpr) -> Iterator[OpNode]:
+    """Every equation of ``closed`` and all nested call bodies, in program
+    order, as :class:`OpNode` records."""
+    stack: List[Tuple[jax.core.Jaxpr, str, int]] = [(closed.jaxpr, "", 0)]
+    while stack:
+        jpr, outer_scope, depth = stack.pop()
+        for eqn in jpr.eqns:
+            scope = _join_scope(outer_scope, _scope_of(eqn))
+            subs: List[jax.core.Jaxpr] = []
+            params: Dict[str, Any] = {}
+            for k, v in eqn.params.items():
+                nested = _sub_jaxprs(v)
+                if nested:
+                    subs.extend(nested)
+                else:
+                    params[k] = v
+            yield OpNode(
+                primitive=eqn.primitive.name,
+                scope=scope,
+                invars=tuple(a for a in (_aval_info(v) for v in eqn.invars) if a),
+                outvars=tuple(a for a in (_aval_info(v) for v in eqn.outvars) if a),
+                params=params,
+                depth=depth,
+            )
+            for sub in subs:
+                stack.append((sub, scope, depth + 1))
+
+
+def iter_consts(closed: jax.core.ClosedJaxpr) -> Iterator[ConstInfo]:
+    """Array constants closed over anywhere in the graph, deduplicated by
+    object identity (a const threaded through nested call bodies counts
+    once — at its outermost appearance)."""
+    seen: set = set()
+    stack: List[Tuple[jax.core.ClosedJaxpr, str]] = [(closed, "")]
+    while stack:
+        cj, scope = stack.pop()
+        for const in cj.consts:
+            if id(const) in seen:
+                continue
+            seen.add(id(const))
+            shape = getattr(const, "shape", None)
+            dtype = getattr(const, "dtype", None)
+            if shape is None or dtype is None:
+                continue  # python scalars etc.
+            nbytes = int(getattr(const, "nbytes", 0))
+            yield ConstInfo(tuple(int(d) for d in shape), str(dtype), nbytes, scope)
+        for eqn in cj.jaxpr.eqns:
+            scope = _scope_of(eqn)
+            for v in eqn.params.values():
+                if isinstance(v, jax.core.ClosedJaxpr):
+                    stack.append((v, scope))
+                elif isinstance(v, (tuple, list)):
+                    for item in v:
+                        if isinstance(item, jax.core.ClosedJaxpr):
+                            stack.append((item, scope))
+
+
+_DONATION_DROPPED_RE = re.compile(r"donated buffers were not usable", re.IGNORECASE)
+
+
+def lower(fn, args=(), kwargs=None, donate_argnums: Tuple[int, ...] = ()):
+    """Lower ``fn`` and capture jax's dropped-donation warnings.
+
+    Returns ``(lowered, dropped_donation_messages)``. A function that is
+    already jitted (has ``.lower``) is lowered as-is — its own
+    ``donate_argnums`` apply; otherwise it is wrapped in ``jax.jit`` with
+    the given ``donate_argnums``."""
+    kwargs = kwargs or {}
+    target = fn if hasattr(fn, "lower") else jax.jit(fn, donate_argnums=donate_argnums)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        lowered = target.lower(*args, **kwargs)
+    dropped = [str(w.message) for w in caught if _DONATION_DROPPED_RE.search(str(w.message))]
+    return lowered, dropped
+
+
+def compile_text(lowered) -> str:
+    """Post-optimization HLO text of the compiled executable."""
+    return lowered.compile().as_text()
+
+
+# collective ops as they appear in optimized HLO (plus their async -start
+# split forms); GSPMD emits these — the jaxpr has no trace of them unless
+# the program used shard_map/pmap explicitly
+_COLLECTIVE_RE = re.compile(
+    r"=\s*\S+\s+"
+    r"(all-gather|all-reduce|reduce-scatter|collective-permute|all-to-all)"
+    r"(?:-start)?\("
+)
+
+
+def collective_counts(hlo_text: str) -> Dict[str, int]:
+    """Occurrences of each collective op kind in compiled HLO text."""
+    counts: Dict[str, int] = {}
+    for m in _COLLECTIVE_RE.finditer(hlo_text):
+        kind = m.group(1)
+        counts[kind] = counts.get(kind, 0) + 1
+    return counts
+
+
+def count_output_aliases(hlo_text: str) -> int:
+    """Number of parameter buffers the compiled module aliases into outputs
+    (the committed form of ``donate_argnums``). 0 means every donation was
+    dropped (or none was declared)."""
+    # syntax (on the HloModule line): input_output_alias={ {0}: (0, {},
+    # may-alias), {1}: (2, {}) } — nested braces, so regex alone can't
+    # delimit it; brace-count from the opening "{". One "(param, ...)"
+    # group per aliased output.
+    start = hlo_text.find("input_output_alias={")
+    if start < 0:
+        return 0
+    i = hlo_text.index("{", start)
+    depth, j = 0, i
+    while j < len(hlo_text):
+        if hlo_text[j] == "{":
+            depth += 1
+        elif hlo_text[j] == "}":
+            depth -= 1
+            if depth == 0:
+                break
+        j += 1
+    return hlo_text[i:j].count("(")

@@ -1,0 +1,476 @@
+"""Lint rules over the normalized graph views, with a registry.
+
+Each rule is a function ``rule(ctx) -> list[Violation]`` registered under a
+kebab-case name with a default severity and the graph views it needs
+(``jaxpr`` — cheap, trace only; ``lowered`` / ``compiled`` — require
+lowering/compiling the function). A rule whose policy inputs are absent
+(e.g. ``dtype-drift`` with no declared bf16 scopes) returns nothing rather
+than guessing — the policy is the declaration of intent the graph is
+checked against.
+
+Scope matching is ``fnmatch`` over the ``jax.named_scope`` path recorded on
+each op (PR 1 threads these labels through the model: ``cross_attend``,
+``prefill``, ``decode``, ``qkv_proj``, …), so rules attribute violations to
+the module that traced the op, not just to a primitive index.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from fnmatch import fnmatch
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from perceiver_io_tpu.analysis import graph as G
+
+SEVERITIES = ("info", "warn", "error")
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    rule: str
+    severity: str  # "info" | "warn" | "error"
+    scope: str  # named_scope path of the offending op ("" = top level)
+    message: str
+    op: Optional[str] = None  # primitive / HLO op kind, when applicable
+
+    @property
+    def key(self) -> str:
+        """The string allowlist entries match against: ``rule:scope``."""
+        return f"{self.rule}:{self.scope or '<top>'}"
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["key"] = self.key
+        return d
+
+
+@dataclasses.dataclass
+class LintPolicy:
+    """What the caller declares about the function under lint — rules only
+    fire against declared intent (plus the always-wrong cases)."""
+
+    # dtype-drift: scopes declared to run bf16 compute (fnmatch patterns);
+    # f32 matmul-class ops inside them are drift
+    bf16_scopes: Tuple[str, ...] = ()
+    # hot-concat: scopes where a materialized concatenate is a lost fusion
+    # (attention/generation paths). Structural filters keep glue out: the
+    # output must be a real activation (rank >= 3 — batch/seq/channels) and
+    # the CONCATENATED axis must be long (>= min_concat_axis) — RoPE's
+    # rotate-half and frequency-table concats join short channel axes and
+    # pass, the [prefix; latents] kv build joins the sequence axis and fires
+    hot_scopes: Tuple[str, ...] = (
+        "*cross_attend*", "*self_attend*", "*attention*", "*attend*",
+        "*decode*", "*prefill*", "*flash*", "*kv_concat*",
+    )
+    min_concat_numel: int = 1024
+    min_concat_axis: int = 128
+    # any concatenate whose OUTPUT has a dimension of one of these sizes
+    # fires regardless of scope — the "this exact tensor must never be
+    # built" form of the rule (the PR 2 twoseg kv-concat guarantee)
+    concat_dim_sizes: Tuple[int, ...] = ()
+    # unsorted/non-unique gathers are only suspicious where a sorted or
+    # fused access was the design (attention kv reads, decode cache reads)
+    gather_scopes: Tuple[str, ...] = (
+        "*cross_attend*", "*self_attend*", "*attend*", "*kv_cache*", "*flash*",
+    )
+    min_gather_numel: int = 1024
+    # const-capture: array constants >= this many bytes baked into the
+    # jaxpr are closed-over weights, not blessed epsilon tables
+    const_bytes_limit: int = 1 << 16
+    # donation-dropped: argnums the caller declares donated (for plain fns;
+    # an already-jitted fn carries its own) — checked against the compiled
+    # executable's committed input/output aliases
+    donate_argnums: Tuple[int, ...] = ()
+    expect_donation: bool = False  # require aliases even without argnums info
+    # collective-budget: max allowed per compiled module, e.g.
+    # {"all-gather": 2, "all-reduce": 1} or {"total": 4}; None disables
+    collective_budget: Optional[Dict[str, int]] = None
+    # per-rule severity overrides, e.g. {"hot-concat": "warn"}
+    severity_overrides: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+class RuleContext:
+    """Lazily materialized graph views shared by all rules in one check."""
+
+    def __init__(
+        self,
+        fn,
+        args: tuple,
+        kwargs: dict,
+        policy: LintPolicy,
+        closed_jaxpr=None,
+    ):
+        import jax
+
+        self.fn = fn
+        self.args = args
+        self.kwargs = kwargs
+        self.policy = policy
+        self.backend = jax.default_backend()
+        self._closed = closed_jaxpr
+        self._ops: Optional[List[G.OpNode]] = None
+        self._consts: Optional[List[G.ConstInfo]] = None
+        self._lowered = None
+        self._dropped_donations: Optional[List[str]] = None
+        self._compiled_text: Optional[str] = None
+
+    @property
+    def closed_jaxpr(self):
+        if self._closed is None:
+            self._closed = G.trace(self.fn, *self.args, **self.kwargs)
+        return self._closed
+
+    @property
+    def ops(self) -> List[G.OpNode]:
+        if self._ops is None:
+            self._ops = list(G.iter_ops(self.closed_jaxpr))
+        return self._ops
+
+    @property
+    def consts(self) -> List[G.ConstInfo]:
+        if self._consts is None:
+            self._consts = list(G.iter_consts(self.closed_jaxpr))
+        return self._consts
+
+    def _ensure_lowered(self):
+        if self._lowered is None:
+            self._lowered, self._dropped_donations = G.lower(
+                self.fn, self.args, self.kwargs, donate_argnums=self.policy.donate_argnums
+            )
+        return self._lowered
+
+    @property
+    def dropped_donations(self) -> List[str]:
+        self._ensure_lowered()
+        return self._dropped_donations or []
+
+    @property
+    def compiled_text(self) -> str:
+        if self._compiled_text is None:
+            self._compiled_text = G.compile_text(self._ensure_lowered())
+        return self._compiled_text
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    name: str
+    default_severity: str
+    needs: str  # "jaxpr" | "compiled"
+    fn: Callable[[RuleContext], List[Violation]]
+    doc: str
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def register_rule(name: str, severity: str, needs: str, doc: str):
+    """Register a rule under ``name``; see docs/static-analysis.md for the
+    how-to-add-a-rule walkthrough this decorator anchors."""
+
+    def deco(fn):
+        RULES[name] = Rule(name, severity, needs, fn, doc)
+        return fn
+
+    return deco
+
+
+def _severity(ctx: RuleContext, rule: str, default: Optional[str] = None) -> str:
+    return ctx.policy.severity_overrides.get(rule, default or RULES[rule].default_severity)
+
+
+def _match(scope: str, patterns: Sequence[str]) -> bool:
+    return any(fnmatch(scope, p) for p in patterns)
+
+
+# ---------------------------------------------------------------- the rules
+
+
+# matmul-class compute: where running f32 instead of bf16 silently doubles
+# MXU time and HBM traffic; elementwise f32 islands (softmax, norms) are
+# deliberate numerics and not flagged
+_COMPUTE_PRIMS = ("dot_general", "conv_general_dilated")
+
+
+@register_rule(
+    "dtype-drift",
+    severity="error",
+    needs="jaxpr",
+    doc="f32 matmul-class ops inside a declared-bf16 scope (unintended upcast)",
+)
+def dtype_drift(ctx: RuleContext) -> List[Violation]:
+    pats = ctx.policy.bf16_scopes
+    if not pats:
+        return []
+    out = []
+    for op in ctx.ops:
+        if op.primitive not in _COMPUTE_PRIMS:
+            continue
+        if not _match(op.scope, pats):
+            continue
+        f32_out = [o for o in op.outvars if o.dtype == "float32"]
+        if not f32_out:
+            continue
+        out.append(
+            Violation(
+                rule="dtype-drift",
+                severity=_severity(ctx, "dtype-drift"),
+                scope=op.scope,
+                op=op.primitive,
+                message=(
+                    f"{op.primitive} computes float32 "
+                    f"{'x'.join(map(str, f32_out[0].shape))} inside a "
+                    "declared-bf16 scope — unintended upcast "
+                    "(preferred_element_type or a f32 operand leaking in?)"
+                ),
+            )
+        )
+    return out
+
+
+@register_rule(
+    "const-capture",
+    severity="error",
+    needs="jaxpr",
+    doc="large array constants baked into the jaxpr (closed-over weights)",
+)
+def const_capture(ctx: RuleContext) -> List[Violation]:
+    limit = ctx.policy.const_bytes_limit
+    out = []
+    for c in ctx.consts:
+        if c.nbytes < limit:
+            continue
+        out.append(
+            Violation(
+                rule="const-capture",
+                severity=_severity(ctx, "const-capture"),
+                scope=c.scope,
+                message=(
+                    f"{c.dtype}[{'x'.join(map(str, c.shape))}] "
+                    f"({c.nbytes / 1e6:.2f} MB) is baked into the graph as a "
+                    "constant — a closed-over weight is re-staged on every "
+                    "compile and excluded from donation/sharding; pass it as "
+                    "an argument"
+                ),
+            )
+        )
+    return out
+
+
+@register_rule(
+    "hot-concat",
+    severity="error",
+    needs="jaxpr",
+    doc="concatenate (or unsorted gather) materialized inside attention/generation scopes",
+)
+def hot_concat(ctx: RuleContext) -> List[Violation]:
+    p = ctx.policy
+    out = []
+    for op in ctx.ops:
+        if op.primitive == "concatenate":
+            out_aval = op.outvars[0] if op.outvars else None
+            axis = int(op.params.get("dimension", -1))
+            big = (
+                out_aval is not None
+                and out_aval.numel >= p.min_concat_numel
+                and len(out_aval.shape) >= 3
+                and 0 <= axis < len(out_aval.shape)
+                and out_aval.shape[axis] >= p.min_concat_axis
+            )
+            in_hot = _match(op.scope, p.hot_scopes) and big
+            # forbidden-size check is on the CONCATENATED axis only — an
+            # untouched axis that happens to equal the forbidden size (e.g.
+            # a seq dim on a channel-axis RoPE concat) is not a kv build
+            dim_hit = (
+                p.concat_dim_sizes
+                and out_aval is not None
+                and 0 <= axis < len(out_aval.shape)
+                and out_aval.shape[axis] in p.concat_dim_sizes
+            )
+            if not (in_hot or dim_hit):
+                continue
+            shape = "x".join(map(str, op.outvars[0].shape)) if op.outvars else "?"
+            why = (
+                f"builds a {shape} tensor with a forbidden dimension "
+                f"(sizes {tuple(p.concat_dim_sizes)})"
+                if dim_hit and not in_hot
+                else f"materializes a {shape} tensor on the hot path"
+            )
+            out.append(
+                Violation(
+                    rule="hot-concat",
+                    severity=_severity(ctx, "hot-concat"),
+                    scope=op.scope,
+                    op="concatenate",
+                    message=f"concatenate {why} — feed the segments to the kernel "
+                    "as separate operands (see ops/flash_attention.py twoseg)",
+                )
+            )
+        elif op.primitive == "gather":
+            if not _match(op.scope, p.gather_scopes):
+                continue
+            if op.outvars and op.outvars[0].numel < p.min_gather_numel:
+                continue
+            if op.params.get("indices_are_sorted") or op.params.get("unique_indices"):
+                continue
+            shape = "x".join(map(str, op.outvars[0].shape)) if op.outvars else "?"
+            out.append(
+                Violation(
+                    rule="hot-concat",
+                    severity=_severity(ctx, "hot-concat", "warn"),
+                    scope=op.scope,
+                    op="gather",
+                    message=(
+                        f"unsorted non-unique gather ({shape}) in an attention "
+                        "scope — its backward lowers to a serializing "
+                        "scatter-add; use ops/gathers.py scatter-free routes"
+                    ),
+                )
+            )
+    return out
+
+
+@register_rule(
+    "donation-dropped",
+    severity="error",
+    needs="compiled",
+    doc="declared donate_argnums whose buffers the compiled executable does not alias",
+)
+def donation_dropped(ctx: RuleContext) -> List[Violation]:
+    p = ctx.policy
+    declared = (
+        bool(p.donate_argnums)
+        or p.expect_donation
+        or _fn_donates(ctx.fn)
+        # authoritative across jax versions: the lowered module's args_info
+        # records per-arg donation (pjit hides donate_argnums attributes) —
+        # reached only when this rule runs, i.e. the compiled view was
+        # already requested, so the lowering is not an extra cost
+        or _lowered_donates(ctx)
+    )
+    if not declared:
+        return []
+    dropped = ctx.dropped_donations
+    aliased = G.count_output_aliases(ctx.compiled_text)
+    if aliased > 0 and not dropped:
+        return []
+    # XLA:CPU never commits donation — on cpu this is an environment
+    # limitation, not a model bug (and the persistent-cache interaction
+    # makes donation actively unsafe there: utils/compat.py donation notes)
+    sev = "warn" if ctx.backend == "cpu" else _severity(ctx, "donation-dropped")
+    detail = dropped[0] if dropped else "no input_output_alias in the compiled module"
+    return [
+        Violation(
+            rule="donation-dropped",
+            severity=sev,
+            scope="",
+            message=(
+                "buffer donation was declared but not committed "
+                f"({detail}) — the step pays a full params+opt-state copy "
+                "of HBM traffic every call"
+            ),
+        )
+    ]
+
+
+def _fn_donates(fn) -> bool:
+    """Best-effort attribute probe: does a jitted ``fn`` advertise its own
+    donate_argnums? On the pinned jax 0.4.37 PjitFunction these attributes
+    do not exist (always False) — :func:`_lowered_donates` is the
+    authoritative check once a lowering is available; this probe only
+    serves check()'s pre-lowering auto-compile decision on jax versions
+    that do expose them."""
+    for attr in ("_jit_info", "_fun"):
+        info = getattr(fn, attr, None)
+        if info is not None and getattr(info, "donate_argnums", None):
+            return True
+    return False
+
+
+def _lowered_donates(ctx: RuleContext) -> bool:
+    """Whether the lowered module's ``args_info`` marks any argument
+    donated — the per-version-stable record of ``donate_argnums``."""
+    import jax
+
+    try:
+        info = getattr(ctx._ensure_lowered(), "args_info", None)
+        leaves = jax.tree_util.tree_leaves(
+            info, is_leaf=lambda x: hasattr(x, "donated")
+        )
+        return any(getattr(x, "donated", False) for x in leaves)
+    except Exception:  # noqa: BLE001 — a probe, not a gate
+        return False
+
+
+@register_rule(
+    "collective-budget",
+    severity="error",
+    needs="compiled",
+    doc="all-gather/all-reduce/reduce-scatter counts in the compiled module vs a declared budget",
+)
+def collective_budget(ctx: RuleContext) -> List[Violation]:
+    budget = ctx.policy.collective_budget
+    if budget is None:
+        return []
+    counts = G.collective_counts(ctx.compiled_text)
+    out = []
+    total_budget = budget.get("total")
+    if total_budget is not None and sum(counts.values()) > total_budget:
+        out.append(
+            Violation(
+                rule="collective-budget",
+                severity=_severity(ctx, "collective-budget"),
+                scope="",
+                message=(
+                    f"{sum(counts.values())} collectives in the compiled module "
+                    f"exceed the declared total budget {total_budget} "
+                    f"(breakdown: {counts})"
+                ),
+            )
+        )
+    for kind, n in sorted(counts.items()):
+        cap = budget.get(kind)
+        if cap is not None and n > cap:
+            out.append(
+                Violation(
+                    rule="collective-budget",
+                    severity=_severity(ctx, "collective-budget"),
+                    scope="",
+                    op=kind,
+                    message=(
+                        f"{n}x {kind} in the compiled module exceeds the "
+                        f"declared budget {cap} — an implicit resharding "
+                        "(GSPMD) crept into the step"
+                    ),
+                )
+            )
+    return out
+
+
+_CALLBACK_PRIMS = ("pure_callback", "io_callback", "debug_callback")
+
+
+@register_rule(
+    "callback-in-jit",
+    severity="error",
+    needs="jaxpr",
+    doc="host callbacks (pure_callback/io_callback/debug prints) inside a hot jitted fn",
+)
+def callback_in_jit(ctx: RuleContext) -> List[Violation]:
+    out = []
+    for op in ctx.ops:
+        if op.primitive not in _CALLBACK_PRIMS:
+            continue
+        out.append(
+            Violation(
+                rule="callback-in-jit",
+                severity=_severity(ctx, "callback-in-jit"),
+                scope=op.scope,
+                op=op.primitive,
+                message=(
+                    f"{op.primitive} in the traced graph — a host round-trip "
+                    "per call serializes the device stream (a debug print or "
+                    "debug_unique_indices left on?)"
+                ),
+            )
+        )
+    return out

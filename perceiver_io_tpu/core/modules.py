@@ -174,8 +174,14 @@ class CrossAttention(nn.Module):
                     rope_k_prefix=None if rope_k is None else rope_k[:, :n_p],
                     rope_k_latent=None if rope_k is None else rope_k[:, n_p:],
                 )
-            x_kv_prefix = self.kv_norm(x_kv_prefix)
-            x_kv = jnp.concatenate([x_kv_prefix, x_q], axis=1)
+            with jax.named_scope("kv_concat"):
+                # the materialized [prefix; latents] kv tensor the twoseg
+                # route exists to kill — labeled so graphlint's hot-concat
+                # rule attributes it precisely (analysis/flagship.py
+                # DEFAULT_ALLOW allowlists exactly this scope while the
+                # concat route remains the default)
+                x_kv_prefix = self.kv_norm(x_kv_prefix)
+                x_kv = jnp.concatenate([x_kv_prefix, x_q], axis=1)
         else:
             x_kv = self.kv_norm(x_kv)
         return self.attention(

@@ -3,9 +3,10 @@
 ``events.jsonl`` is the machine-readable companion of ``metrics.csv`` — one
 JSON object per line, every line carrying ``ts`` (epoch seconds) and
 ``event`` (the kind). The trainer emits ``fit_start`` / ``log`` /
-``compile`` / ``eval`` / ``generate`` / ``fit_end`` events through one
-:class:`EventLog`; ``tools/obs_report.py`` renders a run directory back
-into a summary table.
+``compile`` / ``eval`` / ``generate`` / ``graphlint`` (the static-analysis
+verdict on the train step's traced graph — analysis/, one event per fit)
+/ ``fit_end`` events through one :class:`EventLog`;
+``tools/obs_report.py`` renders a run directory back into a summary table.
 
 ``run_manifest.json`` pins what the run actually ran on: mesh shape,
 device kind/count, jax version, and a stable hash of the model/trainer

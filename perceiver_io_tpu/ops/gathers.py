@@ -145,6 +145,11 @@ small_vocab_embed.defvjp(_sve_fwd, _sve_bwd)
 SMALL_VOCAB_MAX = 2048
 
 
+# named scopes on the dispatchers: graphlint (analysis/) attributes any
+# plain-gather fallback here to these labels instead of a bare primitive —
+# the hot-concat rule's gather check is scoped, so a route silently falling
+# back to the scatter-add backward becomes visible by name
+@jax.named_scope("embed_lookup")
 def embed_lookup(table: jnp.ndarray, ids: jnp.ndarray) -> jnp.ndarray:
     """Embedding lookup choosing the matmul-backward path for small tables."""
     if table.shape[0] <= SMALL_VOCAB_MAX and not _PLAIN_MODE.get():
@@ -190,6 +195,7 @@ def _gur_bwd(res, g):
 gather_unique_rows.defvjp(_gur_fwd, _gur_bwd)
 
 
+@jax.named_scope("gather_rows")
 def gather_rows(x: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
     """`gather_unique_rows` unless tracing inside :func:`plain_gathers`."""
     if _PLAIN_MODE.get():
@@ -234,6 +240,7 @@ def _gstr_bwd(res, g):
 gather_sorted_table_rows.defvjp(_gstr_fwd, _gstr_bwd)
 
 
+@jax.named_scope("gather_table_rows")
 def gather_table_rows(table: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
     """`gather_sorted_table_rows` unless tracing inside :func:`plain_gathers`
     (the plain ``take`` keeps shard_map's varying-axes check happy)."""

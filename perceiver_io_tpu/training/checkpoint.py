@@ -178,7 +178,11 @@ def _load_orbax_pretrained(directory: str, template_params=None):
             step = mngr.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoint steps under {root}")
-        payload = mngr.restore(step)
+        # a fresh manager reading another run's checkpoint needs the
+        # restore-args shim on newer orbax (utils/compat.py)
+        from perceiver_io_tpu.utils.compat import orbax_manager_restore
+
+        payload = orbax_manager_restore(mngr, step)
     finally:
         mngr.close()
     params = payload["params"] if isinstance(payload, dict) and "params" in payload else payload

@@ -94,7 +94,14 @@ def make_train_step(
 
     if not jit:
         return train_step
-    return jax.jit(train_step, donate_argnums=(0,) if donate else ())
+    # donation is dropped on XLA:CPU — not just useless there but UNSAFE
+    # in combination with the persistent compilation cache (a cache-hit
+    # executable returns the donated state unchanged; see
+    # utils/compat.donation_safe) — graphlint's donation-dropped rule
+    # audits that TPU/GPU builds actually commit the aliasing
+    from perceiver_io_tpu.utils.compat import donation_safe
+
+    return jax.jit(train_step, donate_argnums=(0,) if donate and donation_safe() else ())
 
 
 def _chunk(x, i: int, k: int):

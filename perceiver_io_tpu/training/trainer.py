@@ -69,6 +69,14 @@ class TrainerConfig:
     # peak FLOP/s of one device for the MFU denominator; None = look the
     # device kind up in obs.mfu.PEAK_FLOPS
     peak_flops_per_device: Optional[float] = None
+    # static-analysis gate (analysis/): at the first step of each fit, the
+    # train step's jaxpr is linted with the trace-only always-wrong rules
+    # and the result lands in events.jsonl as a `graphlint` event. Runs
+    # only when events are active (a logger is attached); one extra trace
+    # per fit. docs/static-analysis.md has the rule catalog.
+    graphlint: bool = True
+    graphlint_rules: tuple = ("const-capture", "callback-in-jit")
+    graphlint_allow: tuple = ()
 
 
 class Trainer:
@@ -110,6 +118,10 @@ class Trainer:
         self._events: Optional[EventLog] = None
         self._manifest_written = False
         self._train_step = self.recompiles.wrap(make_train_step(loss_fn), "train_step")
+        # the raw (unjitted) step for the graphlint trace: linting through
+        # the recompile-tracked jit wrapper would pollute its compile
+        # bookkeeping, and the raw fn traces identically
+        self._lint_step = make_train_step(loss_fn, jit=False)
         eval_fn = eval_loss_fn
         if eval_fn is None:
             # dropout must be off during validation (Lightning model.eval()
@@ -172,6 +184,37 @@ class Trainer:
                 self.logger.log_dir, main_process=getattr(self.logger, "_active", None)
             )
         return self._events
+
+    def _graphlint(self, events: EventLog, state: TrainState, batch) -> None:
+        """Lint the train step's jaxpr (trace-only rules) and emit the
+        result as a ``graphlint`` event. Telemetry contract: never takes
+        the training loop down — a lint failure is an event, an analysis
+        crash a warning."""
+        import warnings
+
+        try:
+            from perceiver_io_tpu import analysis
+
+            report = analysis.check(
+                self._lint_step,
+                (state, batch),
+                rules=self.config.graphlint_rules,
+                allow=self.config.graphlint_allow,
+                name="train_step",
+            )
+            events.emit(
+                "graphlint",
+                step=int(state.step),
+                ok=report.ok(),
+                clean=report.clean,
+                rules=list(report.rules_run),
+                counts={s: report.count(s) for s in ("error", "warn", "info")},
+                violations=[v.to_dict() for v in report.violations[:20]],
+                n_allowed=len(report.allowed),
+            )
+        except Exception as e:  # noqa: BLE001 — lint must not kill training
+            warnings.warn(f"graphlint failed on the train step: {e}")
+            events.emit("graphlint", step=int(state.step), error=str(e))
 
     # -- API --------------------------------------------------------------
 
@@ -283,9 +326,14 @@ class Trainer:
             # subtraction must not mix monotonic and wall (NTP-steppable) time
             t0 = time.perf_counter()
             window_overhead0 = goodput.overhead()
+            lint_pending = events is not None and cfg.graphlint
             try:
                 for _ in range(start_step, cfg.max_steps):
                     batch = self._prepare_batch(next(train_iter))
+                    if lint_pending:
+                        lint_pending = False
+                        with goodput.measure("graphlint"):
+                            self._graphlint(events, state, batch)
                     state, metrics = self._train_step(state, batch)
                     window.append(metrics)
                     window_samples += _leading_dim(batch)

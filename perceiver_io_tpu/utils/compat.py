@@ -47,3 +47,39 @@ def safe_increment(count):
 
     fn = getattr(optax, "safe_increment", None) or optax.safe_int32_increment
     return fn(count)
+
+
+def orbax_manager_restore(mngr, step):
+    """``CheckpointManager.restore(step)`` across the orbax args-API drift.
+
+    Old orbax restores bare. Newer orbax (0.5+) requires an
+    ``ocp.args.CheckpointArgs`` when the manager instance has no handler
+    registered for the saved item — exactly the warm-start case, where a
+    FRESH manager opens a checkpoint some other run's manager wrote
+    (``KeyError: Item "default" ... could not be restored``). The fallback
+    restores through ``StandardRestore()`` with no target tree, matching
+    the bare-restore semantics (a raw numpy pytree; callers template-coerce
+    afterwards)."""
+    try:
+        return mngr.restore(step)
+    except (KeyError, ValueError):
+        import orbax.checkpoint as ocp
+
+        return mngr.restore(step, args=ocp.args.StandardRestore())
+
+
+def donation_safe() -> bool:
+    """Whether ``jax.jit(..., donate_argnums=...)`` is safe to use on the
+    default backend.
+
+    False on XLA:CPU: donation buys nothing there (no HBM roofline), and
+    with a persistent compilation cache it is actively WRONG on this jax
+    line — a cache-deserialized executable re-commits the input/output
+    alias but returns the donated input buffers unchanged, so e.g. a train
+    step silently stops updating params on the second process to hit the
+    cache (reproduced on jax 0.4.37: fresh compile correct, cache hit
+    returns stale state). Callers should drop ``donate_argnums`` when this
+    returns False; TPU/GPU keep donation."""
+    import jax
+
+    return jax.default_backend() != "cpu"

@@ -106,6 +106,13 @@ def bench(args):
     run(sys.executable, "bench.py", *args.rest)
 
 
+@task
+def graphlint(args):
+    """Static-analysis gate over the flagship compiled graphs
+    (tools/graphlint.py; docs/static-analysis.md)."""
+    run(sys.executable, "tools/graphlint.py", "--fail-on", "error", *args.rest)
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("task", choices=sorted(TASKS))

@@ -1,0 +1,433 @@
+"""analysis/ (graphlint): each rule against a synthetic graph with a known
+planted violation (positive) and a clean twin (negative), allowlist
+behavior, the report/JSON surface, the trainer's ``graphlint`` event, and
+a smoke lint of the real flagship step functions on CPU."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from perceiver_io_tpu import analysis
+from perceiver_io_tpu.analysis import LintPolicy
+
+
+# ---------------------------------------------------------------- dtype-drift
+
+
+def test_dtype_drift_fires_on_f32_matmul_in_bf16_scope():
+    def planted(x):
+        with jax.named_scope("block"):
+            return x.astype(jnp.float32) @ jnp.ones((8, 8), jnp.float32)
+
+    report = analysis.check(
+        planted,
+        (jnp.ones((4, 8), jnp.bfloat16),),
+        rules=("dtype-drift",),
+        policy=LintPolicy(bf16_scopes=("*block*",)),
+    )
+    assert [v.rule for v in report.violations] == ["dtype-drift"]
+    assert report.violations[0].scope == "block"
+    assert not report.ok()
+
+
+def test_dtype_drift_clean_on_bf16_matmul_and_undeclared_scope():
+    def clean(x):
+        with jax.named_scope("block"):
+            return x @ jnp.ones((8, 8), jnp.bfloat16)
+
+    policy = LintPolicy(bf16_scopes=("*block*",))
+    x = jnp.ones((4, 8), jnp.bfloat16)
+    assert analysis.check(clean, (x,), rules=("dtype-drift",), policy=policy).clean
+
+    def f32_elsewhere(x):  # f32 matmul OUTSIDE the declared scope: fine
+        return x.astype(jnp.float32) @ jnp.ones((8, 8), jnp.float32)
+
+    assert analysis.check(f32_elsewhere, (x,), rules=("dtype-drift",), policy=policy).clean
+
+
+# -------------------------------------------------------------- const-capture
+
+
+def test_const_capture_fires_on_closed_over_weight():
+    big = np.ones((256, 256), np.float32)  # 256 KB >= the 64 KB default
+
+    def planted(x):
+        return x @ big
+
+    report = analysis.check(planted, (jnp.ones((4, 256)),), rules=("const-capture",))
+    assert [v.rule for v in report.violations] == ["const-capture"]
+    assert "256x256" in report.violations[0].message
+
+
+def test_const_capture_clean_below_threshold_and_for_arguments():
+    small = np.ones((16, 16), np.float32)  # 1 KB
+
+    def clean(x):
+        return x @ small
+
+    assert analysis.check(clean, (jnp.ones((4, 16)),), rules=("const-capture",)).clean
+
+    def weights_as_args(x, w):  # the fix the rule demands
+        return x @ w
+
+    big = jnp.ones((256, 256))
+    assert analysis.check(
+        weights_as_args, (jnp.ones((4, 256)), big), rules=("const-capture",)
+    ).clean
+
+
+# ----------------------------------------------------------------- hot-concat
+
+
+def _seq_concat_in(scope_name):
+    def fn(a, b):
+        with jax.named_scope(scope_name):
+            kv = jnp.concatenate([a, b], axis=1)  # (B, Np+Nq, C) seq-axis build
+            return kv.sum()
+
+    return fn
+
+
+_A, _B = jnp.ones((2, 200, 32)), jnp.ones((2, 128, 32))
+
+
+def test_hot_concat_fires_in_attention_scope():
+    report = analysis.check(
+        _seq_concat_in("cross_attend"), (_A, _B), rules=("hot-concat",)
+    )
+    assert [v.rule for v in report.violations] == ["hot-concat"]
+    assert report.violations[0].op == "concatenate"
+    assert "cross_attend" in report.violations[0].scope
+
+
+def test_hot_concat_clean_outside_hot_scope_and_for_channel_glue():
+    # same concat, cold scope: no violation
+    assert analysis.check(
+        _seq_concat_in("embed"), (_A, _B), rules=("hot-concat",)
+    ).clean
+
+    # RoPE-style channel-axis glue inside a hot scope: the concatenated
+    # axis is short, the structural filter keeps it out
+    def rotate_half(x):
+        with jax.named_scope("cross_attend"):
+            x1, x2 = jnp.split(x, 2, axis=-1)
+            return jnp.concatenate([-x2, x1], axis=-1).sum()
+
+    assert analysis.check(
+        rotate_half, (jnp.ones((2, 512, 32)),), rules=("hot-concat",)
+    ).clean
+
+
+def test_hot_concat_forbidden_dim_fires_anywhere():
+    """The twoseg-style guarantee: a concat producing a tensor with the
+    forbidden kv-length dimension ON THE CONCATENATED AXIS fires regardless
+    of scope."""
+    n_kv = _A.shape[1] + _B.shape[1]
+    report = analysis.check(
+        _seq_concat_in("embed"),  # cold scope — only the dim trigger applies
+        (_A, _B),
+        rules=("hot-concat",),
+        policy=LintPolicy(concat_dim_sizes=(n_kv,)),
+    )
+    assert len(report.violations) == 1
+    assert "forbidden dimension" in report.violations[0].message
+
+
+def test_hot_concat_forbidden_dim_ignores_untouched_axes():
+    """An axis that merely COINCIDES with the forbidden size must not fire:
+    a channel-axis rotate-half concat on a (B, n_kv, C) tensor joins the
+    last axis — the untouched seq axis equaling n_kv is not a kv build."""
+    def rotate_half(x):
+        x1, x2 = jnp.split(x, 2, axis=-1)
+        return jnp.concatenate([-x2, x1], axis=-1).sum()
+
+    report = analysis.check(
+        rotate_half,
+        (jnp.ones((2, 48, 8)),),
+        rules=("hot-concat",),
+        policy=LintPolicy(concat_dim_sizes=(48,)),
+    )
+    assert report.clean, report.format()
+
+
+def test_hot_gather_fires_on_unsorted_gather_in_attention_scope():
+    idx = jnp.asarray(np.random.default_rng(0).integers(0, 512, size=(2048,)))
+
+    def planted(table):
+        with jax.named_scope("self_attend"):
+            return jnp.take(table, idx, axis=0).sum()
+
+    report = analysis.check(planted, (jnp.ones((512, 64)),), rules=("hot-concat",))
+    assert [v.op for v in report.violations] == ["gather"]
+
+    def cold(table):  # same gather outside the attention scopes: clean
+        return jnp.take(table, idx, axis=0).sum()
+
+    assert analysis.check(cold, (jnp.ones((512, 64)),), rules=("hot-concat",)).clean
+
+
+# ------------------------------------------------------------ callback-in-jit
+
+
+def test_callback_in_jit_fires_on_debug_print():
+    def planted(x):
+        with jax.named_scope("decode"):
+            jax.debug.print("x={}", x.sum())
+        return x * 2
+
+    report = analysis.check(planted, (jnp.ones((4,)),), rules=("callback-in-jit",))
+    assert [v.rule for v in report.violations] == ["callback-in-jit"]
+    assert "decode" in report.violations[0].scope
+
+    def clean(x):
+        return x * 2
+
+    assert analysis.check(clean, (jnp.ones((4,)),), rules=("callback-in-jit",)).clean
+
+
+# ----------------------------------------------------------- donation-dropped
+
+
+def test_donation_dropped_fires_when_donation_unusable():
+    # the donated f32 buffer cannot back the bf16 output — jax drops the
+    # donation at lowering and the compiled module carries no alias
+    fn = jax.jit(lambda s: (s * 2).astype(jnp.bfloat16), donate_argnums=(0,))
+    report = analysis.check(
+        fn,
+        (jnp.ones((64, 64), jnp.float32),),
+        rules=("donation-dropped",),
+        policy=LintPolicy(expect_donation=True),
+    )
+    assert [v.rule for v in report.violations] == ["donation-dropped"]
+    # on CPU the drop is an environment limitation, downgraded to warn
+    # (utils/compat.donation_safe documents why donation is off there)
+    assert report.violations[0].severity == ("warn" if jax.default_backend() == "cpu" else "error")
+    assert not report.clean
+
+
+def test_donation_rule_skipped_without_declared_donation():
+    report = analysis.check(
+        lambda x: x * 2, (jnp.ones((4,)),), rules=("donation-dropped",)
+    )
+    assert report.rules_skipped == ("donation-dropped",)
+    assert report.clean
+
+
+def test_donation_detected_from_lowered_module_with_compiled_true():
+    """pjit hides donate_argnums attributes (jax 0.4.37), but with
+    compiled=True the rule reads the lowered args_info — a donating jitted
+    fn whose donation is dropped fires with NO policy hints."""
+    fn = jax.jit(lambda s: (s * 2).astype(jnp.bfloat16), donate_argnums=(0,))
+    report = analysis.check(
+        fn, (jnp.ones((64, 64), jnp.float32),),
+        rules=("donation-dropped",), compiled=True,
+    )
+    assert [v.rule for v in report.violations] == ["donation-dropped"]
+
+
+def test_donation_committed_is_clean():
+    # same-shape same-dtype donation: XLA commits the alias even on CPU
+    fn = jax.jit(lambda s, b: s + b, donate_argnums=(0,))
+    report = analysis.check(
+        fn,
+        (jnp.ones((64, 64)), jnp.ones((64, 64))),
+        rules=("donation-dropped",),
+        policy=LintPolicy(expect_donation=True),
+    )
+    assert report.clean
+
+
+# ---------------------------------------------------------- collective-budget
+
+
+def _psum_fn():
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from perceiver_io_tpu.utils.compat import shard_map
+
+    mesh = Mesh(np.array(jax.devices()).reshape(-1), ("x",))
+    fn = shard_map(
+        lambda x: jax.lax.psum(x, "x"), mesh=mesh, in_specs=P("x"), out_specs=P()
+    )
+    return jax.jit(fn), (jnp.ones((len(jax.devices()), 4)),)
+
+
+def test_collective_budget_fires_over_budget():
+    fn, args = _psum_fn()
+    report = analysis.check(
+        fn,
+        args,
+        rules=("collective-budget",),
+        policy=LintPolicy(collective_budget={"all-reduce": 0}),
+    )
+    assert [v.op for v in report.violations] == ["all-reduce"]
+    assert not report.ok()
+
+
+def test_collective_budget_clean_within_budget_and_total_form():
+    fn, args = _psum_fn()
+    assert analysis.check(
+        fn, args, rules=("collective-budget",),
+        policy=LintPolicy(collective_budget={"all-reduce": 4}),
+    ).clean
+    report = analysis.check(
+        fn, args, rules=("collective-budget",),
+        policy=LintPolicy(collective_budget={"total": 0}),
+    )
+    assert len(report.violations) == 1 and "total budget" in report.violations[0].message
+
+
+# ----------------------------------------------------- allowlist + report API
+
+
+def test_allowlist_by_rule_and_by_scope_key():
+    fn, args = _seq_concat_in("cross_attend"), (_A, _B)
+    by_rule = analysis.check(fn, args, rules=("hot-concat",), allow=("hot-concat",))
+    assert by_rule.ok() and by_rule.clean and len(by_rule.allowed) == 1
+
+    by_key = analysis.check(
+        fn, args, rules=("hot-concat",), allow=("hot-concat:*cross_attend*",)
+    )
+    assert by_key.clean and len(by_key.allowed) == 1
+
+    miss = analysis.check(
+        fn, args, rules=("hot-concat",), allow=("hot-concat:*decode*",)
+    )
+    assert not miss.clean and not miss.allowed
+
+
+def test_unknown_rule_raises():
+    with pytest.raises(ValueError, match="unknown rule"):
+        analysis.check(lambda x: x, (jnp.ones(1),), rules=("no-such-rule",))
+
+
+def test_report_surface():
+    report = analysis.check(
+        _seq_concat_in("cross_attend"), (_A, _B), rules=("hot-concat",)
+    )
+    d = json.loads(report.to_json())
+    assert d["counts"]["error"] == 1 and d["violations"][0]["rule"] == "hot-concat"
+    assert "hot-concat" in report.format()
+    with pytest.raises(analysis.GraphLintError):
+        report.raise_if("error")
+    report.raise_if("none")  # no-op
+
+
+def test_invalid_severity_override_rejected_at_config_time():
+    with pytest.raises(ValueError, match="invalid severity"):
+        analysis.check(
+            lambda x: x, (jnp.ones(1),),
+            policy=LintPolicy(severity_overrides={"hot-concat": "warning"}),
+        )
+
+
+def test_severity_override_respected():
+    report = analysis.check(
+        _seq_concat_in("cross_attend"),
+        (_A, _B),
+        rules=("hot-concat",),
+        policy=LintPolicy(severity_overrides={"hot-concat": "info"}),
+    )
+    assert report.ok() and report.count("info") == 1
+
+
+# -------------------------------------------------- trainer graphlint event
+
+
+def test_trainer_emits_graphlint_event_with_planted_const(tmp_path):
+    from perceiver_io_tpu.training.metrics import MetricsLogger
+    from perceiver_io_tpu.training.optim import make_optimizer
+    from perceiver_io_tpu.training.state import TrainState
+    from perceiver_io_tpu.training.trainer import Trainer, TrainerConfig
+
+    baked = np.ones((200, 200), np.float32)  # 160 KB closed-over "weight"
+
+    def apply_fn(p, x):
+        return (x @ p["w"]) @ baked
+
+    def loss_fn(p, batch, rng):
+        out = apply_fn(p, batch["x"])
+        return jnp.mean(out**2), {"loss": jnp.mean(out**2)}
+
+    state = TrainState.create(
+        apply_fn, {"w": jnp.ones((8, 200))}, make_optimizer(1e-3), jax.random.PRNGKey(0)
+    )
+    logger = MetricsLogger(str(tmp_path), use_tensorboard=False)
+    trainer = Trainer(loss_fn, config=TrainerConfig(max_steps=2, log_interval=1), logger=logger)
+
+    def batches():
+        while True:
+            yield {"x": jnp.ones((2, 8))}
+
+    state = trainer.fit(state, batches())
+    assert int(state.step) == 2
+    events = [json.loads(l) for l in open(os.path.join(str(tmp_path), "events.jsonl"))]
+    gl = [e for e in events if e["event"] == "graphlint"]
+    assert len(gl) == 1, "exactly one graphlint event per fit"
+    assert gl[0]["ok"] is False and gl[0]["counts"]["error"] >= 1
+    assert any(v["rule"] == "const-capture" for v in gl[0]["violations"])
+
+
+def test_trainer_graphlint_off_emits_nothing(tmp_path):
+    from perceiver_io_tpu.training.metrics import MetricsLogger
+    from perceiver_io_tpu.training.optim import make_optimizer
+    from perceiver_io_tpu.training.state import TrainState
+    from perceiver_io_tpu.training.trainer import Trainer, TrainerConfig
+
+    def loss_fn(p, batch, rng):
+        out = batch["x"] @ p["w"]
+        return jnp.mean(out**2), {"loss": jnp.mean(out**2)}
+
+    state = TrainState.create(
+        None, {"w": jnp.ones((8, 8))}, make_optimizer(1e-3), jax.random.PRNGKey(0)
+    )
+    logger = MetricsLogger(str(tmp_path), use_tensorboard=False)
+    trainer = Trainer(
+        loss_fn, config=TrainerConfig(max_steps=1, log_interval=1, graphlint=False), logger=logger
+    )
+    trainer.fit(state, iter([{"x": jnp.ones((2, 8))}] * 2))
+    events = [json.loads(l) for l in open(os.path.join(str(tmp_path), "events.jsonl"))]
+    assert not [e for e in events if e["event"] == "graphlint"]
+
+
+# ------------------------------------------------------- flagship smoke (CPU)
+
+
+def test_flagship_micro_lint_is_clean():
+    """The real flagship train/prefill/decode graphs lint clean at micro
+    geometry with the documented default allowlist — the gate bench.py and
+    `tasks.py graphlint` run."""
+    from perceiver_io_tpu.analysis.flagship import lint_flagship
+
+    reports = lint_flagship(geometry="micro")
+    assert set(reports) == {"train", "prefill", "decode"}
+    for name, report in reports.items():
+        assert report.ok(), f"{name}:\n{report.format()}"
+        # the default-route kv concat is allowlisted, not silently absent
+    assert any("kv_concat" in v.key for v in reports["train"].allowed)
+
+
+def test_flagship_twoseg_feature_removes_kv_concat():
+    """Linting under features=('twoseg',) the kv_concat scope disappears
+    from the trace entirely — the PR 2 guarantee at flagship level."""
+    from perceiver_io_tpu.analysis.flagship import lint_flagship
+
+    off = lint_flagship(geometry="micro", targets=("train",), features=())["train"]
+    on = lint_flagship(geometry="micro", targets=("train",), features=("twoseg",))["train"]
+    assert any("kv_concat" in v.key for v in off.allowed)
+    assert not any("kv_concat" in v.key for v in on.allowed + on.violations)
+    assert on.ok()
+
+
+def test_graphlint_telemetry_block_shape():
+    from perceiver_io_tpu.analysis.flagship import graphlint_telemetry
+
+    block = graphlint_telemetry()
+    assert block["status"] in ("passed", "failed")
+    assert set(block["targets"]) == {"train", "decode"}
+    for t in block["targets"].values():
+        assert {"errors", "warnings", "allowed", "violations"} <= set(t)

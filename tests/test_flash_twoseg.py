@@ -253,35 +253,28 @@ def test_segmented_path_materializes_no_kv_concat():
     traced prefix cross-attention contains NO concatenate over the kv
     sequence axis — the [prefix; latents] tensor, its LayerNorm output and
     its K/V projections are never built. The flag-off trace contains the
-    concat (the old path), so the assertion is discriminating."""
+    concat (the old path), so the assertion is discriminating.
+
+    Enforced through the shared static-analysis API (analysis/, ISSUE 3):
+    the hot-concat rule's ``concat_dim_sizes`` trigger flags any
+    concatenate producing the joined kv length, scope-independently — the
+    same walker tools/graphlint.py runs over the flagship graphs."""
+    from perceiver_io_tpu import analysis
+
     ca = _cross_attention()
     x_q, x_p, _, _ = _module_inputs()
     params = ca.init(jax.random.PRNGKey(0), x_q, x_kv_prefix=x_p)
+    n_kv = x_p.shape[1] + x_q.shape[1]
 
-    def n_kv_concats(features):
+    def lint(features):
         with fast_kernels(features):
-            jaxpr = jax.make_jaxpr(
-                lambda p: ca.apply(p, x_q, x_kv_prefix=x_p).last_hidden_state
-            )(params)
-        n_kv = x_p.shape[1] + x_q.shape[1]
+            return analysis.check(
+                lambda p: ca.apply(p, x_q, x_kv_prefix=x_p).last_hidden_state,
+                (params,),
+                rules=("hot-concat",),
+                policy=analysis.LintPolicy(concat_dim_sizes=(n_kv,)),
+            )
 
-        # walk nested jaxprs too (pjit/custom_vjp bodies)
-        total = 0
-        stack = [jaxpr.jaxpr]
-        while stack:
-            jpr = stack.pop()
-            for eqn in jpr.eqns:
-                if eqn.primitive.name == "concatenate" and any(
-                    getattr(v.aval, "shape", (None, None))[1:2] == (n_kv,)
-                    for v in eqn.outvars
-                ):
-                    total += 1
-                for val in eqn.params.values():
-                    if isinstance(val, jax.core.ClosedJaxpr):
-                        stack.append(val.jaxpr)
-                    elif isinstance(val, jax.core.Jaxpr):
-                        stack.append(val)
-        return total
-
-    assert n_kv_concats(frozenset()) >= 1  # the old path builds the concat
-    assert n_kv_concats(frozenset({"twoseg"})) == 0
+    assert not lint(frozenset()).clean  # the old path builds the concat
+    report = lint(frozenset({"twoseg"}))
+    assert report.clean, report.format()

@@ -1,0 +1,96 @@
+"""graphlint CLI — static analysis of the flagship compiled graphs.
+
+Lints the flagship train step, prefill and decode functions
+(perceiver_io_tpu/analysis/flagship.py builds the same programs bench.py
+measures) against the full rule set and prints a human report per target
+plus, optionally, one JSON artifact. Exit status follows ``--fail-on``, so
+this is the CI gate `tasks.py graphlint` wraps:
+
+    python tools/graphlint.py --fail-on error
+    python tools/graphlint.py --geometry flagship --no-compiled   # trace-only
+    python tools/graphlint.py --kernel-features twoseg            # A/B the lint
+    python tools/graphlint.py --json graphlint.json --allow 'hot-concat:*mlp*'
+
+Rule catalog and allowlist syntax: docs/static-analysis.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--geometry", choices=("micro", "flagship"), default="micro",
+                   help="micro (default): flagship architecture at toy sizes — "
+                        "graph-shape rules are geometry-invariant and this "
+                        "compiles in seconds on CPU; flagship: the real 16k "
+                        "geometry (compiling it is a TPU-sized job — pair "
+                        "with --no-compiled elsewhere)")
+    p.add_argument("--targets", default="train,prefill,decode",
+                   help="comma list of flagship functions to lint")
+    p.add_argument("--rules", default=None,
+                   help="comma list of rules to run (default: all registered)")
+    p.add_argument("--allow", action="append", default=[],
+                   help="extra allowlist entry (repeatable), fnmatch-ed against "
+                        "'rule' and 'rule:scope' — e.g. 'hot-concat:*decode*'")
+    p.add_argument("--fail-on", choices=("error", "warn", "info", "none"),
+                   default="error",
+                   help="exit non-zero when any violation at/above this "
+                        "severity survives the allowlist")
+    p.add_argument("--json", default=None, metavar="PATH",
+                   help="write {target: report} JSON artifact")
+    p.add_argument("--compiled", dest="compiled", action="store_true", default=None,
+                   help="force lowering+compiling (the donation/collective rules)")
+    p.add_argument("--no-compiled", dest="compiled", action="store_false",
+                   help="forbid compiling — trace-only rules")
+    p.add_argument("--kernel-features", default=None,
+                   help="trace-time flash kernel feature set to lint under: "
+                        "'all', 'none', or a comma list (e.g. 'twoseg') — same "
+                        "tokens as bench.py --kernel-features")
+    p.add_argument("--collective-budget", default=None,
+                   help="JSON dict enabling the collective-budget rule, e.g. "
+                        "'{\"all-gather\": 2, \"total\": 4}'")
+    args = p.parse_args(argv)
+
+    from perceiver_io_tpu.analysis.flagship import lint_flagship
+
+    features = None
+    if args.kernel_features is not None:
+        from perceiver_io_tpu.ops.flash_attention import ALL_FEATURES
+
+        features = {
+            "all": tuple(ALL_FEATURES), "none": ()
+        }.get(args.kernel_features, tuple(f for f in args.kernel_features.split(",") if f))
+
+    budget = json.loads(args.collective_budget) if args.collective_budget else None
+    reports = lint_flagship(
+        geometry=args.geometry,
+        targets=tuple(t for t in args.targets.split(",") if t),
+        rules=tuple(args.rules.split(",")) if args.rules else None,
+        allow=tuple(args.allow),
+        compiled=args.compiled,
+        collective_budget=budget,
+        features=features,
+    )
+
+    for report in reports.values():
+        print(report.format())
+        print()
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({k: r.to_dict() for k, r in reports.items()}, f, indent=1)
+        print(f"wrote {args.json}")
+
+    failed = [k for k, r in reports.items() if not r.ok(args.fail_on)]
+    if failed:
+        print(f"graphlint FAILED ({args.fail_on}+) on: {', '.join(failed)}")
+        return 1
+    print(f"graphlint ok ({len(reports)} target(s), fail-on={args.fail_on})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
