@@ -697,6 +697,17 @@ def main():
                         "'none', or a comma list (e.g. 'twoseg') — see "
                         "ops/flash_attention.py ALL_FEATURES; recorded in the "
                         "result's telemetry block")
+    p.add_argument("--mesh", default=None, metavar="data=N[,fsdp=M]",
+                   help="train mode: shard the step over this data/fsdp mesh "
+                        "(state via shard_train_state, batch via shard_batch) "
+                        "and record telemetry.collectives (per-kind counts + "
+                        "estimated bytes from the compiled HLO) in the artifact")
+    p.add_argument("--overlap", choices=["on", "off"], default="off",
+                   help="with --mesh: 'on' runs the explicit overlap-scheduled "
+                        "shard_map step (parallel/overlap.py: chunk-interleaved "
+                        "gradient reduce-scatter + FSDP all-gather prefetch); "
+                        "default off (GSPMD) until the TPU A/B lands "
+                        "(docs/performance.md round 7; tools/overlap_ab.py)")
     p.add_argument("--out", default=None, help="extra mode: JSON artifact path (e.g. BENCH_extra_r3.json)")
     args = p.parse_args()
 
@@ -741,10 +752,14 @@ def main():
     else:
         # unlike kernel_smoke this gate never raises: a lint FAILURE is a
         # recorded verdict in the artifact (the CI-facing hard gate is
-        # `tasks.py graphlint` / tools/graphlint.py --fail-on error)
+        # `tasks.py graphlint` / tools/graphlint.py --fail-on error). A
+        # --mesh train run also lints the SHARDED micro step (the overlap
+        # scheduling claim) as the train_sharded target.
         from perceiver_io_tpu.analysis.flagship import graphlint_telemetry
 
-        _GRAPHLINT_STATUS = graphlint_telemetry()
+        _GRAPHLINT_STATUS = graphlint_telemetry(
+            mesh_spec=args.mesh if args.mode == "train" else None
+        )
         print(f"graphlint {_GRAPHLINT_STATUS['status']}", flush=True)
 
     if args.mode == "extra":
@@ -797,8 +812,39 @@ def main():
     microbatch = args.microbatch if b % args.microbatch == 0 else 1
     if microbatch != args.microbatch:
         print(f"note: --microbatch {args.microbatch} does not divide batch {b}; using 1")
+
+    mesh = None
+    if args.mesh:
+        from perceiver_io_tpu.parallel import shard_batch
+        from perceiver_io_tpu.parallel.overlap import OverlapConfig, mesh_from_spec
+        from perceiver_io_tpu.training.loop import shard_train_state
+
+        try:
+            mesh = mesh_from_spec(args.mesh)
+        except ValueError as e:
+            raise SystemExit(str(e)) from None
+        state = shard_train_state(state, mesh)
+        batch = shard_batch(batch, mesh)
+        need = mesh.size
+        # sharded steps chunk the PER-DEVICE batch (b / submesh), so the
+        # microbatch fallback re-checks divisibility at that granularity
+        per_device = b // need
+        if microbatch > 1 and per_device % microbatch != 0:
+            print(
+                f"note: --microbatch {microbatch} does not divide the per-device "
+                f"batch {per_device} on mesh {args.mesh}; using 1"
+            )
+            microbatch = 1
+    overlap_cfg = None
+    if args.overlap == "on":
+        if mesh is None:
+            raise SystemExit("--overlap on requires --mesh")
+        overlap_cfg = OverlapConfig(mesh=mesh)
     step = make_train_step(
-        clm_loss_fn(model.apply, max_latents=args.latents), jit=False, microbatch=microbatch
+        clm_loss_fn(model.apply, max_latents=args.latents),
+        jit=False,
+        microbatch=microbatch,
+        overlap=overlap_cfg,
     )
 
     timer = StepTimer(warmup=1)
@@ -808,15 +854,26 @@ def main():
     # analytic A100 reference: same step FLOPs at MFU_BAR..MFU_LOW
     flops = train_step_flops(config, b, prefix_dropout_keep=0.5)
 
+    mesh_tag = "" if mesh is None else f", mesh {args.mesh}, overlap {args.overlap}"
     result = {
         "metric": f"perceiver-ar-clm train tokens/sec/chip @{args.seq_len} ctx "
         f"({n_params/1e6:.1f}M params, {args.dtype}, batch {b}, "
-        f"microbatch {microbatch}, prefix_len={prefix_len})",
+        f"microbatch {microbatch}, prefix_len={prefix_len}{mesh_tag})",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/sec/chip",
         **_vs_baseline_fields(flops, step_time),
         **telemetry_fields(flops, step_time, [t / TIMER_CHAIN for t in timer.steps]),
     }
+    if mesh is not None:
+        # the audited communication footprint of the measured step: per-kind
+        # collective counts + estimated bytes from the compiled HLO, so a
+        # collective-count regression is visible in the committed artifact
+        from perceiver_io_tpu.analysis.graph import collective_stats
+
+        hlo = jax.jit(step).lower(state, batch).compile().as_text()
+        result["telemetry"]["mesh"] = {str(k): int(v) for k, v in mesh.shape.items()}
+        result["telemetry"]["overlap"] = args.overlap == "on"
+        result["telemetry"]["collectives"] = collective_stats(hlo)
     print(json.dumps(result))
 
 

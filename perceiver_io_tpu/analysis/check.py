@@ -164,6 +164,8 @@ def check(
             return bool(policy.donate_argnums) or policy.expect_donation or _fn_donates(fn)
         if rule_name == "collective-budget":
             return policy.collective_budget is not None
+        if rule_name == "collective-overlap":
+            return policy.expect_overlap
         return True
 
     run: List[str] = []

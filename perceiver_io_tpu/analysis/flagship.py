@@ -77,8 +77,20 @@ def build_targets(
     targets: Sequence[str] = ("train", "prefill", "decode"),
     dtype=None,
     collective_budget: Optional[Dict[str, int]] = None,
+    mesh=None,
+    overlap: bool = True,
+    microbatch: Optional[int] = None,
 ) -> Dict[str, LintTarget]:
     """Build the flagship functions and their lint policies.
+
+    ``mesh``: a data/fsdp ``jax.sharding.Mesh`` shards the TRAIN target
+    (state via ``shard_train_state``, batch via ``shard_batch``; the batch
+    is padded up to the submesh). ``overlap=True`` (default) builds the
+    explicit ``parallel/overlap.py`` step with ``expect_overlap`` set and a
+    collective budget derived from :func:`~perceiver_io_tpu.parallel.overlap.
+    expected_collectives`; ``overlap=False`` lints the GSPMD step instead
+    (no overlap claim — XLA owns the schedule). ``microbatch`` defaults to
+    2 on the sharded step (the chunk-interleaving claim needs >= 2 chunks).
 
     Trace-time kernel features (``fast_kernels``) must be active around BOTH
     this call and the subsequent ``check`` — callers own the feature
@@ -96,6 +108,11 @@ def build_targets(
     config = _clm_config(g)
     model = CausalLanguageModel(config, dtype=dtype)
     b, n = g["batch"], g["seq_len"]
+    if mesh is not None:
+        # batch must divide the data x fsdp submesh, with >= 2 samples per
+        # device so the sharded step can microbatch-chunk
+        dpf = mesh.shape["data"] * mesh.shape["fsdp"]
+        b = dpf * max(2, -(-b // dpf))
     rng = np.random.default_rng(0)
     tokens = rng.integers(0, config.vocab_size, size=(b, n + 1))
     params = model.init(
@@ -123,18 +140,64 @@ def build_targets(
         }
         tx = make_optimizer(1e-3, gradient_clip=1.0, moment_dtype="bfloat16")
         state = TrainState.create(model.apply, params, tx, jax.random.PRNGKey(1))
-        step = make_train_step(clm_loss_fn(model.apply, max_latents=g["latents"]))
-        out["train"] = LintTarget(
-            name="train_step",
-            fn=step,
-            args=(state, batch),
-            policy=LintPolicy(
+        loss_fn = clm_loss_fn(model.apply, max_latents=g["latents"])
+        if mesh is None:
+            step = make_train_step(loss_fn)
+            policy = LintPolicy(
                 bf16_scopes=bf16_scopes,
                 # the train step donates its state; XLA:CPU does not commit
                 # donation (and utils/compat.py deliberately drops it there)
                 expect_donation=backend != "cpu",
                 collective_budget=collective_budget,
-            ),
+            )
+        else:
+            from perceiver_io_tpu.parallel.mesh import shard_batch
+            from perceiver_io_tpu.parallel.overlap import (
+                DEFAULT_BUCKET_BYTES,
+                OverlapConfig,
+                expected_collectives,
+            )
+            from perceiver_io_tpu.training.loop import shard_train_state
+
+            # min_weight_size=0 so the micro model actually fsdp-shards;
+            # small buckets at micro geometry so multiple gather/scatter
+            # buckets (the interleaving structure) exist to lint
+            bucket_bytes = DEFAULT_BUCKET_BYTES if geometry == "flagship" else 128 << 10
+            k = 2 if microbatch is None else microbatch
+            state = shard_train_state(state, mesh, min_weight_size=0)
+            batch = shard_batch(batch, mesh)
+            if overlap:
+                step = make_train_step(
+                    loss_fn,
+                    microbatch=k,
+                    overlap=OverlapConfig(
+                        mesh=mesh, bucket_bytes=bucket_bytes, min_weight_size=0
+                    ),
+                )
+            else:
+                step = make_train_step(loss_fn, microbatch=k)
+            budget = collective_budget
+            if budget is None and overlap:
+                budget = expected_collectives(
+                    state.params, mesh, microbatch=k,
+                    bucket_bytes=bucket_bytes, min_weight_size=0,
+                )
+                # the GSPMD optimizer update outside the shard_map region
+                # adds per-leaf global-norm partial all-reduces: budget one
+                # per parameter leaf plus headroom for the metrics tree
+                n_leaves = len(jax.tree_util.tree_leaves(state.params))
+                budget["all-reduce"] += n_leaves + 16
+            policy = LintPolicy(
+                bf16_scopes=bf16_scopes,
+                expect_donation=backend != "cpu",
+                expect_overlap=overlap,
+                collective_budget=budget,
+            )
+        out["train"] = LintTarget(
+            name="train_step",
+            fn=step,
+            args=(state, batch),
+            policy=policy,
             allow=DEFAULT_ALLOW,
         )
 
@@ -172,8 +235,14 @@ def lint_flagship(
     compiled: Optional[bool] = None,
     collective_budget: Optional[Dict[str, int]] = None,
     features: Optional[Sequence[str]] = None,
+    mesh=None,
+    overlap: bool = True,
 ) -> Dict[str, Report]:
     """Lint the flagship functions; returns ``{target: Report}``.
+
+    ``mesh``/``overlap``: shard the train target over a data/fsdp mesh and
+    lint the overlap-scheduled (or, with ``overlap=False``, the GSPMD)
+    distributed step — see :func:`build_targets`.
 
     ``features``: trace-time kernel feature set to lint under (e.g.
     ``("twoseg",)``); ``None`` keeps the ambient/default set. Feature sets
@@ -192,7 +261,9 @@ def lint_flagship(
     else:
         ctx = contextlib.nullcontext()
     with ctx:
-        built = build_targets(geometry, targets, collective_budget=collective_budget)
+        built = build_targets(
+            geometry, targets, collective_budget=collective_budget, mesh=mesh, overlap=overlap
+        )
         return {
             key: check(
                 t.fn,
@@ -207,17 +278,38 @@ def lint_flagship(
         }
 
 
-def graphlint_telemetry(geometry: str = "micro") -> dict:
+def graphlint_telemetry(geometry: str = "micro", mesh_spec: Optional[str] = None) -> dict:
     """The ``telemetry.graphlint`` block for bench.py results: lint the
     flagship train + decode graphs at micro sizes and summarize. Mirrors
-    ``kernel_smoke``'s contract — never raises; a failure is recorded."""
+    ``kernel_smoke``'s contract — never raises; a failure is recorded.
+
+    ``mesh_spec`` (bench ``--mesh``): additionally lint the SHARDED micro
+    train step — the overlap-scheduled shard_map step with the
+    ``collective-overlap`` rule and its derived collective budget — as a
+    ``train_sharded`` target (skipped with a note when the host has fewer
+    devices than the mesh needs)."""
+    sharded_note = None
     try:
         reports = lint_flagship(geometry=geometry, targets=("train", "decode"))
+        if mesh_spec:
+            from perceiver_io_tpu.parallel.overlap import mesh_from_spec
+
+            try:
+                mesh = mesh_from_spec(mesh_spec)
+            except ValueError as e:
+                # too few devices: the CLI path (tools/graphlint.py --mesh)
+                # respawns with virtual devices; telemetry records the skip
+                sharded_note = f"skipped: {e}"
+            else:
+                reports["train_sharded"] = lint_flagship(
+                    geometry=geometry, targets=("train",), mesh=mesh
+                )["train"]
     except Exception as e:  # noqa: BLE001 — telemetry must not kill the bench
         return {"status": "error", "error": str(e)}
     status = "passed" if all(r.ok() for r in reports.values()) else "failed"
     return {
         "status": status,
+        **({"sharded": sharded_note} if sharded_note else {}),
         "targets": {
             k: {
                 "errors": r.count("error"),

@@ -198,22 +198,168 @@ def compile_text(lowered) -> str:
 
 
 # collective ops as they appear in optimized HLO (plus their async -start
-# split forms); GSPMD emits these — the jaxpr has no trace of them unless
-# the program used shard_map/pmap explicitly
+# split forms, whose result type is a TUPLE — hence the paren alternative);
+# GSPMD emits these — the jaxpr has no trace of them unless the program used
+# shard_map/pmap explicitly
+COLLECTIVE_KINDS = (
+    "all-gather", "all-reduce", "reduce-scatter", "collective-permute", "all-to-all",
+)
+
 _COLLECTIVE_RE = re.compile(
-    r"=\s*\S+\s+"
+    r"=\s*(?:\([^)]*\)|\S+)\s+"
     r"(all-gather|all-reduce|reduce-scatter|collective-permute|all-to-all)"
     r"(?:-start)?\("
 )
 
 
 def collective_counts(hlo_text: str) -> Dict[str, int]:
-    """Occurrences of each collective op kind in compiled HLO text."""
+    """Occurrences of each collective op kind in compiled HLO text (async
+    ``-start`` forms count once; their ``-done`` halves are not counted)."""
     counts: Dict[str, int] = {}
     for m in _COLLECTIVE_RE.finditer(hlo_text):
         kind = m.group(1)
         counts[kind] = counts.get(kind, 0) + 1
     return counts
+
+
+# ---------------------------------------------------------- HLO text parsing
+
+# bytes per element of the HLO primitive types that appear in these programs
+_HLO_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16,
+}
+
+_SHAPE_RE = re.compile(r"([a-z]\w*)\[([\d,]*)\]")
+_COMP_HEADER_RE = re.compile(r"^(?:ENTRY\s+)?%?([\w.\-]+)\s*\(.*\)\s*->")
+_INSTR_RE = re.compile(r"^\s+(?:ROOT\s+)?%([\w.\-]+)\s*=\s*(.*)$")
+_OPCODE_RE = re.compile(r"([\w\-]+)\(")
+_OP_NAME_RE = re.compile(r'op_name="([^"]*)"')
+
+
+@dataclasses.dataclass(frozen=True)
+class HloInstr:
+    """One instruction of a compiled-HLO computation, as parsed from text."""
+
+    name: str
+    opcode: str
+    operands: Tuple[str, ...]  # operand instruction names (same computation)
+    scope: str  # named_scope-ish path recovered from metadata op_name
+    line: str
+
+
+def _shape_bytes(text: str) -> int:
+    """Total bytes of every array shape literal in ``text`` (an estimate:
+    result-type tokens like ``f32[128,256]{1,0}``; layout braces ignored)."""
+    total = 0
+    for m in _SHAPE_RE.finditer(text):
+        dtype, dims = m.group(1), m.group(2)
+        itemsize = _HLO_DTYPE_BYTES.get(dtype)
+        if itemsize is None:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * itemsize
+    return total
+
+
+def _scope_from_op_name(line: str) -> str:
+    """Recover a named_scope-ish path from an instruction's metadata
+    ``op_name`` — transform wrappers (``jit(...)``, ``transpose(...)``, ...)
+    are dropped and the final primitive segment trimmed, leaving the
+    ``jax.named_scope`` path the op was traced under ('' when none)."""
+    m = _OP_NAME_RE.search(line)
+    if not m:
+        return ""
+    segments = [
+        s for s in m.group(1).split("/")
+        if s and not re.fullmatch(r"\w+\(.*\)", s)
+    ]
+    if segments:
+        segments = segments[:-1]  # the last segment is the primitive itself
+    return "/".join(segments)
+
+
+def parse_hlo_computations(hlo_text: str) -> Dict[str, List[HloInstr]]:
+    """Split compiled HLO text into computations of :class:`HloInstr`, in
+    scheduled (textual) order, with operand edges resolved within each
+    computation. Robust to tuple result types (async ``-start`` ops) and to
+    attribute noise after the operand list."""
+    comps: Dict[str, List[HloInstr]] = {}
+    names_in_comp: set = set()
+    cur: Optional[str] = None
+    for raw in hlo_text.splitlines():
+        header = _COMP_HEADER_RE.match(raw)
+        if header and raw.rstrip().endswith("{"):
+            cur = header.group(1)
+            comps[cur] = []
+            names_in_comp = set()
+            continue
+        if cur is None:
+            continue
+        m = _INSTR_RE.match(raw)
+        if not m:
+            continue
+        name, rest = m.group(1), m.group(2)
+        # strip the result type: a parenthesized tuple or one token
+        if rest.startswith("("):
+            depth = 0
+            for j, ch in enumerate(rest):
+                if ch == "(":
+                    depth += 1
+                elif ch == ")":
+                    depth -= 1
+                    if depth == 0:
+                        break
+            body = rest[j + 1 :].lstrip()
+        else:
+            parts = rest.split(None, 1)
+            body = parts[1] if len(parts) > 1 else parts[0]
+        om = _OPCODE_RE.match(body)
+        if not om:
+            continue
+        # operand list: up to the matching close paren of the opcode's paren
+        seg = body[om.end():]
+        depth, j = 1, 0
+        while j < len(seg) and depth:
+            if seg[j] == "(":
+                depth += 1
+            elif seg[j] == ")":
+                depth -= 1
+            j += 1
+        operands = tuple(
+            op for op in re.findall(r"%([\w.\-]+)", seg[:j]) if op in names_in_comp
+        )
+        comps[cur].append(
+            HloInstr(name, om.group(1), operands, _scope_from_op_name(raw), raw.strip())
+        )
+        names_in_comp.add(name)
+    return comps
+
+
+def collective_stats(hlo_text: str) -> Dict[str, Dict[str, int]]:
+    """Per-kind collective ``{count, bytes}`` over a compiled module — the
+    ``telemetry.collectives`` block bench results and the multichip dryrun
+    record. ``bytes`` is an *estimate* from the result-type shape literals of
+    each collective instruction (async ``-start`` tuples include the operand
+    alias, so async modules over-count roughly 2x — comparable run-over-run,
+    not an exact traffic meter)."""
+    stats: Dict[str, Dict[str, int]] = {}
+    for instrs in parse_hlo_computations(hlo_text).values():
+        for ins in instrs:
+            for kind in COLLECTIVE_KINDS:
+                if ins.opcode == kind or ins.opcode == kind + "-start":
+                    s = stats.setdefault(kind, {"count": 0, "bytes": 0})
+                    s["count"] += 1
+                    # result type sits between "= " and the opcode
+                    head = ins.line.split(ins.opcode + "(", 1)[0]
+                    s["bytes"] += _shape_bytes(head)
+                    break
+    return stats
 
 
 def count_output_aliases(hlo_text: str) -> int:

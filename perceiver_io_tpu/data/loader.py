@@ -89,11 +89,15 @@ class PrefetchIterator:
     """Overlap host-side batch production with device compute.
 
     A daemon producer thread pulls from the wrapped iterator into a small
-    queue while the train step runs — the device-side transfer is already
-    asynchronous under JAX, but the HOST work (dataset indexing, collation,
-    masking) otherwise serializes with every step; the reference gets the
-    same overlap from torch DataLoader worker processes (SURVEY §3.1
-    process boundary #2). The producer runs while the consumer blocks in
+    queue while the train step runs — the HOST work (dataset indexing,
+    collation, masking) otherwise serializes with every step; the reference
+    gets the same overlap from torch DataLoader worker processes (SURVEY
+    §3.1 process boundary #2). The remaining host->device transfer is
+    overlapped one layer up: ``Trainer.fit`` double-buffers device input
+    (``TrainerConfig.input_double_buffer``), issuing ``jax.device_put`` of
+    the NEXT batch onto its batch sharding right after dispatching the
+    current step, and reports the residual blocked time as the per-window
+    ``input_wait_ms`` log field. The producer runs while the consumer blocks in
     device syncs (which release the GIL). A producer exception re-raises in
     the consumer once, in order; after exhaustion (or a delivered error)
     the iterator keeps raising StopIteration per the iterator protocol.

@@ -13,6 +13,14 @@ from perceiver_io_tpu.parallel.mesh import (
     replicated,
     shard_batch,
 )
+from perceiver_io_tpu.parallel.overlap import (
+    OverlapConfig,
+    expected_collectives,
+    make_overlap_train_step,
+    mesh_from_spec,
+    parse_mesh_spec,
+    required_devices,
+)
 from perceiver_io_tpu.parallel.ring_attention import (
     make_ring_cross_attention,
     make_ring_self_attention,
@@ -36,4 +44,10 @@ __all__ = [
     "make_ring_self_attention",
     "ring_self_attention",
     "seq_sharded_cross_attention",
+    "OverlapConfig",
+    "expected_collectives",
+    "make_overlap_train_step",
+    "mesh_from_spec",
+    "parse_mesh_spec",
+    "required_devices",
 ]

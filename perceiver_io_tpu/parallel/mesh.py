@@ -78,11 +78,26 @@ def batch_sharding(mesh: Mesh, ndim: int = 2, seq_dim: Optional[int] = None) -> 
 
 def shard_batch(batch, mesh: Mesh, seq_dim: Optional[int] = None):
     """Device-put a host batch pytree with leading-dim (and optionally
-    sequence-dim) sharding."""
-    return jax.tree.map(
-        lambda x: jax.device_put(x, batch_sharding(mesh, ndim=np.ndim(x), seq_dim=seq_dim)),
-        batch,
-    )
+    sequence-dim) sharding.
+
+    The leading (batch) dim of every array leaf must divide the
+    ``data x fsdp`` submesh — checked here with the offending leaf path,
+    because the same mistake surfaced deep inside pjit as an opaque
+    "sharding ... is not divisible" error otherwise."""
+    n_batch_shards = mesh.shape[AXIS_DATA] * mesh.shape[AXIS_FSDP]
+
+    def put(path, x):
+        shape = np.shape(x)
+        if len(shape) >= 1 and shape[0] % n_batch_shards != 0:
+            raise ValueError(
+                f"batch leaf {jax.tree_util.keystr(path) or '<root>'}: leading dim "
+                f"{shape[0]} is not divisible by the data x fsdp submesh "
+                f"({mesh.shape[AXIS_DATA]} x {mesh.shape[AXIS_FSDP]} = "
+                f"{n_batch_shards} shards) — pad or resize the batch"
+            )
+        return jax.device_put(x, batch_sharding(mesh, ndim=len(shape), seq_dim=seq_dim))
+
+    return jax.tree_util.tree_map_with_path(put, batch)
 
 
 def _fsdp_dim(shape, fsdp_size: int, min_weight_size: int, exclude=()) -> Optional[int]:

@@ -18,11 +18,25 @@ from perceiver_io_tpu.training.state import TrainState
 
 
 def make_train_step(
-    loss_fn: Callable, donate: bool = True, jit: bool = True, microbatch: int = 1
+    loss_fn: Callable,
+    donate: bool = True,
+    jit: bool = True,
+    microbatch: int = 1,
+    overlap=None,
 ) -> Callable:
     """``train_step(state, batch) -> (state, metrics)``, jitted.
 
     ``loss_fn(params, batch, rng) -> (loss, metrics)``.
+
+    ``overlap``: a ``parallel.overlap.OverlapConfig`` (or a bare ``Mesh``)
+    switches to the explicit shard_map distributed step — chunk-interleaved
+    gradient reduce-scatter + bucket-chained FSDP all-gather prefetch
+    (``parallel/overlap.py``) — instead of leaving the collectives to GSPMD.
+    Same loss contract and the same uniform-weighting precondition; the
+    state must be placed by ``shard_train_state`` (matching
+    ``min_weight_size``) and every batch by ``shard_batch``. Default
+    ``None`` keeps the GSPMD path (the overlap step is feature-gated off
+    until its TPU A/B lands — docs/performance.md round 7).
 
     ``jit=False`` returns the raw step function — for callers embedding the
     step in a larger jitted computation (e.g. a multi-step ``lax.scan``),
@@ -51,6 +65,17 @@ def make_train_step(
     roofline over the full batch. Unlike ``optax.MultiSteps`` gradient
     accumulation (optim.py), this changes no optimizer-visible step count.
     """
+
+    if overlap is not None:
+        from jax.sharding import Mesh as _Mesh
+
+        from perceiver_io_tpu.parallel.overlap import OverlapConfig, make_overlap_train_step
+
+        if isinstance(overlap, _Mesh):
+            overlap = OverlapConfig(mesh=overlap)
+        return make_overlap_train_step(
+            loss_fn, overlap, microbatch=microbatch, donate=donate, jit=jit
+        )
 
     if microbatch > 1 and getattr(loss_fn, "uniform_weighting", None) is False:
         raise ValueError(

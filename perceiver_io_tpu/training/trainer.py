@@ -56,6 +56,22 @@ class TrainerConfig:
     # host-side batch production overlapped with device compute via a
     # producer thread (data/loader.py PrefetchIterator); 0 disables
     prefetch_batches: int = 2
+    # device-side input double-buffering: after each step is dispatched
+    # (async under JAX), the NEXT batch is device_put onto its batch
+    # sharding while the step runs, so the host->device transfer stops
+    # serializing with compute. Log rows carry ``input_wait_ms`` — host
+    # time BLOCKED waiting for the consumed batch, near zero when the
+    # buffer hits
+    input_double_buffer: bool = True
+    # --- distributed step (parallel/overlap.py) ---------------------------
+    # explicit overlap-scheduled shard_map train step: chunk-interleaved
+    # gradient reduce-scatter + bucket-chained FSDP all-gather prefetch
+    # instead of GSPMD-placed collectives. Requires a data/fsdp mesh.
+    # Default OFF until the TPU A/B lands (measure-before-shipping;
+    # docs/performance.md round 7, docs/parallelism.md overlap section)
+    overlap: bool = False
+    overlap_bucket_mb: float = 4.0
+    overlap_prefetch: bool = True
     # --- telemetry (obs/) -------------------------------------------------
     # structured events.jsonl + run_manifest.json next to metrics.csv
     # (written only when a logger is attached)
@@ -117,11 +133,28 @@ class Trainer:
         self.recompiles = RecompileTracker()
         self._events: Optional[EventLog] = None
         self._manifest_written = False
-        self._train_step = self.recompiles.wrap(make_train_step(loss_fn), "train_step")
+        overlap_cfg = None
+        if self.config.overlap:
+            if mesh is None:
+                raise ValueError("TrainerConfig.overlap requires a mesh (data/fsdp axes)")
+            from perceiver_io_tpu.parallel.overlap import OverlapConfig
+
+            overlap_cfg = OverlapConfig(
+                mesh=mesh,
+                bucket_bytes=int(self.config.overlap_bucket_mb * (1 << 20)),
+                prefetch=self.config.overlap_prefetch,
+                # must match fit()'s shard_train_state placement
+                min_weight_size=self.config.fsdp_min_weight_size,
+            )
+        self._train_step = self.recompiles.wrap(
+            make_train_step(loss_fn, overlap=overlap_cfg), "train_step"
+        )
         # the raw (unjitted) step for the graphlint trace: linting through
         # the recompile-tracked jit wrapper would pollute its compile
-        # bookkeeping, and the raw fn traces identically
-        self._lint_step = make_train_step(loss_fn, jit=False)
+        # bookkeeping, and the raw fn traces identically. Built with the
+        # SAME overlap config so the linted graph is the trained program
+        # (the jaxpr walker descends into the shard_map body)
+        self._lint_step = make_train_step(loss_fn, jit=False, overlap=overlap_cfg)
         eval_fn = eval_loss_fn
         if eval_fn is None:
             # dropout must be off during validation (Lightning model.eval()
@@ -322,19 +355,49 @@ class Trainer:
                 train_iter = prefetch = PrefetchIterator(train_iter, depth=cfg.prefetch_batches)
             window: list = []
             window_samples = 0
+            pending_batch = None
+            pending_exc = None
+            input_wait_s = 0.0
             # perf_counter, matching GoodputTracker's clock: the goodput
             # subtraction must not mix monotonic and wall (NTP-steppable) time
             t0 = time.perf_counter()
             window_overhead0 = goodput.overhead()
             lint_pending = events is not None and cfg.graphlint
             try:
-                for _ in range(start_step, cfg.max_steps):
-                    batch = self._prepare_batch(next(train_iter))
+                for i in range(start_step, cfg.max_steps):
+                    # input_wait: host time BLOCKED obtaining the batch this
+                    # step consumes — the double buffer below drives it to ~0
+                    t_in = time.perf_counter()
+                    if pending_exc is not None:
+                        # a deferred prefetch failure surfaces HERE, where the
+                        # pre-double-buffer loop would have hit it — after the
+                        # previous step's log/eval/checkpoint ran
+                        exc, pending_exc = pending_exc, None
+                        raise exc
+                    if pending_batch is not None:
+                        batch, pending_batch = pending_batch, None
+                    else:
+                        batch = self._prepare_batch(next(train_iter))
+                    input_wait_s += time.perf_counter() - t_in
                     if lint_pending:
                         lint_pending = False
                         with goodput.measure("graphlint"):
                             self._graphlint(events, state, batch)
                     state, metrics = self._train_step(state, batch)
+                    if cfg.input_double_buffer and i + 1 < cfg.max_steps:
+                        # the step above is dispatched asynchronously: issue
+                        # the NEXT batch's device_put now so the host->device
+                        # transfer rides under the running step. ANY iterator
+                        # failure (exhaustion or a pipeline error) is deferred
+                        # to the next iteration's blocking fetch so the
+                        # just-completed step still gets its log/eval/
+                        # checkpoint, exactly like the pre-buffer loop
+                        try:
+                            pending_batch = self._prepare_batch(next(train_iter))
+                        except StopIteration:
+                            pending_batch = None
+                        except Exception as e:  # noqa: BLE001 — re-raised next iteration
+                            pending_batch, pending_exc = None, e
                     window.append(metrics)
                     window_samples += _leading_dim(batch)
                     step = int(state.step)
@@ -358,6 +421,10 @@ class Trainer:
                             avg["model_flops_per_sec"] = flops_per_sec
                             if peak:
                                 avg["mfu"] = flops_per_sec / (peak * n_dev)
+                        # per-window input wait (ms per step): blocked host
+                        # time fetching batches — the double-buffer win shows
+                        # up here as ~0 rows in events.jsonl
+                        avg["input_wait_ms"] = input_wait_s * 1e3 / len(window)
                         # per-WINDOW goodput (overhead delta since the last log
                         # row), so the column attributes THIS window's dip; the
                         # run-cumulative breakdown comes once, at fit_end
@@ -369,6 +436,7 @@ class Trainer:
                         if events is not None:
                             events.emit("log", step=step, **avg)
                         window, window_samples, t0 = [], 0, time.perf_counter()
+                        input_wait_s = 0.0
                         window_overhead0 = goodput.overhead()
 
                     at_val = cfg.val_interval is not None and step % cfg.val_interval == 0
@@ -393,6 +461,7 @@ class Trainer:
                         for cb in self.callbacks:
                             cb(self, state, step)
             finally:
+                parked = False
                 if prefetch is not None:
                     prefetch.close()
                     # the prefetch pulled items ahead of the step loop — they
@@ -402,6 +471,15 @@ class Trainer:
                         # producer stuck in the source iterator; hold the wrapper
                         # so the next fit can harvest (and refuses to race it)
                         self._pending_prefetch = prefetch
+                    parked = True
+                if pending_batch is not None:
+                    # a double-buffered batch pulled but never consumed (the
+                    # loop raised): it came out of train_iter BEFORE anything
+                    # recovered from the prefetch queue, so it goes in front
+                    self._residual_batches.appendleft(pending_batch)
+                    pending_batch = None
+                    parked = True
+                if parked:
                     try:
                         import weakref
 

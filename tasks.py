@@ -9,11 +9,15 @@ equivalent with the same task names:
     python tasks.py build              # sdist/wheel via pyproject
     python tasks.py docker [--tag TAG]
     python tasks.py bench [...args]    # the driver benchmark (real chip)
+    python tasks.py graphlint [...]    # static-analysis gate (compiled graphs)
+    python tasks.py dryrun [...]       # 8-virtual-device multichip certification
 """
 
 from __future__ import annotations
 
 import argparse
+import os
+import re
 import shutil
 import subprocess
 import sys
@@ -29,9 +33,9 @@ def task(fn):
     return fn
 
 
-def run(*cmd: str) -> None:
+def run(*cmd: str, env: dict | None = None) -> None:
     print("+", " ".join(cmd))
-    subprocess.run(cmd, cwd=ROOT, check=True)
+    subprocess.run(cmd, cwd=ROOT, check=True, env=env)
 
 
 @task
@@ -104,6 +108,26 @@ def docker(args):
 @task
 def bench(args):
     run(sys.executable, "bench.py", *args.rest)
+
+
+@task
+def dryrun(args):
+    """Multichip certification gate: the forced-8-device dryrun (every mesh
+    kind, the ring strategy, the overlap-scheduled step, sharded decode) plus
+    the distributed test suites — which otherwise only run when someone
+    remembers to. Extra args go to pytest (e.g. ``-k overlap``)."""
+    env = dict(os.environ)
+    flags = re.sub(r"--xla_force_host_platform_device_count=\S+", "", env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    # dryrun_multichip provisions its own virtual devices (subprocess respawn)
+    run(sys.executable, "-c", "import __graft_entry__; __graft_entry__.dryrun_multichip(8)")
+    run(
+        sys.executable, "-m", "pytest",
+        "tests/test_overlap.py", "tests/test_distributed.py",
+        "tests/test_seq_parallel_step.py", "tests/test_ring_attention.py",
+        "-q", *args.rest,
+        env=env,
+    )
 
 
 @task

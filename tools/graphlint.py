@@ -10,6 +10,14 @@ this is the CI gate `tasks.py graphlint` wraps:
     python tools/graphlint.py --geometry flagship --no-compiled   # trace-only
     python tools/graphlint.py --kernel-features twoseg            # A/B the lint
     python tools/graphlint.py --json graphlint.json --allow 'hot-concat:*mlp*'
+    python tools/graphlint.py --mesh data=2,fsdp=4 --targets train  # sharded step
+
+``--mesh data=N[,fsdp=M]`` lints the SHARDED flagship train step — by
+default the overlap-scheduled shard_map step (parallel/overlap.py) with the
+``collective-overlap`` rule armed and a collective budget derived from its
+bucket plan; ``--overlap off`` lints the GSPMD step instead. When the host
+has fewer devices than the mesh needs, the CLI re-execs itself with that
+many virtual CPU devices (the __graft_entry__ dryrun trick).
 
 Rule catalog and allowlist syntax: docs/static-analysis.md.
 """
@@ -18,7 +26,43 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import re
 import sys
+
+
+def _ensure_devices(n: int) -> None:
+    """Re-exec with ``n`` virtual CPU devices when fewer are visible.
+
+    Mirrors ``__graft_entry__._respawn_with_virtual_devices``: XLA_FLAGS must
+    be set before backend init and the platform forced via jax.config (the
+    axon plugin presets JAX_PLATFORMS)."""
+    import subprocess
+
+    import jax
+
+    if len(jax.devices()) >= n:
+        return
+    if os.environ.get("_GRAPHLINT_RESPAWNED"):
+        raise RuntimeError(
+            f"already respawned once but still see {len(jax.devices())} devices "
+            f"(< {n}); virtual CPU device provisioning did not take effect"
+        )
+    script = os.path.abspath(__file__)
+    repo = os.path.dirname(os.path.dirname(script))
+    bootstrap = (
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        f"import sys; sys.path.insert(0, {repo!r})\n"
+        f"sys.argv = [{script!r}] + {sys.argv[1:]!r}\n"
+        f"import runpy; runpy.run_path({script!r}, run_name='__main__')\n"
+    )
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["_GRAPHLINT_RESPAWNED"] = "1"
+    flags = re.sub(r"--xla_force_host_platform_device_count=\S+", "", env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = (flags + f" --xla_force_host_platform_device_count={n}").strip()
+    raise SystemExit(subprocess.call([sys.executable, "-c", bootstrap], env=env))
 
 
 def main(argv=None) -> int:
@@ -53,7 +97,26 @@ def main(argv=None) -> int:
     p.add_argument("--collective-budget", default=None,
                    help="JSON dict enabling the collective-budget rule, e.g. "
                         "'{\"all-gather\": 2, \"total\": 4}'")
+    p.add_argument("--mesh", default=None, metavar="data=N[,fsdp=M]",
+                   help="shard the train target over this data/fsdp mesh and "
+                        "lint the distributed step (re-execs with virtual CPU "
+                        "devices when the host has too few)")
+    p.add_argument("--overlap", choices=("on", "off"), default="on",
+                   help="with --mesh: lint the overlap-scheduled shard_map "
+                        "step (on, default — arms the collective-overlap rule "
+                        "and a derived collective budget) or the GSPMD step (off)")
     args = p.parse_args(argv)
+
+    mesh = None
+    if args.mesh:
+        from perceiver_io_tpu.parallel.overlap import (
+            mesh_from_spec,
+            parse_mesh_spec,
+            required_devices,
+        )
+
+        _ensure_devices(required_devices(parse_mesh_spec(args.mesh)))
+        mesh = mesh_from_spec(args.mesh)
 
     from perceiver_io_tpu.analysis.flagship import lint_flagship
 
@@ -74,6 +137,8 @@ def main(argv=None) -> int:
         compiled=args.compiled,
         collective_budget=budget,
         features=features,
+        mesh=mesh,
+        overlap=args.overlap == "on",
     )
 
     for report in reports.values():
