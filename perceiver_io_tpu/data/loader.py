@@ -46,7 +46,18 @@ class Batches:
         drop_last: bool = True,
         seed: int = 0,
         shard_for_processes: bool = False,
+        retry=None,
+        on_retry: Optional[Callable] = None,
     ):
+        """``retry``: a ``training.faults.RetryPolicy`` adds bounded
+        exponential-backoff retries (with jitter) around each per-example
+        dataset fetch — for datasets backed by flaky remote/blob storage,
+        where a transient ``OSError`` must cost milliseconds of
+        ``input_wait_ms`` (it happens in the prefetch producer thread under
+        the Trainer), not the run. Non-transient exception types still
+        propagate immediately; exhausted retries raise
+        ``FetchRetriesExhausted``. ``on_retry(attempt, exc, delay)``
+        observes every retry."""
         self.dataset = dataset
         self.batch_size = batch_size
         self.shuffle = shuffle
@@ -55,6 +66,8 @@ class Batches:
         self.seed = seed
         self.epoch = 0
         self.shard_for_processes = shard_for_processes
+        self.retry = retry
+        self.on_retry = on_retry
 
     def __len__(self):
         n = len(self._indices())
@@ -65,6 +78,15 @@ class Batches:
             return shard_indices_for_process(len(self.dataset))
         return np.arange(len(self.dataset))
 
+    def _fetch(self, i: int):
+        if self.retry is None:
+            return self.dataset[i]
+        from perceiver_io_tpu.training.faults import call_with_retry
+
+        return call_with_retry(
+            lambda: self.dataset[i], self.retry, on_retry=self.on_retry
+        )
+
     def __iter__(self):
         indices = self._indices()
         if self.shuffle:
@@ -73,7 +95,7 @@ class Batches:
         self.epoch += 1
         end = len(indices) - self.batch_size + 1 if self.drop_last else len(indices)
         for start in range(0, max(end, 0), self.batch_size):
-            batch = [self.dataset[int(i)] for i in indices[start : start + self.batch_size]]
+            batch = [self._fetch(int(i)) for i in indices[start : start + self.batch_size]]
             yield self.collate(batch)
 
 

@@ -4,9 +4,13 @@
 JSON object per line, every line carrying ``ts`` (epoch seconds) and
 ``event`` (the kind). The trainer emits ``fit_start`` / ``log`` /
 ``compile`` / ``eval`` / ``generate`` / ``graphlint`` (the static-analysis
-verdict on the train step's traced graph — analysis/, one event per fit)
-/ ``fit_end`` events through one :class:`EventLog`;
-``tools/obs_report.py`` renders a run directory back into a summary table.
+verdict on the train step's traced graph — analysis/, one event per fit) /
+``resume`` and the ``fault.*`` family (``fault.preempt`` / ``fault.skip`` /
+``fault.spike`` / ``fault.rollback`` / ``fault.halt`` /
+``fault.poison_batch`` / ``fault.fetch_retry`` — the fault-handling audit
+trail, training/faults.py, docs/robustness.md) / ``fit_end`` events through
+one :class:`EventLog`; ``tools/obs_report.py`` renders a run directory back
+into a summary table.
 
 ``run_manifest.json`` pins what the run actually ran on: mesh shape,
 device kind/count, jax version, and a stable hash of the model/trainer

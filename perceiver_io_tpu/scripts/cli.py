@@ -132,7 +132,10 @@ class TrainerArgs:
     checkpoint: bool = True
     max_checkpoints: int = 1
     save_weights_only: bool = True
-    resume: bool = False
+    # false | true (restore latest, legacy) | auto (preemption-safe
+    # auto-resume: restore latest VALID checkpoint + fast-forward the data
+    # stream + truncate metrics past the restore point — docs/robustness.md)
+    resume: str = "false"
 
 
 @dataclass
@@ -430,8 +433,13 @@ def run_training(
             metrics = trainer.validate(state, val_loader or [])
             logger.log(int(state.step), metrics)
             return state, metrics
+        resume = trainer_args.resume
+        if isinstance(resume, str):
+            # tri-state flag: bool-ish strings coerce, "auto" (any case)
+            # normalizes to the exact token Trainer.fit dispatches on
+            resume = "auto" if resume.lower() == "auto" else _str2bool(resume)
         state = trainer.fit(
-            state, train_iter, val_loader, model_config=model_config, resume=trainer_args.resume
+            state, train_iter, val_loader, model_config=model_config, resume=resume
         )
         return state, None
     finally:

@@ -21,6 +21,17 @@ from perceiver_io_tpu.training.checkpoint import (
     save_config,
     save_pretrained,
 )
+from perceiver_io_tpu.training.faults import (
+    DivergenceHalt,
+    DivergenceSentinel,
+    FetchRetriesExhausted,
+    PreemptionGuard,
+    QuarantineIterator,
+    RetryPolicy,
+    SentinelConfig,
+    call_with_retry,
+    fetch_retry_emitter,
+)
 from perceiver_io_tpu.training.metrics import MetricsLogger
 from perceiver_io_tpu.training.prefix_dropout import (
     prefix_keep_count,
@@ -48,6 +59,15 @@ __all__ = [
     "save_config",
     "save_pretrained",
     "MetricsLogger",
+    "DivergenceHalt",
+    "DivergenceSentinel",
+    "FetchRetriesExhausted",
+    "PreemptionGuard",
+    "QuarantineIterator",
+    "RetryPolicy",
+    "SentinelConfig",
+    "call_with_retry",
+    "fetch_retry_emitter",
     "prefix_keep_count",
     "sample_prefix_keep_idx",
     "with_prefix_keep_idx",

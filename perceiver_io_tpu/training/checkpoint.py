@@ -167,9 +167,10 @@ def _load_orbax_pretrained(directory: str, template_params=None):
             )
     # prefer the best retained step by the standard monitor (the reference's
     # ModelCheckpoint monitors val_loss); fall back to the latest when no
-    # per-step metrics were recorded
+    # per-step metrics were recorded. NaN/missing metrics sanitize to worst
+    # so a diverged-val checkpoint can never win the comparison.
     options = ocp.CheckpointManagerOptions(
-        best_fn=lambda metrics: metrics.get("val_loss", float("inf")), best_mode="min"
+        best_fn=lambda metrics: _monitor_value(metrics, "val_loss", "min"), best_mode="min"
     )
     mngr = ocp.CheckpointManager(root, options=options)
     try:
@@ -240,8 +241,83 @@ def _state_payload(state, save_weights_only: bool) -> dict:
     return payload
 
 
+# -- atomic-save hygiene (docs/robustness.md) -------------------------------
+#
+# orbax commits a step by writing into a tmp-suffixed directory and renaming
+# it into place, but (this version, local fs) its *read* side is not torn-
+# proof: ``latest_step`` happily returns a digit directory whose contents
+# were half-deleted or half-copied (e.g. a host killed mid-rsync of a
+# restored run dir), and ``restore`` then dies instead of falling back.
+# Three guards close that:
+#   1. a startup sweep quarantines leftover tmp dirs and non-finalized step
+#      dirs (missing orbax's ``_CHECKPOINT_METADATA`` commit marker) into
+#      ``_quarantine/`` — a non-digit name orbax ignores forever,
+#   2. a post-commit integrity record (``integrity.json``: file count +
+#      total bytes + the save-time metrics per step) written atomically
+#      (tmp + ``os.replace``) lets ``restore`` detect a step dir that is
+#      finalized-but-mutilated, quarantine it, and fall back to the next
+#      valid step,
+#   3. ``best_step`` is computed from the recorded metrics with NaN/missing
+#      monitor values excluded — a diverged-val checkpoint is never "best".
+
+QUARANTINE_DIR = "_quarantine"
+INTEGRITY_FILE = "integrity.json"
+COMMIT_MARKER = "_CHECKPOINT_METADATA"  # orbax's per-step commit metadata file
+
+
+def _monitor_value(metrics: Optional[dict], monitor: str, mode: str) -> float:
+    """Sanitized monitor value for best-step comparison: NaN or missing
+    becomes the WORST possible value for ``mode``, so it never wins."""
+    worst = float("inf") if mode == "min" else float("-inf")
+    if not metrics:
+        return worst
+    try:
+        v = float(metrics.get(monitor, worst))
+    except (TypeError, ValueError):
+        return worst
+    return v if v == v else worst  # NaN != NaN
+
+
+def _dir_stats(path: str) -> dict:
+    """File count + total byte size under ``path`` — the integrity signature
+    a torn step dir fails (missing payload files / truncated shards)."""
+    n_files = 0
+    n_bytes = 0
+    for root, _dirs, files in os.walk(path):
+        for name in files:
+            try:
+                n_bytes += os.path.getsize(os.path.join(root, name))
+                n_files += 1
+            except OSError:
+                continue
+    return {"files": n_files, "bytes": n_bytes}
+
+
+def _is_tmp_checkpoint(path: str) -> bool:
+    name = os.path.basename(path)
+    if ".orbax-checkpoint-tmp" in name:
+        return True
+    try:
+        return bool(ocp.utils.is_tmp_checkpoint(path))
+    except Exception:
+        return False
+
+
+def _quarantine_path(directory: str, name: str) -> str:
+    qdir = os.path.join(directory, QUARANTINE_DIR)
+    os.makedirs(qdir, exist_ok=True)
+    k = 0
+    while True:
+        target = os.path.join(qdir, name if k == 0 else f"{name}.{k}")
+        if not os.path.exists(target):
+            return target
+        k += 1
+
+
 class CheckpointManager:
-    """Best-k training checkpoints monitored on a metric.
+    """Best-k training checkpoints monitored on a metric, with torn-save
+    protection (sweep / integrity records / valid-step fallback — see the
+    atomic-save hygiene block above and docs/robustness.md).
 
     Reference semantics: ModelCheckpoint(monitor=val_loss, mode=min,
     save_weights_only) (reference: perceiver/scripts/trainer.yaml:7-12), plus
@@ -251,8 +327,8 @@ class CheckpointManager:
     def __init__(
         self,
         directory: str,
-        max_to_keep: int = 1,
-        monitor: str = "val_loss",
+        max_to_keep: Optional[int] = 1,
+        monitor: Optional[str] = "val_loss",
         mode: str = "min",
         save_weights_only: bool = False,
         enable_async: bool = False,
@@ -262,63 +338,276 @@ class CheckpointManager:
         this on): ``save`` returns once the on-device state is snapshotted
         and the write proceeds in the background. Every read-side method
         (``latest_step``/``best_step``/``restore``) and ``close`` first
-        ``wait_until_finished``, so save-then-restore stays correct."""
+        ``wait_until_finished``, so save-then-restore stays correct.
+
+        ``max_to_keep=None`` retains every step (the Trainer's preemption
+        saves use this so a final save never evicts the best-val step)."""
+        from perceiver_io_tpu.parallel.dist import is_main_process
+
         self.directory = os.path.abspath(directory)
         self.monitor = monitor
+        self.mode = mode
         self.save_weights_only = save_weights_only
         self.enable_async = enable_async
         self._config_written = False
+        self._main_process = is_main_process()
+        self._pending_integrity: dict = {}
+        # startup sweep BEFORE the orbax manager scans the directory, so a
+        # torn step never even enters its checkpoint-info cache
+        self.quarantined: list = self._sweep() if self._main_process else []
+        self._integrity = self._read_integrity()
         options = ocp.CheckpointManagerOptions(
             max_to_keep=max_to_keep,
-            best_fn=(lambda metrics: metrics[monitor]) if monitor else None,
+            # NaN-sanitized: orbax also uses best_fn for best-k RETENTION —
+            # an unsanitized fn would evict good steps in favor of NaN ones
+            best_fn=(lambda metrics: _monitor_value(metrics, monitor, mode)) if monitor else None,
             best_mode=mode,
             create=True,
             enable_async_checkpointing=enable_async,
         )
         self._mngr = ocp.CheckpointManager(self.directory, options=options)
 
-    def save(self, state, metrics: Optional[dict] = None, config=None) -> bool:
+    # -- integrity bookkeeping -------------------------------------------
+
+    def _integrity_path(self) -> str:
+        return os.path.join(self.directory, INTEGRITY_FILE)
+
+    def _read_integrity(self) -> dict:
+        try:
+            with open(self._integrity_path()) as f:
+                data = json.load(f)
+            return dict(data.get("steps", {}))
+        except (OSError, ValueError):
+            return {}
+
+    def _write_integrity(self) -> None:
+        if not self._main_process:
+            return
+        tmp = self._integrity_path() + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump({"steps": self._integrity}, f, indent=1, default=str)
+            os.replace(tmp, self._integrity_path())  # atomic on POSIX
+        except OSError as e:
+            import warnings
+
+            warnings.warn(f"checkpoint integrity record not written: {e}")
+
+    def _flush_integrity(self) -> None:
+        """Record integrity signatures for saves that have committed. Runs
+        after every ``wait_until_finished`` — for async saves the record
+        lands at the first barrier after commit (a crash in between leaves
+        a committed-but-unrecorded step, which validation accepts on the
+        orbax commit marker alone)."""
+        if not self._pending_integrity:
+            return
+        done = []
+        for step, metrics in self._pending_integrity.items():
+            path = self._step_path(step)
+            if not os.path.exists(os.path.join(path, COMMIT_MARKER)):
+                continue  # save was skipped (should_save) or still in flight
+            self._integrity[str(step)] = {**_dir_stats(path), "metrics": metrics}
+            done.append(step)
+        for step in done:
+            self._pending_integrity.pop(step, None)
+        if done:
+            self._write_integrity()
+
+    # -- torn-checkpoint detection / quarantine ---------------------------
+
+    def _step_path(self, step: int) -> str:
+        return os.path.join(self.directory, str(int(step)))
+
+    def _sweep(self) -> list:
+        """Quarantine leftover orbax tmp dirs and non-finalized step dirs
+        (present but missing the commit marker: a save torn mid-rename or a
+        step dir half-copied onto shared storage). Returns quarantined
+        names."""
+        moved = []
+        if not os.path.isdir(self.directory):
+            return moved
+        for name in sorted(os.listdir(self.directory)):
+            path = os.path.join(self.directory, name)
+            if name == QUARANTINE_DIR or not os.path.isdir(path):
+                continue
+            torn = _is_tmp_checkpoint(path) or (
+                name.isdigit() and not os.path.exists(os.path.join(path, COMMIT_MARKER))
+            )
+            if torn:
+                self._quarantine(path)
+                moved.append(name)
+        return moved
+
+    def _quarantine(self, path: str) -> None:
+        import shutil
+        import warnings
+
+        target = _quarantine_path(self.directory, os.path.basename(path))
+        shutil.move(path, target)
+        warnings.warn(
+            f"quarantined checkpoint dir {os.path.basename(path)!r} -> {target} "
+            "(torn save — tmp leftover, missing commit marker, integrity "
+            "mismatch — or a weights-only commit superseded by a forced "
+            "full-state save)"
+        )
+
+    def _step_valid(self, step: int) -> bool:
+        """A step is restorable iff its dir carries the orbax commit marker
+        AND (when an integrity record exists) its file count/bytes match the
+        post-commit signature."""
+        path = self._step_path(step)
+        if not os.path.exists(os.path.join(path, COMMIT_MARKER)):
+            return False
+        rec = self._integrity.get(str(int(step)))
+        if rec is None:
+            return True  # legacy/unrecorded: the commit marker is all we have
+        stats = _dir_stats(path)
+        return stats["files"] == rec.get("files") and stats["bytes"] == rec.get("bytes")
+
+    def _payload_has_opt_state(self, step: int) -> bool:
+        """Whether a committed step's payload tree carries optimizer state
+        (orbax StandardSave records the tree structure in the item's
+        ``_METADATA``). Unreadable/absent metadata reads as False — for a
+        forced full-state save, replacing an ambiguous commit with a known
+        full payload is the safe direction."""
+        meta = os.path.join(self._step_path(step), "default", "_METADATA")
+        try:
+            with open(meta) as f:
+                return '"opt_state"' in f.read()
+        except OSError:
+            return False
+
+    def _quarantine_step(self, step: int) -> None:
+        if self._main_process:
+            self._quarantine(self._step_path(step))
+        self._integrity.pop(str(int(step)), None)
+        self._write_integrity()
+        self._mngr.reload()  # drop it from the orbax checkpoint-info cache
+
+    def valid_steps(self) -> list:
+        """Committed, integrity-clean steps (ascending). Invalid steps found
+        here are quarantined so no later read can select them."""
+        self.wait_until_finished()
+        steps = []
+        for step in sorted(self._mngr.all_steps()):
+            if self._step_valid(step):
+                steps.append(int(step))
+            else:
+                self._quarantine_step(step)
+        return steps
+
+    # -- save / read API ---------------------------------------------------
+
+    def save(self, state, metrics: Optional[dict] = None, config=None, force: bool = False) -> bool:
+        """``force=True`` bypasses the monitored-metric requirement (the
+        Trainer's preemption save: there is no fresh val metric at an
+        arbitrary step boundary, and the save must happen anyway)."""
         metrics = {k: float(v) for k, v in (metrics or {}).items()}
-        if self.monitor and self.monitor not in metrics:
+        if self.monitor and self.monitor not in metrics and not force:
             raise ValueError(f"metrics must contain monitored key {self.monitor!r}")
+        if force and os.path.exists(os.path.join(self._step_path(int(state.step)), COMMIT_MARKER)):
+            # a forced (preemption) save colliding with an already-committed
+            # step — e.g. preempted right after a val-interval save. Skip
+            # only when the existing commit is at least as complete as this
+            # payload: a weights-only commit must NOT swallow a full-state
+            # preemption save (exact resume needs the optimizer), so the
+            # thinner commit is quarantined and replaced (its monitored
+            # metric goes with it — exact resume wins)
+            if self.save_weights_only or self._payload_has_opt_state(int(state.step)):
+                return False
+            self._quarantine_step(int(state.step))
         payload = _state_payload(state, self.save_weights_only)
         saved = self._mngr.save(
-            int(state.step), metrics=metrics, args=ocp.args.StandardSave(payload)
+            int(state.step), metrics=metrics, args=ocp.args.StandardSave(payload), force=force
         )
+        if saved:
+            self._pending_integrity[int(state.step)] = metrics
         if not self.enable_async:
             self._mngr.wait_until_finished()
+            self._flush_integrity()
         if config is not None and not self._config_written:
             # config.json must never exist without a committed checkpoint
             # (warm-start tooling reads config then restores): wait for the
             # first save to commit before the one-time config write — the
             # config is static per run, so later async saves skip this
-            self._mngr.wait_until_finished()
+            self.wait_until_finished()
             save_config(self.directory, config)
             self._config_written = True
         return saved
 
     def wait_until_finished(self) -> None:
-        """Block until any in-flight async save has committed."""
+        """Block until any in-flight async save has committed (and record
+        its integrity signature)."""
         self._mngr.wait_until_finished()
+        self._flush_integrity()
 
     def latest_step(self) -> Optional[int]:
-        self._mngr.wait_until_finished()
-        return self._mngr.latest_step()
+        steps = self.valid_steps()
+        return steps[-1] if steps else None
 
     def best_step(self) -> Optional[int]:
-        self._mngr.wait_until_finished()
-        return self._mngr.best_step()
+        """Best valid step by the monitored metric; NaN/missing-metric steps
+        NEVER win. Steps without a recorded metric (legacy dirs, ``force``
+        saves) are excluded; returns None when nothing has a finite metric
+        (callers fall back to ``latest_step``)."""
+        if not self.monitor:
+            return None
+        candidates = []
+        for step in self.valid_steps():
+            rec = self._integrity.get(str(step))
+            metrics = rec.get("metrics") if rec else self._orbax_metrics(step)
+            v = _monitor_value(metrics, self.monitor, self.mode)
+            if v == v and abs(v) != float("inf"):
+                candidates.append((v, step))
+        if not candidates:
+            return None
+        pick = min(candidates) if self.mode == "min" else max(candidates)
+        return pick[1]
+
+    def _orbax_metrics(self, step: int) -> Optional[dict]:
+        """Save-time metrics for steps that predate integrity records, read
+        from the orbax checkpoint-info cache (no public accessor in this
+        version — best-effort)."""
+        for info in getattr(self._mngr, "_checkpoints", []) or []:
+            if getattr(info, "step", None) == step:
+                m = getattr(info, "metrics", None)
+                return dict(m) if m else None
+        return None
 
     def restore(self, state, step: Optional[int] = None):
         """Restore into (a copy of) ``state``; returns the updated state.
-        ``step=None`` restores the latest checkpoint. Restores whatever the
-        checkpoint actually contains: resuming from a weights-only checkpoint
-        restores params/step/rng and leaves the optimizer state fresh
-        (Lightning ``save_weights_only`` resume semantics)."""
-        self._mngr.wait_until_finished()
-        step = self._mngr.latest_step() if step is None else step
-        if step is None:
+        ``step=None`` restores the latest VALID checkpoint — a torn step dir
+        discovered mid-restore is quarantined and the next-newest valid step
+        is tried, so auto-resume never dies on (or silently loads) a partial
+        write. Restores whatever the checkpoint actually contains: resuming
+        from a weights-only checkpoint restores params/step/rng and leaves
+        the optimizer state fresh (Lightning ``save_weights_only`` resume
+        semantics)."""
+        self.wait_until_finished()
+        if step is not None:
+            if not self._step_valid(step):
+                raise FileNotFoundError(
+                    f"checkpoint step {step} under {self.directory} is missing or torn"
+                )
+            return self._restore_step(state, step)
+        candidates = self.valid_steps()
+        if not candidates:
             raise FileNotFoundError(f"no checkpoint found under {self.directory}")
+        last_err: Optional[Exception] = None
+        for step in reversed(candidates):
+            try:
+                return self._restore_step(state, step)
+            except FileNotFoundError as e:
+                # integrity said ok but payload structure is gone (deep tear
+                # the file-count signature missed, e.g. a truncated manifest):
+                # quarantine and fall back to the next-newest valid step
+                last_err = e
+                self._quarantine_step(step)
+        raise FileNotFoundError(
+            f"every checkpoint under {self.directory} failed to restore; last: {last_err}"
+        )
+
+    def _restore_step(self, state, step: int):
         def attempt(weights_only: bool):
             payload = _state_payload(state, weights_only)
             abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, payload)
@@ -341,5 +630,5 @@ class CheckpointManager:
         return load_config(self.directory)
 
     def close(self):
-        self._mngr.wait_until_finished()
+        self.wait_until_finished()
         self._mngr.close()

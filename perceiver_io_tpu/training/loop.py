@@ -11,6 +11,7 @@ from __future__ import annotations
 from typing import Callable
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from perceiver_io_tpu.parallel.mesh import param_shardings
@@ -23,6 +24,7 @@ def make_train_step(
     jit: bool = True,
     microbatch: int = 1,
     overlap=None,
+    sentinel: bool = False,
 ) -> Callable:
     """``train_step(state, batch) -> (state, metrics)``, jitted.
 
@@ -64,9 +66,27 @@ def make_train_step(
     monolithic batch-4 step (-5%) while amortizing the optimizer's HBM
     roofline over the full batch. Unlike ``optax.MultiSteps`` gradient
     accumulation (optim.py), this changes no optimizer-visible step count.
+
+    ``sentinel=True`` compiles the divergence sentinel's in-graph half into
+    the step (training/faults.py, docs/robustness.md): loss + gradient
+    finiteness is reduced inside the SAME XLA program (two cheap
+    ``isfinite`` reductions — no extra host sync) and a non-finite step is
+    SKIPPED: params/opt state hold their previous values, step and rng
+    still advance (the run keeps its batch schedule and cannot spin on a
+    persistent NaN source). Metrics gain ``sentinel_skipped`` (0/1) so the
+    host-side :class:`~perceiver_io_tpu.training.faults.DivergenceSentinel`
+    can walk its policy ladder. Not supported by the overlap-scheduled step
+    (the update runs sharded outside the shard_map region); there detection
+    stays host-side.
     """
 
     if overlap is not None:
+        if sentinel:
+            raise ValueError(
+                "sentinel=True (in-graph skip) is not supported by the overlap-"
+                "scheduled step; use SentinelConfig(in_graph_skip=False) — "
+                "host-side detection with the rollback rung still applies"
+            )
         from jax.sharding import Mesh as _Mesh
 
         from perceiver_io_tpu.parallel.overlap import OverlapConfig, make_overlap_train_step
@@ -89,7 +109,7 @@ def make_train_step(
         rng, step_rng = jax.random.split(state.rng)
         grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
         if microbatch <= 1:
-            (_, metrics), grads = grad_fn(state.params, batch, step_rng)
+            (loss, metrics), grads = grad_fn(state.params, batch, step_rng)
         else:
             if (
                 not uniform_declared
@@ -114,7 +134,25 @@ def make_train_step(
             inv = 1.0 / microbatch
             grads = jax.tree.map(lambda g: g * inv, grads)
             metrics = jax.tree.map(lambda m: m * inv, metrics)
-        state = state.apply_gradients(grads).replace(rng=rng)
+            loss = metrics.get("loss") if isinstance(metrics, dict) else None
+        if not sentinel:
+            return state.apply_gradients(grads).replace(rng=rng), metrics
+        # in-graph divergence sentinel: finiteness reduced inside the same
+        # XLA program, the update SELECTED rather than branched (cond would
+        # force both sides anyway on TPU) — a non-finite step holds
+        # params/opt state and still advances step/rng, so the batch
+        # schedule and any step-indexed LR schedule stay aligned with an
+        # uninterrupted run
+        ok = jnp.isfinite(loss) if loss is not None else jnp.asarray(True)
+        for g in jax.tree.leaves(grads):
+            if jnp.issubdtype(g.dtype, jnp.inexact):
+                ok = ok & jnp.all(jnp.isfinite(g))
+        updated = state.apply_gradients(grads).replace(rng=rng)
+        held = state.replace(step=state.step + 1, rng=rng)
+        state = jax.tree.map(lambda n, o: jnp.where(ok, n, o), updated, held)
+        if isinstance(metrics, dict):
+            metrics = dict(metrics)
+            metrics["sentinel_skipped"] = 1.0 - ok.astype(jnp.float32)
         return state, metrics
 
     if not jit:

@@ -16,6 +16,15 @@ import time
 from typing import Dict
 
 
+def _row_step(row: dict) -> float:
+    """Step value of a CSV row; rows without a parseable step sort as
+    "keep" (-inf) — truncation must never eat foreign rows it can't read."""
+    try:
+        return float(row.get("step", ""))
+    except (TypeError, ValueError):
+        return float("-inf")
+
+
 class MetricsLogger:
     """Appends scalars to ``metrics.csv`` (one row per log call; the header is
     the union of keys seen, and the file is rewritten only on the rare event a
@@ -92,6 +101,29 @@ class MetricsLogger:
             writer = csv.DictWriter(f, fieldnames=self._keys, restval="")
             writer.writeheader()
             writer.writerows(rows)
+
+    def truncate_after(self, step: int) -> int:
+        """Drop rows with ``step`` greater than the given step; returns the
+        number of rows removed.
+
+        Auto-resume hygiene (``Trainer.fit(resume="auto")``): a preempted
+        run may have logged rows past its last committed checkpoint; the
+        resumed run re-executes those steps and re-logs them. Truncating at
+        the restore point keeps ``metrics.csv`` equivalent to an
+        uninterrupted run instead of carrying duplicate (and possibly
+        diverging) rows for the replayed interval."""
+        if not self._active or not os.path.exists(self._csv_path):
+            return 0
+        with open(self._csv_path, newline="") as f:
+            rows = list(csv.DictReader(f))
+        kept = [r for r in rows if _row_step(r) <= step]
+        dropped = len(rows) - len(kept)
+        if dropped:
+            with open(self._csv_path, "w", newline="") as f:
+                writer = csv.DictWriter(f, fieldnames=self._keys, restval="")
+                writer.writeheader()
+                writer.writerows(kept)
+        return dropped
 
     def log_text(self, step: int, tag: str, text: str) -> None:
         if not self._active:

@@ -72,6 +72,22 @@ class TrainerConfig:
     overlap: bool = False
     overlap_bucket_mb: float = 4.0
     overlap_prefetch: bool = True
+    # --- robustness (training/faults.py; docs/robustness.md) --------------
+    # SIGTERM/SIGINT request a final checkpoint at the next step boundary
+    # and a clean return instead of killing the loop mid-save (preemption-
+    # safe exit; the save itself needs checkpoint_dir). Installed per fit,
+    # main thread only.
+    preemption_save: bool = True
+    # divergence sentinel: True (default thresholds) or a SentinelConfig.
+    # In-graph grad/loss finiteness + skip compiles into the train step
+    # (where supported); host-side windowed spike detection walks the
+    # skip -> rollback-to-last-checkpoint -> halt ladder, every trip an
+    # events.jsonl ``fault.*`` event
+    sentinel: "bool | object" = False
+    # drop batches carrying non-finite float leaves before they reach the
+    # step (poison-batch quarantine), emitting ``fault.poison_batch`` with
+    # the offending leaf path
+    quarantine_poison_batches: bool = False
     # --- telemetry (obs/) -------------------------------------------------
     # structured events.jsonl + run_manifest.json next to metrics.csv
     # (written only when a logger is attached)
@@ -146,15 +162,43 @@ class Trainer:
                 # must match fit()'s shard_train_state placement
                 min_weight_size=self.config.fsdp_min_weight_size,
             )
+        # divergence sentinel (training/faults.py): resolve the config once;
+        # the in-graph skip half is compiled into the step below, the
+        # host-side ladder walker is created fresh per fit()
+        self._sentinel_cfg = None
+        if self.config.sentinel:
+            from perceiver_io_tpu.training.faults import SentinelConfig
+
+            self._sentinel_cfg = (
+                self.config.sentinel
+                if isinstance(self.config.sentinel, SentinelConfig)
+                else SentinelConfig()
+            )
+            if overlap_cfg is not None and self._sentinel_cfg.in_graph_skip:
+                # the overlap step's update runs outside the shard_map region;
+                # detection stays host-side there (non-finite losses go
+                # straight to the rollback rung — faults.py)
+                import dataclasses
+
+                self._sentinel_cfg = dataclasses.replace(
+                    self._sentinel_cfg, in_graph_skip=False
+                )
+        in_graph_sentinel = self._sentinel_cfg is not None and self._sentinel_cfg.in_graph_skip
         self._train_step = self.recompiles.wrap(
-            make_train_step(loss_fn, overlap=overlap_cfg), "train_step"
+            make_train_step(loss_fn, overlap=overlap_cfg, sentinel=in_graph_sentinel),
+            "train_step",
         )
         # the raw (unjitted) step for the graphlint trace: linting through
         # the recompile-tracked jit wrapper would pollute its compile
         # bookkeeping, and the raw fn traces identically. Built with the
         # SAME overlap config so the linted graph is the trained program
         # (the jaxpr walker descends into the shard_map body)
-        self._lint_step = make_train_step(loss_fn, jit=False, overlap=overlap_cfg)
+        self._lint_step = make_train_step(
+            loss_fn, jit=False, overlap=overlap_cfg, sentinel=in_graph_sentinel
+        )
+        # the fit-scoped preemption guard, exposed so tests and the chaos
+        # harness can trip it deterministically (tools/chaos.py)
+        self._preempt_guard = None
         eval_fn = eval_loss_fn
         if eval_fn is None:
             # dropout must be off during validation (Lightning model.eval()
@@ -275,15 +319,43 @@ class Trainer:
         train_iter,
         val_loader: Optional[Iterable] = None,
         model_config=None,
-        resume: bool = False,
+        resume: "bool | str" = False,
     ) -> TrainState:
+        """``resume=False`` starts fresh; ``resume=True`` restores the latest
+        checkpoint into ``state`` (legacy: no data-stream alignment);
+        ``resume="auto"`` is the preemption-safe mode — restore the latest
+        VALID checkpoint when one exists (fresh start otherwise), fast-forward
+        the data iterator by the restored step count so the stream realigns,
+        truncate ``metrics.csv`` rows past the restore point, and emit a
+        ``resume`` event. With a fresh/restartable iterator a preempted and
+        auto-resumed run reproduces the uninterrupted run's loss trajectory
+        (state RNG rides in the checkpoint; certified by ``tools/chaos.py``).
+        Auto-resume drops residual batches parked by a previous fit on this
+        Trainer: they encode the OLD stream position, which the fast-forward
+        replaces."""
         cfg = self.config
         if self.mesh is not None:
             state = shard_train_state(state, self.mesh, min_weight_size=cfg.fsdp_min_weight_size)
+        auto_resume = resume == "auto"
+        fast_forward_n = 0
+        resume_info = None
         if resume:
             if self.checkpoints is None:
-                raise ValueError("resume=True requires checkpoint_dir")
-            if self.checkpoints.latest_step() is not None:
+                raise ValueError("resume requires checkpoint_dir")
+            if auto_resume:
+                self._residual_batches.clear()
+                if self.checkpoints.latest_step() is not None:
+                    pre_step = int(state.step)
+                    state = self.checkpoints.restore(state)
+                    fast_forward_n = max(0, int(state.step) - pre_step)
+                    resume_info = {
+                        "from_step": pre_step,
+                        "to_step": int(state.step),
+                        "fast_forward_batches": fast_forward_n,
+                    }
+                    if self.logger is not None:
+                        self.logger.truncate_after(int(state.step))
+            elif self.checkpoints.latest_step() is not None:
                 state = self.checkpoints.restore(state)
 
         # --- telemetry: event sink, run manifest, goodput, MFU inputs -----
@@ -306,6 +378,25 @@ class Trainer:
             peak = device_peak_flops()
         if events is not None:
             events.emit("fit_start", start_step=int(state.step), max_steps=cfg.max_steps)
+            if resume_info is not None:
+                events.emit("resume", **resume_info)
+
+        # fit-scoped fault handling (training/faults.py): a fresh sentinel
+        # ladder per fit, and a preemption guard installed for the duration
+        # of the loop (uninstalled on every exit path below)
+        sentinel = None
+        if self._sentinel_cfg is not None:
+            from perceiver_io_tpu.training.faults import DivergenceSentinel
+
+            sentinel = DivergenceSentinel(self._sentinel_cfg)
+        guard = None
+        if cfg.preemption_save:
+            from perceiver_io_tpu.training.faults import PreemptionGuard
+
+            guard = PreemptionGuard()
+            guard.install()
+            self._preempt_guard = guard
+        preempted = False
 
         # an aborted run must still get its goodput/recompile audit, and
         # a fit_start must always be paired with a fit_end — the try
@@ -317,6 +408,14 @@ class Trainer:
         try:
             train_iter = iter(train_iter)
             src = train_iter
+            if fast_forward_n:
+                # consume the batches the pre-preemption run already trained
+                # on; the restored step counter and in-checkpoint RNG then
+                # see exactly the stream an uninterrupted run would
+                import itertools
+
+                for _ in itertools.islice(train_iter, fast_forward_n):
+                    pass
             if self._pending_prefetch is not None:
                 # a previous fit's producer outlived its bounded close() join
                 # (source iterator blocked); collect whatever it has since
@@ -345,6 +444,16 @@ class Trainer:
 
                 # lazy drain: unconsumed items REMAIN in the deque for the next fit
                 train_iter = itertools.chain(_drain(), train_iter)
+            if cfg.quarantine_poison_batches:
+                # upstream of the prefetch wrapper: the per-leaf finiteness
+                # scan then runs in the producer thread, off the step path
+                from perceiver_io_tpu.training.faults import QuarantineIterator
+
+                def _on_poison(path, n, _ev=events):
+                    if _ev is not None:
+                        _ev.emit("fault.poison_batch", leaf=path, n_quarantined=n)
+
+                train_iter = QuarantineIterator(train_iter, on_quarantine=_on_poison)
             prefetch = None
             start_step = int(state.step)
             if cfg.prefetch_batches > 0 and start_step < cfg.max_steps:
@@ -364,7 +473,15 @@ class Trainer:
             window_overhead0 = goodput.overhead()
             lint_pending = events is not None and cfg.graphlint
             try:
-                for i in range(start_step, cfg.max_steps):
+                i = start_step
+                while i < cfg.max_steps:
+                    if guard is not None and guard.requested:
+                        # preemption requested (SIGTERM/SIGINT): this step
+                        # boundary is the last consistent point to stop —
+                        # the final save happens below, after the prefetch
+                        # cleanup parks unconsumed batches
+                        preempted = True
+                        break
                     # input_wait: host time BLOCKED obtaining the batch this
                     # step consumes — the double buffer below drives it to ~0
                     t_in = time.perf_counter()
@@ -400,9 +517,75 @@ class Trainer:
                             pending_batch, pending_exc = None, e
                     window.append(metrics)
                     window_samples += _leading_dim(batch)
-                    step = int(state.step)
+                    step = i = int(state.step)
 
-                    if step % cfg.log_interval == 0 or step == cfg.max_steps:
+                    if sentinel is not None:
+                        decision = self._sentinel_decide(sentinel, events, metrics, step)
+                        if (
+                            isinstance(metrics, dict)
+                            and float(metrics.get("sentinel_skipped", 0.0)) > 0.5
+                            and window
+                        ):
+                            # the held step's non-finite metrics must not
+                            # poison the log-window mean (the skip itself is
+                            # on record as a fault.skip event)
+                            window.pop()
+                            window_samples -= _leading_dim(batch)
+                        if decision is not None and decision.action == "rollback":
+                            from_step = step
+                            # roll back to the last valid checkpoint; the
+                            # restored step counter rewinds any step-indexed
+                            # LR schedule with it (LR-rewind), and the
+                            # replayed interval is booked as overhead, not
+                            # goodput
+                            prev_opt = state.opt_state
+                            with goodput.measure("rollback"):
+                                state = self.checkpoints.restore(state)
+                            opt_reinit = state.opt_state is prev_opt
+                            if opt_reinit:
+                                # weights-only checkpoint: restore left the
+                                # (possibly poisoned) optimizer moments in
+                                # place — reinitialize them fresh rather than
+                                # replay the interval with diverged state
+                                state = state.replace(
+                                    opt_state=state.tx.init(state.params)
+                                )
+                            step = i = int(state.step)
+                            sentinel.reset_window()
+                            if events is not None:
+                                events.emit(
+                                    "fault.rollback",
+                                    from_step=from_step,
+                                    to_step=step,
+                                    reason=decision.reason,
+                                    rollbacks=sentinel.rollbacks,
+                                    opt_reinit=opt_reinit,
+                                    **decision.detail,
+                                )
+                            # the metrics window spans the diverged steps —
+                            # reset it so the next log row is post-rollback
+                            window, window_samples, t0 = [], 0, time.perf_counter()
+                            input_wait_s = 0.0
+                            window_overhead0 = goodput.overhead()
+                            continue
+                        if decision is not None and decision.action == "halt":
+                            if events is not None:
+                                events.emit(
+                                    "fault.halt",
+                                    step=step,
+                                    reason=decision.reason,
+                                    **decision.detail,
+                                )
+                            from perceiver_io_tpu.training.faults import DivergenceHalt
+
+                            raise DivergenceHalt(
+                                f"divergence sentinel halted the run at step {step} "
+                                f"({decision.reason})"
+                            )
+
+                    # (an entirely-skipped window has no rows to average —
+                    # the fault.skip events already tell that story)
+                    if (step % cfg.log_interval == 0 or step == cfg.max_steps) and window:
                         avg = {
                             cfg.metric_prefix_train + k: float(np.mean([float(m[k]) for m in window]))
                             for k in window[-1]
@@ -492,7 +675,28 @@ class Trainer:
                 if self.checkpoints is not None:
                     with goodput.measure("checkpoint"):
                         self.checkpoints.wait_until_finished()
-            if val_loader is None and self.checkpoints is not None:
+            if preempted:
+                if events is not None:
+                    events.emit(
+                        "fault.preempt",
+                        step=int(state.step),
+                        signals=0 if guard is None else guard.signal_count,
+                    )
+                if cfg.checkpoint_dir is not None:
+                    # final preemption save: a monitor-free KEEP-ALL manager
+                    # over the same directory — full state (exact resume
+                    # needs the optimizer), no fresh val metric required,
+                    # and retention can never evict the best-val step
+                    with goodput.measure("checkpoint"):
+                        pm = CheckpointManager(
+                            cfg.checkpoint_dir, max_to_keep=None, monitor=None
+                        )
+                        # the marker metric keeps orbax's metrics item present
+                        # (restore paths read it); _monitor_value never lets a
+                        # non-monitor key win best_step
+                        pm.save(state, metrics={"preempted": 1.0}, config=model_config, force=True)
+                        pm.close()
+            elif val_loader is None and self.checkpoints is not None:
                 # no validation: leave a final latest-state checkpoint via a
                 # monitor-free manager (Lightning save-last parity) so NaN metrics
                 # never pollute best-k retention
@@ -506,6 +710,7 @@ class Trainer:
                     final_mngr.save(state, config=model_config)
                     final_mngr.close()
         except BaseException:
+            self._release_guard(guard)
             if events is not None:
                 events.emit(
                     "fit_end",
@@ -515,15 +720,52 @@ class Trainer:
                     **goodput.summary(),
                 )
             raise
+        self._release_guard(guard)
         if events is not None:
             events.emit(
                 "fit_end",
                 step=int(state.step),
                 aborted=False,
+                preempted=preempted,
                 recompiles=self.recompiles.counts(),
                 **goodput.summary(),
             )
         return state
+
+    def _release_guard(self, guard) -> None:
+        if guard is not None:
+            guard.uninstall()
+            if self._preempt_guard is guard:
+                self._preempt_guard = None
+
+    def _sentinel_decide(self, sentinel, events, metrics, step: int):
+        """Feed one completed step to the sentinel; handle the skip/spike
+        rungs (events only) inline and return the decision when the trainer
+        must act (rollback/halt), escalating rollback to halt when there is
+        no checkpoint to roll back to."""
+        skipped = False
+        loss_val = None
+        if isinstance(metrics, dict):
+            if "sentinel_skipped" in metrics:
+                skipped = float(metrics["sentinel_skipped"]) > 0.5
+            if "loss" in metrics:
+                loss_val = float(metrics["loss"])
+        decision = sentinel.observe(step, loss_val, skipped)
+        if decision.action == "skip":
+            if events is not None:
+                events.emit(
+                    "fault.skip", step=step, reason=decision.reason, skips=sentinel.skips
+                )
+            return None
+        if decision.action == "ok":
+            if decision.reason == "spike-noted" and events is not None:
+                events.emit("fault.spike", step=step, **decision.detail)
+            return None
+        if decision.action == "rollback" and (
+            self.checkpoints is None or self.checkpoints.latest_step() is None
+        ):
+            decision = sentinel.notify_rollback_unavailable()
+        return decision
 
     def close(self) -> None:
         """Release the checkpoint manager (waits for in-flight async saves).

@@ -26,7 +26,10 @@ class ScalingLaw:
         return self.k_d * flops**self.b
 
     def __str__(self):
-        return f"N_opt = {self.k_n:.4f} * C ** {self.a:.2f}\nD_opt = {self.k_d:.4f} * C ** {self.b:.2f}"
+        return (
+            f"compute-optimal fit: N_opt(C) = {self.k_n:.4g}·C^{self.a:.3g}, "
+            f"D_opt(C) = {self.k_d:.4g}·C^{self.b:.3g}"
+        )
 
 
 def fit_power_law(xs: Sequence[float], ys: Sequence[float], m: float) -> float:

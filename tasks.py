@@ -11,6 +11,7 @@ equivalent with the same task names:
     python tasks.py bench [...args]    # the driver benchmark (real chip)
     python tasks.py graphlint [...]    # static-analysis gate (compiled graphs)
     python tasks.py dryrun [...]       # 8-virtual-device multichip certification
+    python tasks.py chaos [...]        # fault-injection gate (preempt/NaN/torn-save)
 """
 
 from __future__ import annotations
@@ -128,6 +129,16 @@ def dryrun(args):
         "-q", *args.rest,
         env=env,
     )
+
+
+@task
+def chaos(args):
+    """Fault-injection gate (tools/chaos.py; docs/robustness.md): SIGTERM
+    preemption + auto-resume equivalence (unsharded AND data x fsdp mesh),
+    loader fetch retries, NaN-grad sentinel skip/rollback, torn-save
+    quarantine. Extra args go to tools/chaos.py (e.g. ``--scenarios
+    preempt``)."""
+    run(sys.executable, "tools/chaos.py", *args.rest)
 
 
 @task

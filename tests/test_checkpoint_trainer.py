@@ -493,6 +493,208 @@ def test_residuals_survive_noop_and_unprefetched_fits():
     assert seen == list(range(15)), seen
 
 
+def _linear_state(seed=0):
+    from perceiver_io_tpu.training import make_optimizer
+
+    tx = make_optimizer(1e-3)
+    return TrainState.create(
+        None, {"w": jnp.full((4,), float(seed))}, tx, jax.random.PRNGKey(seed)
+    )
+
+
+def test_best_step_never_selects_nan_or_missing_metric(tmp_path):
+    """VERDICT/issue satellite: a NaN (or absent) monitored metric must
+    never win best_step — raw orbax best_fn comparison picks the NaN step
+    (verified against orbax 0.7.0), so both retention best_fn and our
+    best_step sanitize."""
+    mngr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=5, monitor="val_loss")
+    s = _linear_state()
+    mngr.save(s.replace(step=jnp.asarray(1)), metrics={"val_loss": 1.0})
+    mngr.save(s.replace(step=jnp.asarray(2)), metrics={"val_loss": float("nan")})
+    mngr.save(s.replace(step=jnp.asarray(3)), metrics={"val_loss": 0.7})
+    # a force (preemption-style) save carries no monitored metric at all
+    mngr.save(s.replace(step=jnp.asarray(4)), force=True)
+    assert mngr.best_step() == 3
+    assert mngr.latest_step() == 4
+    mngr.close()
+
+    # all-NaN metrics: best_step is None (callers fall back to latest),
+    # never a NaN-metric step
+    m2 = CheckpointManager(str(tmp_path / "allnan"), max_to_keep=5, monitor="val_loss")
+    m2.save(s.replace(step=jnp.asarray(1)), metrics={"val_loss": float("nan")})
+    m2.save(s.replace(step=jnp.asarray(2)), metrics={"val_loss": float("nan")})
+    assert m2.best_step() is None
+    assert m2.latest_step() == 2
+    m2.close()
+
+
+def test_startup_sweep_quarantines_tmp_and_unfinalized(tmp_path):
+    """Atomic-save discipline: leftover orbax tmp dirs and digit dirs
+    missing the commit marker are swept to _quarantine/ at manager startup
+    and never appear as steps."""
+    from perceiver_io_tpu.training.checkpoint import QUARANTINE_DIR
+
+    ckpt = tmp_path / "ckpt"
+    mngr = CheckpointManager(str(ckpt), monitor=None)
+    mngr.save(_linear_state().replace(step=jnp.asarray(1)))
+    mngr.close()
+    # simulate torn writes: an orbax tmp leftover + a digit dir with no
+    # commit marker (a save killed mid-rename / a partial copy)
+    (ckpt / "2.orbax-checkpoint-tmp-99").mkdir()
+    (ckpt / "3" / "default").mkdir(parents=True)
+
+    with pytest.warns(UserWarning, match="quarantined checkpoint dir"):
+        m2 = CheckpointManager(str(ckpt), monitor=None)
+    assert sorted(m2.quarantined) == ["2.orbax-checkpoint-tmp-99", "3"]
+    assert m2.latest_step() == 1
+    restored = m2.restore(_linear_state(seed=9))
+    assert int(restored.step) == 1
+    names = os.listdir(ckpt / QUARANTINE_DIR)
+    assert any(n.startswith("3") for n in names)
+    m2.close()
+
+
+def test_restore_skips_torn_step_and_falls_back(tmp_path):
+    """The torn-save contract (issue acceptance): a step dir mutilated
+    AFTER commit fails its integrity record, is quarantined, and restore
+    lands on the previous good step — it never returns partial state."""
+    import shutil
+
+    from perceiver_io_tpu.training.checkpoint import QUARANTINE_DIR
+
+    ckpt = tmp_path / "ckpt"
+    mngr = CheckpointManager(str(ckpt), max_to_keep=3, monitor=None)
+    for step in (1, 2):
+        mngr.save(_linear_state(seed=step).replace(step=jnp.asarray(step)))
+    mngr.close()
+    shutil.rmtree(ckpt / "2" / "default")  # tear the payload, keep the marker
+
+    m2 = CheckpointManager(str(ckpt), max_to_keep=3, monitor=None)
+    assert m2.latest_step() == 1  # the torn step is not selectable
+    restored = m2.restore(_linear_state(seed=9))
+    assert int(restored.step) == 1
+    np.testing.assert_array_equal(np.asarray(restored.params["w"]), np.full((4,), 1.0))
+    assert os.path.isdir(ckpt / QUARANTINE_DIR)
+    m2.close()
+
+
+def test_force_save_replaces_thinner_commit_only(tmp_path):
+    """A forced (preemption) full-state save colliding with a committed
+    step: skipped when the commit already carries the optimizer, but a
+    weights-only commit is quarantined and REPLACED — exact resume needs
+    the optimizer state (code-review finding)."""
+    ckpt = str(tmp_path / "ckpt")
+    s = _linear_state()
+    stepped = s.replace(step=jnp.asarray(3))
+
+    wm = CheckpointManager(ckpt, monitor=None, save_weights_only=True)
+    assert wm.save(stepped)
+    wm.close()
+
+    fm = CheckpointManager(ckpt, monitor=None, save_weights_only=False)
+    assert fm.save(stepped, force=True)  # thinner commit replaced
+    restored = fm.restore(s, step=3)
+    # moments restored from the forced save, not left fresh: run a step so
+    # the saved opt_state is distinguishable? zeros == fresh here, so
+    # instead assert the payload itself carries opt_state on disk
+    assert fm._payload_has_opt_state(3)
+    assert int(restored.step) == 3
+    # a second forced save against the (now full-state) commit is a no-op
+    assert fm.save(stepped, force=True) is False
+    fm.close()
+
+    # full-state commit first: a forced save never replaces it
+    ckpt2 = str(tmp_path / "ckpt2")
+    fm2 = CheckpointManager(ckpt2, monitor=None, save_weights_only=False)
+    assert fm2.save(stepped)
+    assert fm2.save(stepped, force=True) is False
+    fm2.close()
+
+
+def test_restore_weights_only_fallback_paths(tmp_path):
+    """The two cross-layout restores (tests/test_checkpoint gaps): resuming
+    FULL-state training from a weights-only checkpoint restores
+    params/step/rng and leaves the optimizer fresh; a weights-only manager
+    pointed at a full-state checkpoint still restores."""
+    model, config = tiny_classifier()
+    state, batch = make_state(model, config)
+    from perceiver_io_tpu.training.loop import make_train_step
+
+    step = make_train_step(classification_loss_fn(model.apply), donate=False)
+    state, _ = step(state, batch)  # non-trivial opt_state + advanced step
+
+    # weights-only save -> full-state restore
+    wdir = str(tmp_path / "weights_only")
+    wm = CheckpointManager(wdir, monitor=None, save_weights_only=True)
+    wm.save(state)
+    wm.close()
+    fresh, _ = make_state(model, config, seed=5)
+    full = CheckpointManager(wdir, monitor=None, save_weights_only=False)
+    restored = full.restore(fresh)
+    full.close()
+    assert int(restored.step) == int(state.step)
+    for a, b in zip(jax.tree.leaves(restored.params), jax.tree.leaves(state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(np.asarray(restored.rng), np.asarray(state.rng))
+    # optimizer state stayed FRESH (not restored): equals the fresh state's
+    for a, b in zip(jax.tree.leaves(restored.opt_state), jax.tree.leaves(fresh.opt_state)):
+        if hasattr(a, "shape"):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # full-state save -> weights-only manager restore (reverse fallback)
+    fdir = str(tmp_path / "full_state")
+    fm = CheckpointManager(fdir, monitor=None, save_weights_only=False)
+    fm.save(state)
+    fm.close()
+    fresh2, _ = make_state(model, config, seed=6)
+    wm2 = CheckpointManager(fdir, monitor=None, save_weights_only=True)
+    restored2 = wm2.restore(fresh2)
+    wm2.close()
+    for a, b in zip(jax.tree.leaves(restored2.params), jax.tree.leaves(state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_load_params_into_subtree_selection():
+    """load_params_into gaps: subtree replace leaves siblings untouched,
+    works without a "params" wrapper, and unknown subtrees fail with the
+    available keys listed."""
+    dst = {
+        "params": {
+            "encoder": {"w": np.zeros((2,)), "b": np.zeros((2,))},
+            "decoder": {"w": np.zeros((2,))},
+        }
+    }
+    src = {
+        "params": {
+            "encoder": {"w": np.ones((2,)), "b": np.full((2,), 2.0)},
+            "decoder": {"w": np.full((2,), 3.0)},
+        }
+    }
+    out = load_params_into(dst, src, subtree="encoder")
+    np.testing.assert_array_equal(out["params"]["encoder"]["w"], np.ones((2,)))
+    np.testing.assert_array_equal(out["params"]["encoder"]["b"], np.full((2,), 2.0))
+    np.testing.assert_array_equal(out["params"]["decoder"]["w"], np.zeros((2,)))
+    # the input tree is not mutated (shallow-copy-via-rebuild contract)
+    np.testing.assert_array_equal(dst["params"]["encoder"]["w"], np.zeros((2,)))
+
+    # no "params" wrapper on either side
+    out2 = load_params_into(
+        {"encoder": {"w": np.zeros((2,))}, "head": {"w": np.zeros((2,))}},
+        {"encoder": {"w": np.ones((2,))}},
+        subtree="encoder",
+    )
+    np.testing.assert_array_equal(out2["encoder"]["w"], np.ones((2,)))
+    np.testing.assert_array_equal(out2["head"]["w"], np.zeros((2,)))
+
+    # unknown subtree: the error names what IS available
+    with pytest.raises(KeyError, match="encoder"):
+        load_params_into(dst, src, subtree="missing_tower")
+
+    # full-tree load (subtree=None) round-trips through the state-dict path
+    out3 = load_params_into(dst, src)
+    np.testing.assert_array_equal(out3["params"]["decoder"]["w"], np.full((2,), 3.0))
+
+
 def test_checkpoint_roundtrip_bf16_moments(tmp_path):
     """Orbax save/restore must preserve the compact Adam state's bfloat16
     moment dtype (the round-4 bench default): a restored state has to be

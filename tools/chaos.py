@@ -1,0 +1,413 @@
+#!/usr/bin/env python
+"""Chaos harness — deterministic fault injection against the train loop.
+
+Certifies the faults subsystem (training/faults.py, docs/robustness.md) the
+same way dryrun_multichip certifies sharding: by RUNNING the failure and
+asserting recovery, not by unit-testing pieces. ``python tasks.py chaos`` is
+the gate. Scenarios:
+
+- ``preempt``       — a REAL SIGTERM mid-fit: the trainer saves at the step
+                      boundary and returns; a fresh trainer with
+                      ``resume="auto"`` fast-forwards the data stream and the
+                      combined loss trajectory matches the uninterrupted run
+                      to <= 1e-6.
+- ``preempt_mesh``  — the same kill/resume cycle under a {data:2, fsdp:4}
+                      mesh (8 virtual CPU devices; the harness respawns
+                      itself like dryrun_multichip), so auto-resume is
+                      certified against ``shard_train_state`` placements.
+- ``fetch_error``   — transient loader fetch failures at a chosen step are
+                      absorbed by ``Batches(retry=RetryPolicy(...))``: the
+                      trajectory is IDENTICAL to the fault-free run.
+- ``nan_skip``      — a single NaN batch trips the in-graph sentinel skip:
+                      params hold, step advances, one ``fault.skip`` event.
+- ``nan_rollback``  — persistent NaN batches escalate past ``skip_limit``
+                      into rollback-to-last-checkpoint; the run completes
+                      with finite loss and a ``fault.rollback`` event.
+- ``torn_save``     — a checkpoint step dir torn post-commit is quarantined;
+                      ``restore`` falls back to the previous good step and
+                      never selects the torn one.
+
+Every injection is count-/step-deterministic (no wall-clock, no randomness
+outside seeded generators), so failures reproduce exactly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import os
+import re
+import signal
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+TOL = 1e-6
+
+
+# ---------------------------------------------------------------------------
+# fixture: a tiny linear-regression step — compiles in milliseconds, losses
+# are deterministic functions of (seed, step), and the parameter is large
+# enough ((8, 4) floats) for fsdp to actually shard it under min_weight_size=0
+# ---------------------------------------------------------------------------
+
+
+def _loss_fn():
+    import jax.numpy as jnp
+
+    def loss_fn(params, batch, rng):
+        pred = batch["x"] @ params["w"]
+        loss = jnp.mean((pred - batch["y"]) ** 2)
+        return loss, {"loss": loss}
+
+    return loss_fn
+
+
+def _fresh_state():
+    import jax
+    import jax.numpy as jnp
+
+    from perceiver_io_tpu.training import TrainState, make_optimizer
+
+    tx = make_optimizer(1e-2)
+    return TrainState.create(None, {"w": jnp.zeros((8, 4))}, tx, jax.random.PRNGKey(0))
+
+
+def _batches(seed=0, batch_size=8, poison_at=()):
+    """Infinite deterministic batch stream; ``poison_at`` (1-based fetch
+    indices) yields batches with NaN inputs — the NaN-grad injection."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    for i in itertools.count(1):
+        x = rng.normal(size=(batch_size, 8)).astype(np.float32)
+        y = (x @ np.ones((8, 4))).astype(np.float32)
+        if i in poison_at:
+            x = x.copy()
+            x[0, 0] = np.nan
+        yield {"x": x, "y": y}
+
+
+def _make_trainer(run_dir, max_steps, mesh=None, sentinel=False, **cfg_kw):
+    from perceiver_io_tpu.training import MetricsLogger, Trainer, TrainerConfig
+
+    config = TrainerConfig(
+        max_steps=max_steps,
+        log_interval=1,
+        checkpoint_dir=os.path.join(run_dir, "ckpt"),
+        prefetch_batches=0,
+        input_double_buffer=False,
+        graphlint=False,
+        sentinel=sentinel,
+        fsdp_min_weight_size=0,
+        **cfg_kw,
+    )
+    logger = MetricsLogger(os.path.join(run_dir, "logs"), use_tensorboard=False)
+    return Trainer(_loss_fn(), mesh=mesh, config=config, logger=logger)
+
+
+def _record_losses(trainer, hook=None):
+    """Wrap the trainer's step to host-fetch each loss (and optionally run a
+    per-step injection hook)."""
+    losses = []
+    orig = trainer._train_step
+
+    def wrapped(state, batch, _orig=orig):
+        state, metrics = _orig(state, batch)
+        losses.append(float(metrics["loss"]))
+        if hook is not None:
+            hook(trainer, state, metrics)
+        return state, metrics
+
+    trainer._train_step = wrapped
+    return losses
+
+
+def _assert_trajectories_match(ref, got, what):
+    assert len(got) == len(ref), f"{what}: {len(got)} losses vs reference {len(ref)}"
+    worst = max(abs(a - b) for a, b in zip(ref, got))
+    assert worst <= TOL, f"{what}: trajectory diverged, max |d_loss| = {worst:.3e}"
+    return worst
+
+
+def _events(run_dir, kind):
+    path = os.path.join(run_dir, "logs", "events.jsonl")
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(l) for l in f if json.loads(l).get("event") == kind]
+
+
+# ---------------------------------------------------------------------------
+# scenarios
+# ---------------------------------------------------------------------------
+
+
+def scenario_preempt(tmp, mesh=None, tag="preempt"):
+    """Kill-at-step-N via a real SIGTERM; auto-resume must reproduce the
+    uninterrupted run's loss trajectory."""
+    n_steps, kill_at = 12, 5
+    ref_dir = os.path.join(tmp, f"{tag}_ref")
+    tr = _make_trainer(ref_dir, n_steps, mesh=mesh)
+    ref = _record_losses(tr)
+    tr.fit(_fresh_state(), _batches())
+    tr.close()
+
+    run_dir = os.path.join(tmp, f"{tag}_run")
+    t1 = _make_trainer(run_dir, n_steps, mesh=mesh)
+
+    def kill(trainer, state, metrics):
+        if int(state.step) == kill_at:
+            # the real signal path: SIGTERM -> PreemptionGuard -> flag; the
+            # loop notices at the next step boundary and saves
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    part1 = _record_losses(t1, hook=kill)
+    out1 = t1.fit(_fresh_state(), _batches())
+    t1.close()
+    assert int(out1.step) == kill_at, f"expected stop at {kill_at}, got {int(out1.step)}"
+    assert _events(run_dir, "fault.preempt"), "no fault.preempt event emitted"
+
+    t2 = _make_trainer(run_dir, n_steps, mesh=mesh)
+    part2 = _record_losses(t2)
+    out2 = t2.fit(_fresh_state(), _batches(), resume="auto")
+    t2.close()
+    assert int(out2.step) == n_steps
+    ev = _events(run_dir, "resume")
+    assert ev and ev[-1]["to_step"] == kill_at and ev[-1]["fast_forward_batches"] == kill_at
+    worst = _assert_trajectories_match(ref, part1 + part2, tag)
+    # no partial step dir may survive anywhere a restore could see it
+    ckpt = os.path.join(run_dir, "ckpt")
+    leftovers = [n for n in os.listdir(ckpt) if ".orbax-checkpoint-tmp" in n]
+    assert not leftovers, f"tmp checkpoint leftovers: {leftovers}"
+    print(f"chaos: {tag} ok — killed at {kill_at}, resumed, "
+          f"{len(ref)} losses match <= {TOL:g} (worst {worst:.1e})")
+
+
+def scenario_preempt_mesh(tmp):
+    """scenario_preempt under a {data:2, fsdp:4} mesh — certifies resume
+    against shard_train_state placements (needs 8 devices; the entrypoint
+    respawns with virtual CPU devices when short)."""
+    import jax
+
+    from perceiver_io_tpu.parallel import make_mesh
+
+    assert len(jax.devices()) >= 8, "preempt_mesh needs 8 devices (respawn failed?)"
+    mesh = make_mesh(devices=jax.devices()[:8], data=2, fsdp=4)
+    scenario_preempt(tmp, mesh=mesh, tag="preempt_mesh")
+
+
+def scenario_fetch_error(tmp):
+    """Transient fetch errors at step N are retried with backoff inside the
+    loader — the trajectory is identical to the fault-free run."""
+    import numpy as np
+
+    from perceiver_io_tpu.data.loader import Batches
+    from perceiver_io_tpu.training.faults import RetryPolicy
+
+    n_steps, fail_at_step, batch_size = 10, 4, 8
+
+    class Dataset:
+        def __init__(self, flaky=False):
+            rng = np.random.default_rng(0)
+            self.x = rng.normal(size=(n_steps * batch_size, 8)).astype(np.float32)
+            self.flaky = flaky
+            self.failures_left = 2 if flaky else 0
+            self.fail_index = (fail_at_step - 1) * batch_size  # first fetch of step N
+            self.retries_seen = 0
+
+        def __len__(self):
+            return len(self.x)
+
+        def __getitem__(self, i):
+            if self.flaky and i == self.fail_index and self.failures_left > 0:
+                self.failures_left -= 1
+                raise OSError("injected transient fetch failure")
+            return {"x": self.x[i], "y": self.x[i] @ np.ones((8, 4), np.float32)}
+
+    def run(flaky):
+        tag = "flaky" if flaky else "clean"
+        ds = Dataset(flaky=flaky)
+        retries = []
+        loader = Batches(
+            ds, batch_size,
+            retry=RetryPolicy(max_retries=3, base_delay=0.001, max_delay=0.002),
+            on_retry=lambda a, e, d: retries.append((a, round(d, 6))),
+        )
+        tr = _make_trainer(os.path.join(tmp, f"fetch_{tag}"), n_steps)
+        losses = _record_losses(tr)
+        tr.fit(_fresh_state(), loader)
+        tr.close()
+        return losses, retries
+
+    ref, _ = run(flaky=False)
+    got, retries = run(flaky=True)
+    assert len(retries) == 2, f"expected 2 retries, saw {retries}"
+    worst = _assert_trajectories_match(ref, got, "fetch_error")
+    print(f"chaos: fetch_error ok — 2 transient failures retried "
+          f"(backoff {[d for _, d in retries]}), trajectory identical (worst {worst:.1e})")
+
+
+def scenario_nan_skip(tmp):
+    """One poison batch => one in-graph sentinel skip: params hold across the
+    skipped step, the run completes, exactly one fault.skip event."""
+    import numpy as np
+
+    n_steps, poison_fetch = 10, 4
+    run_dir = os.path.join(tmp, "nan_skip")
+    tr = _make_trainer(run_dir, n_steps, sentinel=True)
+    snapshots = []
+
+    def snap(trainer, state, metrics):
+        w = np.asarray(state.params["w"])
+        snapshots.append((int(state.step), float(metrics["loss"]), w.copy()))
+
+    losses = _record_losses(tr, hook=snap)
+    tr.fit(_fresh_state(), _batches(poison_at=(poison_fetch,)))
+    tr.close()
+    assert len(losses) == n_steps
+    skip_events = _events(run_dir, "fault.skip")
+    assert len(skip_events) == 1 and skip_events[0]["step"] == poison_fetch, skip_events
+    # params across the skipped step: unchanged (post-step-3 == post-step-4)
+    w_before = snapshots[poison_fetch - 2][2]
+    w_at = snapshots[poison_fetch - 1][2]
+    assert np.array_equal(w_before, w_at), "skip did not hold params"
+    assert not np.isnan(losses[poison_fetch:]).any(), "NaN leaked past the skip"
+    print(f"chaos: nan_skip ok — poison batch at step {poison_fetch} skipped in-graph, "
+          f"params held, final loss {losses[-1]:.4f} finite")
+
+
+def scenario_nan_rollback(tmp):
+    """Persistent NaN batches exhaust skip_limit and trip a rollback to the
+    last checkpoint; the run then completes with finite loss."""
+    import numpy as np
+
+    from perceiver_io_tpu.training.faults import SentinelConfig
+
+    n_steps = 12
+    run_dir = os.path.join(tmp, "nan_rollback")
+    tr = _make_trainer(
+        run_dir, n_steps,
+        sentinel=SentinelConfig(skip_limit=2, rollback_limit=2),
+        val_interval=4,
+    )
+    losses = _record_losses(tr)
+    # checkpoint lands at step 4 (val_interval); fetches 6+7 are poison —
+    # two consecutive skips hit skip_limit=2 => rollback to step 4. The
+    # injection is FETCH-indexed, so the replayed interval gets clean data.
+    tr.fit(_fresh_state(), _batches(poison_at=(6, 7)), val_loader=[next(_batches(seed=9))])
+    tr.close()
+    rb = _events(run_dir, "fault.rollback")
+    assert len(rb) == 1, f"expected 1 rollback, got {rb}"
+    assert rb[0]["from_step"] == 7 and rb[0]["to_step"] == 4, rb
+    finite = [l for l in losses if np.isfinite(l)]
+    assert np.isfinite(losses[-1]) and len(finite) >= n_steps, "run did not recover"
+    print(f"chaos: nan_rollback ok — skip_limit tripped at step 7, rolled back to 4, "
+          f"run completed with final loss {losses[-1]:.4f}")
+
+
+def scenario_torn_save(tmp):
+    """A torn (post-commit mutilated) step dir is quarantined and never
+    selectable by restore/latest_step."""
+    import shutil
+
+    from perceiver_io_tpu.training.checkpoint import QUARANTINE_DIR, CheckpointManager
+
+    ckpt = os.path.join(tmp, "torn", "ckpt")
+    m = CheckpointManager(ckpt, max_to_keep=3, monitor="val_loss")
+    s = _fresh_state()
+    m.save(s.replace(step=s.step + 1), metrics={"val_loss": 1.0})
+    s2 = s.replace(step=s.step + 2)
+    m.save(s2, metrics={"val_loss": 0.5})
+    m.close()
+    # tear the newest step: drop its payload directory post-commit
+    shutil.rmtree(os.path.join(ckpt, "2", "default"))
+
+    m2 = CheckpointManager(ckpt, max_to_keep=3, monitor="val_loss")
+    assert m2.latest_step() == 1, f"torn step selectable: latest={m2.latest_step()}"
+    restored = m2.restore(_fresh_state())
+    assert int(restored.step) == 1
+    qdir = os.path.join(ckpt, QUARANTINE_DIR)
+    assert os.path.isdir(qdir) and any(n.startswith("2") for n in os.listdir(qdir))
+    m2.close()
+    print("chaos: torn_save ok — mutilated step 2 quarantined, restore fell back to step 1")
+
+
+SCENARIOS = {
+    "preempt": scenario_preempt,
+    "preempt_mesh": scenario_preempt_mesh,
+    "fetch_error": scenario_fetch_error,
+    "nan_skip": scenario_nan_skip,
+    "nan_rollback": scenario_nan_rollback,
+    "torn_save": scenario_torn_save,
+}
+
+
+def _respawn_for_mesh(scenarios) -> int:
+    """Re-exec the mesh scenarios in a subprocess with 8 virtual CPU devices
+    (same bootstrap contract as __graft_entry__._respawn_with_virtual_devices:
+    set XLA_FLAGS before any device query, force the platform via
+    jax.config)."""
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    bootstrap = (
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        f"import sys; sys.path.insert(0, {repo!r})\n"
+        "import runpy; sys.argv = ['chaos.py', '--scenarios', "
+        f"{','.join(scenarios)!r}]\n"
+        f"runpy.run_path({os.path.abspath(__file__)!r}, run_name='__main__')\n"
+    )
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["_CHAOS_RESPAWNED"] = "1"
+    flags = re.sub(r"--xla_force_host_platform_device_count=\S+", "", env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    proc = subprocess.run([sys.executable, "-c", bootstrap], cwd=repo, env=env, timeout=540)
+    return proc.returncode
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "--scenarios",
+        default=",".join(SCENARIOS),
+        help=f"comma-separated subset of: {', '.join(SCENARIOS)}",
+    )
+    parser.add_argument("--tmp", default=None, help="scratch dir (default: mkdtemp)")
+    args = parser.parse_args(argv)
+    wanted = [s for s in args.scenarios.split(",") if s]
+    unknown = [s for s in wanted if s not in SCENARIOS]
+    if unknown:
+        parser.error(f"unknown scenarios: {unknown}")
+
+    import jax
+
+    run_local = list(wanted)
+    rc = 0
+    if (
+        "preempt_mesh" in run_local
+        and len(jax.devices()) < 8
+        and not os.environ.get("_CHAOS_RESPAWNED")
+    ):
+        # mesh case needs 8 devices: run it in a virtual-device subprocess,
+        # everything else in this process
+        run_local.remove("preempt_mesh")
+        rc = _respawn_for_mesh(["preempt_mesh"])
+        if rc != 0:
+            print("chaos: preempt_mesh FAILED (respawned subprocess)", file=sys.stderr)
+
+    import tempfile
+
+    tmp = args.tmp or tempfile.mkdtemp(prefix="chaos_")
+    for name in run_local:
+        SCENARIOS[name](tmp)
+    if rc == 0:
+        print(f"chaos: all {len(wanted)} scenario(s) passed")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
