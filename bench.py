@@ -118,6 +118,11 @@ _SMOKE_STATUS = None
 # resolves it (or forever, for unit callers of telemetry_fields)
 _GRAPHLINT_STATUS = None
 
+# the graphcheck contract verdict (analysis/fingerprint.py: live flagship
+# train+decode fingerprints diffed against the committed contracts/), same
+# record-in-every-artifact contract; the hard gate is `tasks.py perf`
+_GRAPHCHECK_STATUS = None
+
 
 def telemetry_fields(flops, step_time, step_times_s=None, times_key: str = "step_ms") -> dict:
     """The ``telemetry`` block every bench result carries: device kind, the
@@ -137,6 +142,8 @@ def telemetry_fields(flops, step_time, step_times_s=None, times_key: str = "step
         t["kernel_smoke"] = _SMOKE_STATUS
     if _GRAPHLINT_STATUS is not None:
         t["graphlint"] = _GRAPHLINT_STATUS
+    if _GRAPHCHECK_STATUS is not None:
+        t["graphcheck"] = _GRAPHCHECK_STATUS
     if flops is not None:
         peak = device_peak_flops()
         rate = flops / step_time
@@ -692,6 +699,10 @@ def main():
                    help="skip the static-analysis gate over the flagship "
                         "train/decode graphs (analysis/, tools/graphlint.py; "
                         "runs by default in every mode)")
+    p.add_argument("--skip-graphcheck", action="store_true",
+                   help="skip the compiled-graph contract diff against "
+                        "contracts/ (analysis/fingerprint.py, "
+                        "tools/graphcheck.py; runs by default in every mode)")
     p.add_argument("--kernel-features", default=None,
                    help="trace-time flash kernel feature set for A/B runs: 'all', "
                         "'none', or a comma list (e.g. 'twoseg') — see "
@@ -761,6 +772,18 @@ def main():
             mesh_spec=args.mesh if args.mode == "train" else None
         )
         print(f"graphlint {_GRAPHLINT_STATUS['status']}", flush=True)
+
+    global _GRAPHCHECK_STATUS
+    if args.skip_graphcheck:
+        _GRAPHCHECK_STATUS = {"status": "skipped"}
+    else:
+        # same never-raises contract as graphlint_telemetry: a contract
+        # regression (or missing contracts/) is a recorded verdict in the
+        # artifact; the hard gate is `tasks.py perf` / tools/graphcheck.py
+        from perceiver_io_tpu.analysis.fingerprint import graphcheck_telemetry
+
+        _GRAPHCHECK_STATUS = graphcheck_telemetry()
+        print(f"graphcheck {_GRAPHCHECK_STATUS['status']}", flush=True)
 
     if args.mode == "extra":
         return extra_bench(args)

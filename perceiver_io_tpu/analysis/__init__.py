@@ -19,6 +19,13 @@ and allowlist syntax: docs/static-analysis.md.
 """
 
 from perceiver_io_tpu.analysis.check import GraphLintError, Report, check
+from perceiver_io_tpu.analysis.fingerprint import (
+    DiffTolerances,
+    FingerprintDiff,
+    GraphFingerprint,
+    diff_fingerprints,
+    fingerprint,
+)
 from perceiver_io_tpu.analysis.graph import (
     AvalInfo,
     ConstInfo,
@@ -29,18 +36,26 @@ from perceiver_io_tpu.analysis.graph import (
     iter_ops,
     trace,
 )
+from perceiver_io_tpu.analysis.memory import MemoryBreakdown, memory_breakdown
 from perceiver_io_tpu.analysis.rules import RULES, LintPolicy, Violation, register_rule
 
 __all__ = [
     "AvalInfo",
     "ConstInfo",
+    "DiffTolerances",
+    "FingerprintDiff",
+    "GraphFingerprint",
     "GraphLintError",
     "LintPolicy",
+    "MemoryBreakdown",
     "OpNode",
     "RULES",
     "Report",
     "Violation",
     "check",
+    "diff_fingerprints",
+    "fingerprint",
+    "memory_breakdown",
     "collective_counts",
     "count_output_aliases",
     "iter_consts",

@@ -117,6 +117,7 @@ def check(
     policy: Optional[LintPolicy] = None,
     compiled: Optional[bool] = None,
     name: Optional[str] = None,
+    closed_jaxpr=None,
 ) -> Report:
     """Lint ``fn`` traced with ``args``/``kwargs``.
 
@@ -131,12 +132,18 @@ def check(
     :param compiled: force (True) or forbid (False) lowering+compiling for
         the compiled-module rules. Default ``None``: compile exactly when an
         active compiled-level rule has its policy inputs declared
-        (``donate_argnums``/``expect_donation``, ``collective_budget``).
+        (``donate_argnums``/``expect_donation``, ``collective_budget``,
+        ``peak_memory_budget_bytes``, ``replicated_bytes_limit``,
+        ``reshard_budget``).
         A jitted ``fn``'s OWN donate_argnums are detected from the lowered
         module once the rule runs, but pjit does not expose them before
         lowering (jax 0.4.37) — to audit such a fn without policy hints,
         pass ``compiled=True`` (or declare ``expect_donation=True``).
     :param name: label for reports (default: the function's ``__name__``).
+    :param closed_jaxpr: a pre-traced ``ClosedJaxpr`` of ``fn(*args)`` to
+        reuse (callers that also :func:`~perceiver_io_tpu.analysis.
+        fingerprint.fingerprint` the same fn share one trace); default:
+        trace here.
 
     Trace-time feature flags (``fast_kernels``) must be active AROUND this
     call — ``check`` traces like ``jax.jit`` would.
@@ -155,7 +162,7 @@ def check(
         # override must not lie dormant until the lint it disarms fires
         raise ValueError(f"invalid severity override(s) {bad_sev}; valid: {SEVERITIES}")
 
-    ctx = RuleContext(fn, args, kwargs, policy)
+    ctx = RuleContext(fn, args, kwargs, policy, closed_jaxpr=closed_jaxpr)
 
     def compiled_inputs_declared(rule_name: str) -> bool:
         if rule_name == "donation-dropped":
@@ -166,6 +173,12 @@ def check(
             return policy.collective_budget is not None
         if rule_name == "collective-overlap":
             return policy.expect_overlap
+        if rule_name == "peak-memory-budget":
+            return policy.peak_memory_budget_bytes is not None
+        if rule_name == "replicated-large-tensor":
+            return policy.replicated_bytes_limit is not None
+        if rule_name == "implicit-reshard":
+            return policy.reshard_budget is not None
         return True
 
     run: List[str] = []

@@ -362,6 +362,16 @@ def collective_stats(hlo_text: str) -> Dict[str, Dict[str, int]]:
     return stats
 
 
+_NUM_PARTITIONS_RE = re.compile(r"num_partitions=(\d+)")
+
+
+def hlo_num_partitions(hlo_text: str) -> int:
+    """SPMD partition count from the HloModule header (1 when absent —
+    a single-device module)."""
+    m = _NUM_PARTITIONS_RE.search(hlo_text)
+    return int(m.group(1)) if m else 1
+
+
 def count_output_aliases(hlo_text: str) -> int:
     """Number of parameter buffers the compiled module aliases into outputs
     (the committed form of ``donate_argnums``). 0 means every donation was

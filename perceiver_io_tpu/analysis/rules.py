@@ -17,6 +17,7 @@ the module that traced the op, not just to a primitive index.
 from __future__ import annotations
 
 import dataclasses
+import re
 from fnmatch import fnmatch
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -85,6 +86,21 @@ class LintPolicy:
     # collective-budget: max allowed per compiled module, e.g.
     # {"all-gather": 2, "all-reduce": 1} or {"total": 4}; None disables
     collective_budget: Optional[Dict[str, int]] = None
+    # peak-memory-budget: static budget (bytes) for the compiled module's
+    # temp+argument buffers (analysis/memory.py breakdown:
+    # compiled.memory_analysis() with an HLO-text fallback); None disables
+    peak_memory_budget_bytes: Optional[int] = None
+    # replicated-large-tensor: entry parameters >= this many bytes left
+    # FULLY replicated in a partitioned (num_partitions > 1) module — under
+    # a mesh with an fsdp axis, a large replicated tensor is per-device HBM
+    # bought for nothing; None disables
+    replicated_bytes_limit: Optional[int] = None
+    # implicit-reshard: budget for the resharding collectives GSPMD inserts
+    # when declared input/output shardings disagree with the compute
+    # placement (all-to-all, collective-permute), e.g. {"collective-permute":
+    # 2}; a missing kind allows 0 and {} allows none. None disables. Ring
+    # attention's deliberate permutes must be budgeted by the caller.
+    reshard_budget: Optional[Dict[str, int]] = None
     # collective-overlap: declare that the compiled module's collectives are
     # meant to overlap compute (the parallel/overlap.py scheduling claim).
     # On async backends (TPU) each *-start/*-done pair must have compute
@@ -125,6 +141,7 @@ class RuleContext:
         self._consts: Optional[List[G.ConstInfo]] = None
         self._lowered = None
         self._dropped_donations: Optional[List[str]] = None
+        self._compiled = None
         self._compiled_text: Optional[str] = None
 
     @property
@@ -158,9 +175,17 @@ class RuleContext:
         return self._dropped_donations or []
 
     @property
+    def compiled(self):
+        """The compiled executable — shared by every compiled-level rule in
+        one check, so text parsing and memory_analysis pay one compile."""
+        if self._compiled is None:
+            self._compiled = self._ensure_lowered().compile()
+        return self._compiled
+
+    @property
     def compiled_text(self) -> str:
         if self._compiled_text is None:
-            self._compiled_text = G.compile_text(self._ensure_lowered())
+            self._compiled_text = self.compiled.as_text()
         return self._compiled_text
 
 
@@ -456,6 +481,127 @@ def collective_budget(ctx: RuleContext) -> List[Violation]:
                     ),
                 )
             )
+    return out
+
+
+@register_rule(
+    "peak-memory-budget",
+    severity="error",
+    needs="compiled",
+    doc="temp+argument bytes of the compiled module vs a declared static budget",
+)
+def peak_memory_budget(ctx: RuleContext) -> List[Violation]:
+    budget = ctx.policy.peak_memory_budget_bytes
+    if budget is None:
+        return []
+    from perceiver_io_tpu.analysis.memory import memory_breakdown
+
+    mb = memory_breakdown(ctx.compiled)
+    if mb.gate_bytes <= budget:
+        return []
+    return [
+        Violation(
+            rule="peak-memory-budget",
+            severity=_severity(ctx, "peak-memory-budget"),
+            scope="",
+            message=(
+                f"compiled module needs {mb.gate_bytes / 1e6:.1f} MB "
+                f"(temp {mb.temp_bytes / 1e6:.1f} + args "
+                f"{mb.argument_bytes / 1e6:.1f}, {mb.method}) — over the "
+                f"declared {budget / 1e6:.1f} MB budget; a re-materialized "
+                "activation or lost fusion grew the static footprint"
+            ),
+        )
+    ]
+
+
+# one entry parameter of a partitioned module, with its committed sharding:
+# `%param.1 = f32[512,512]{1,0} parameter(1), sharding={replicated}` —
+# fusion-internal parameters carry no sharding attribute, so matching the
+# attribute restricts this to the entry computation's real inputs
+_PARAM_SHARDING_RE = re.compile(
+    r"=\s*(\S+)\s+parameter\(\d+\),\s*sharding=\{(replicated)\}"
+)
+
+
+@register_rule(
+    "replicated-large-tensor",
+    severity="error",
+    needs="compiled",
+    doc="large entry parameters left fully replicated in a partitioned module",
+)
+def replicated_large_tensor(ctx: RuleContext) -> List[Violation]:
+    limit = ctx.policy.replicated_bytes_limit
+    if limit is None:
+        return []
+    text = ctx.compiled_text
+    if G.hlo_num_partitions(text) <= 1:
+        return []  # single-device module: replication is not a choice
+    out = []
+    for line in text.splitlines():
+        pm = _PARAM_SHARDING_RE.search(line)
+        if pm is None:
+            continue
+        nbytes = G._shape_bytes(pm.group(1))
+        if nbytes < limit:
+            continue
+        # the op_name of an entry parameter is the argument's own label
+        name = G._OP_NAME_RE.search(line)
+        scope = name.group(1) if name else ""
+        out.append(
+            Violation(
+                rule="replicated-large-tensor",
+                severity=_severity(ctx, "replicated-large-tensor"),
+                scope=scope,
+                op="parameter",
+                message=(
+                    f"{pm.group(1)} ({nbytes / 1e6:.2f} MB) enters the "
+                    f"partitioned module fully replicated — every device "
+                    "holds the whole tensor; shard it over the fsdp axis "
+                    "(parallel/mesh.py param_shardings / shard_train_state)"
+                ),
+            )
+        )
+    return out
+
+
+# collectives whose appearance means GSPMD moved data to fix a sharding
+# mismatch rather than to compute a reduction
+_RESHARD_KINDS = ("all-to-all", "collective-permute")
+
+
+@register_rule(
+    "implicit-reshard",
+    severity="error",
+    needs="compiled",
+    doc="all-to-all / unbudgeted collective-permute in compiled HLO (GSPMD resharding)",
+)
+def implicit_reshard(ctx: RuleContext) -> List[Violation]:
+    budget = ctx.policy.reshard_budget
+    if budget is None:
+        return []
+    counts = G.collective_counts(ctx.compiled_text)
+    out = []
+    for kind in _RESHARD_KINDS:
+        n = counts.get(kind, 0)
+        cap = int(budget.get(kind, 0))
+        if n <= cap:
+            continue
+        out.append(
+            Violation(
+                rule="implicit-reshard",
+                severity=_severity(ctx, "implicit-reshard"),
+                scope="",
+                op=kind,
+                message=(
+                    f"{n}x {kind} in the compiled module (budget {cap}) — "
+                    "GSPMD is resharding mid-step because declared input/"
+                    "output shardings disagree with the compute placement; "
+                    "align the specs (or budget a deliberate permute, e.g. "
+                    "ring attention)"
+                ),
+            )
+        )
     return out
 
 

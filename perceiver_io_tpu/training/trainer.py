@@ -109,6 +109,14 @@ class TrainerConfig:
     graphlint: bool = True
     graphlint_rules: tuple = ("const-capture", "callback-in-jit")
     graphlint_allow: tuple = ()
+    # graph-contract telemetry (analysis/fingerprint.py): alongside the
+    # graphlint event, the trace-level fingerprint of the ACTUAL train step
+    # (op count, hot-scope concat inventory, captured-const bytes, dtype
+    # histogram, kernel features) is emitted as a `graphcheck` event — the
+    # run-local record tools/graphcheck.py's flagship contracts can be
+    # compared against when a training regression is suspected. Trace-only:
+    # no extra compile. docs/static-analysis.md has the workflow.
+    graphcheck: bool = True
 
 
 class Trainer:
@@ -262,7 +270,18 @@ class Trainer:
             )
         return self._events
 
-    def _graphlint(self, events: EventLog, state: TrainState, batch) -> None:
+    def _shared_lint_trace(self, state: TrainState, batch):
+        """One jaxpr trace of the lint step for BOTH the graphlint and
+        graphcheck emitters (tracing a large step takes seconds; each
+        emitter re-traces on its own only if this shared one failed)."""
+        try:
+            from perceiver_io_tpu.analysis import graph
+
+            return graph.trace(self._lint_step, state, batch)
+        except Exception:  # noqa: BLE001 — emitters retrace + report themselves
+            return None
+
+    def _graphlint(self, events: EventLog, state: TrainState, batch, closed=None) -> None:
         """Lint the train step's jaxpr (trace-only rules) and emit the
         result as a ``graphlint`` event. Telemetry contract: never takes
         the training loop down — a lint failure is an event, an analysis
@@ -278,6 +297,7 @@ class Trainer:
                 rules=self.config.graphlint_rules,
                 allow=self.config.graphlint_allow,
                 name="train_step",
+                closed_jaxpr=closed,
             )
             events.emit(
                 "graphlint",
@@ -292,6 +312,33 @@ class Trainer:
         except Exception as e:  # noqa: BLE001 — lint must not kill training
             warnings.warn(f"graphlint failed on the train step: {e}")
             events.emit("graphlint", step=int(state.step), error=str(e))
+
+    def _graphcheck(self, events: EventLog, state: TrainState, batch, closed=None) -> None:
+        """Emit the trace-level fingerprint of the train step as a
+        ``graphcheck`` event (same never-kills-training contract as
+        :meth:`_graphlint`; trace-only — no compile)."""
+        import warnings
+
+        try:
+            from perceiver_io_tpu.analysis.fingerprint import fingerprint
+
+            fp = fingerprint(
+                self._lint_step, (state, batch), name="train_step", compiled=False,
+                closed_jaxpr=closed,
+            )
+            events.emit(
+                "graphcheck",
+                step=int(state.step),
+                name=fp.name,
+                n_ops=fp.n_ops,
+                features=list(fp.features),
+                hot_concats=[dict(c) for c in fp.hot_concats[:20]],
+                captured_const_bytes=fp.captured_const_bytes,
+                dtype_histogram=fp.dtype_histogram,
+            )
+        except Exception as e:  # noqa: BLE001 — telemetry must not kill training
+            warnings.warn(f"graphcheck failed on the train step: {e}")
+            events.emit("graphcheck", step=int(state.step), error=str(e))
 
     # -- API --------------------------------------------------------------
 
@@ -471,7 +518,7 @@ class Trainer:
             # subtraction must not mix monotonic and wall (NTP-steppable) time
             t0 = time.perf_counter()
             window_overhead0 = goodput.overhead()
-            lint_pending = events is not None and cfg.graphlint
+            lint_pending = events is not None and (cfg.graphlint or cfg.graphcheck)
             try:
                 i = start_step
                 while i < cfg.max_steps:
@@ -499,7 +546,15 @@ class Trainer:
                     if lint_pending:
                         lint_pending = False
                         with goodput.measure("graphlint"):
-                            self._graphlint(events, state, batch)
+                            closed = (
+                                self._shared_lint_trace(state, batch)
+                                if cfg.graphlint and cfg.graphcheck
+                                else None
+                            )
+                            if cfg.graphlint:
+                                self._graphlint(events, state, batch, closed)
+                            if cfg.graphcheck:
+                                self._graphcheck(events, state, batch, closed)
                     state, metrics = self._train_step(state, batch)
                     if cfg.input_double_buffer and i + 1 < cfg.max_steps:
                         # the step above is dispatched asynchronously: issue

@@ -83,3 +83,47 @@ def donation_safe() -> bool:
     import jax
 
     return jax.default_backend() != "cpu"
+
+
+def respawn_cli_with_virtual_devices(n_devices: int, script: str, guard_env: str) -> None:
+    """Re-exec a CLI ``script`` in a subprocess that provisions ``n_devices``
+    virtual CPU devices, forwarding ``sys.argv[1:]``; no-op when enough
+    devices are already visible. Shared by tools/graphlint.py and
+    tools/graphcheck.py (``__graft_entry__`` keeps its own function-target
+    variant).
+
+    The env-var route alone does not survive this environment: a
+    sitecustomize imports jax at interpreter startup and the axon TPU
+    plugin presets JAX_PLATFORMS, so the child must set XLA_FLAGS before
+    backend init AND force the platform via jax.config. ``guard_env`` marks
+    the child so a failed provision raises instead of respawning forever.
+    Raises ``SystemExit`` with the child's return code after it runs."""
+    import os
+    import re
+    import subprocess
+    import sys
+
+    import jax
+
+    if len(jax.devices()) >= n_devices:
+        return
+    if os.environ.get(guard_env):
+        raise RuntimeError(
+            f"already respawned once but still see {len(jax.devices())} devices "
+            f"(< {n_devices}); virtual CPU device provisioning did not take effect"
+        )
+    script = os.path.abspath(script)
+    repo = os.path.dirname(os.path.dirname(script))
+    bootstrap = (
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        f"import sys; sys.path.insert(0, {repo!r})\n"
+        f"sys.argv = [{script!r}] + {sys.argv[1:]!r}\n"
+        f"import runpy; runpy.run_path({script!r}, run_name='__main__')\n"
+    )
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env[guard_env] = "1"
+    flags = re.sub(r"--xla_force_host_platform_device_count=\S+", "", env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = (flags + f" --xla_force_host_platform_device_count={n_devices}").strip()
+    raise SystemExit(subprocess.call([sys.executable, "-c", bootstrap], env=env))

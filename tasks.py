@@ -10,6 +10,7 @@ equivalent with the same task names:
     python tasks.py docker [--tag TAG]
     python tasks.py bench [...args]    # the driver benchmark (real chip)
     python tasks.py graphlint [...]    # static-analysis gate (compiled graphs)
+    python tasks.py perf [...]         # perf CI: graphcheck contracts + graphlint + bench floors
     python tasks.py dryrun [...]       # 8-virtual-device multichip certification
     python tasks.py chaos [...]        # fault-injection gate (preempt/NaN/torn-save)
 """
@@ -146,6 +147,16 @@ def graphlint(args):
     """Static-analysis gate over the flagship compiled graphs
     (tools/graphlint.py; docs/static-analysis.md)."""
     run(sys.executable, "tools/graphlint.py", "--fail-on", "error", *args.rest)
+
+
+@task
+def perf(args):
+    """The standing perf-CI gate (docs/static-analysis.md): graphcheck —
+    compiled-graph contracts vs contracts/, graduation-ledger validation,
+    committed-bench floors — then the graphlint rule gate. Extra args go to
+    tools/graphcheck.py (e.g. ``--programs train_flat,decode``)."""
+    run(sys.executable, "tools/graphcheck.py", *args.rest)
+    run(sys.executable, "tools/graphlint.py", "--fail-on", "error")
 
 
 def main(argv=None):

@@ -281,6 +281,124 @@ def test_collective_budget_clean_within_budget_and_total_form():
     assert len(report.violations) == 1 and "total budget" in report.violations[0].message
 
 
+# --------------------------------------------------------- peak-memory-budget
+
+
+def test_peak_memory_budget_fires_over_budget():
+    def planted(x):
+        return (x @ x.T).sum()  # 512x512 f32 temp = 1 MB
+
+    x = jnp.ones((512, 128))
+    report = analysis.check(
+        planted, (x,), rules=("peak-memory-budget",),
+        policy=LintPolicy(peak_memory_budget_bytes=64 << 10),
+    )
+    assert [v.rule for v in report.violations] == ["peak-memory-budget"]
+    assert "MB" in report.violations[0].message and not report.ok()
+
+
+def test_peak_memory_budget_clean_within_budget_and_skipped_undeclared():
+    def fn(x):
+        return (x @ x.T).sum()
+
+    x = jnp.ones((512, 128))
+    assert analysis.check(
+        fn, (x,), rules=("peak-memory-budget",),
+        policy=LintPolicy(peak_memory_budget_bytes=64 << 20),
+    ).clean
+    report = analysis.check(fn, (x,), rules=("peak-memory-budget",))
+    assert report.rules_skipped == ("peak-memory-budget",)
+
+
+# ----------------------------------------------------- replicated-large-tensor
+
+
+def _mesh_2x4():
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("data", "fsdp"))
+
+
+def _partitioned_matmul(w_spec):
+    """x @ a with ``a`` placed by ``w_spec`` over a data x fsdp mesh — the
+    compiled module is partitioned (num_partitions=8), so replication of
+    ``a`` is a real per-device HBM choice."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _mesh_2x4()
+    a = jax.device_put(jnp.ones((512, 512)), NamedSharding(mesh, w_spec))  # 1 MB f32
+    x = jax.device_put(jnp.ones((8, 512)), NamedSharding(mesh, P("data")))
+    return jax.jit(lambda x, a: (x @ a).sum()), (x, a)
+
+
+def test_replicated_large_tensor_fires_on_replicated_weight():
+    from jax.sharding import PartitionSpec as P
+
+    fn, args = _partitioned_matmul(P())  # fully replicated
+    report = analysis.check(
+        fn, args, rules=("replicated-large-tensor",),
+        policy=LintPolicy(replicated_bytes_limit=1 << 20),
+    )
+    assert [v.rule for v in report.violations] == ["replicated-large-tensor"]
+    assert "replicated" in report.violations[0].message
+
+
+def test_replicated_large_tensor_clean_when_sharded_or_small_or_unpartitioned():
+    from jax.sharding import PartitionSpec as P
+
+    fn, args = _partitioned_matmul(P("fsdp"))  # sharded over fsdp: fine
+    policy = LintPolicy(replicated_bytes_limit=1 << 20)
+    assert analysis.check(fn, args, rules=("replicated-large-tensor",), policy=policy).clean
+
+    fn, args = _partitioned_matmul(P())  # replicated but UNDER the limit
+    assert analysis.check(
+        fn, args, rules=("replicated-large-tensor",),
+        policy=LintPolicy(replicated_bytes_limit=16 << 20),
+    ).clean
+
+    # single-device module: replication is not a choice — never fires
+    plain = jax.jit(lambda x: (x @ jnp.ones((512, 512))).sum())
+    assert analysis.check(
+        plain, (jnp.ones((8, 512)),), rules=("replicated-large-tensor",), policy=policy
+    ).clean
+
+
+# ------------------------------------------------------------ implicit-reshard
+
+
+def _ppermute_fn():
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from perceiver_io_tpu.utils.compat import shard_map
+
+    n = len(jax.devices())
+    mesh = Mesh(np.array(jax.devices()).reshape(-1), ("x",))
+    fn = shard_map(
+        lambda x: jax.lax.ppermute(x, "x", [(i, (i + 1) % n) for i in range(n)]),
+        mesh=mesh, in_specs=P("x"), out_specs=P("x"),
+    )
+    return jax.jit(fn), (jnp.ones((n, 4)),)
+
+
+def test_implicit_reshard_fires_on_unbudgeted_permute():
+    fn, args = _ppermute_fn()
+    report = analysis.check(
+        fn, args, rules=("implicit-reshard",), policy=LintPolicy(reshard_budget={})
+    )
+    assert [v.op for v in report.violations] == ["collective-permute"]
+    assert "reshard" in report.violations[0].message
+
+
+def test_implicit_reshard_clean_within_budget_and_skipped_undeclared():
+    fn, args = _ppermute_fn()
+    assert analysis.check(
+        fn, args, rules=("implicit-reshard",),
+        policy=LintPolicy(reshard_budget={"collective-permute": 8}),
+    ).clean
+    report = analysis.check(fn, args, rules=("implicit-reshard",))
+    assert report.rules_skipped == ("implicit-reshard",)
+
+
 # ----------------------------------------------------- allowlist + report API
 
 
@@ -298,6 +416,47 @@ def test_allowlist_by_rule_and_by_scope_key():
         fn, args, rules=("hot-concat",), allow=("hot-concat:*decode*",)
     )
     assert not miss.clean and not miss.allowed
+
+
+def test_allowlist_scope_separator_patterns():
+    """fnmatch '*' crosses '/' — a pattern anchored at a scope-path TAIL
+    (``*/kv_concat``-style) matches the site at any nesting depth, while a
+    tail mismatch stays a violation (the DEFAULT_ALLOW entries in
+    analysis/flagship.py rely on exactly this)."""
+
+    def nested(a, b):
+        with jax.named_scope("cross_attend"):
+            with jax.named_scope("kv_concat"):
+                return jnp.concatenate([a, b], axis=1).sum()
+
+    args = (_A, _B)
+    report = analysis.check(nested, args, rules=("hot-concat",))
+    assert [v.scope for v in report.violations] == ["cross_attend/kv_concat"]
+
+    # tail-anchored: any nesting above the labeled site
+    tail = analysis.check(nested, args, rules=("hot-concat",), allow=("*/kv_concat",))
+    assert tail.clean and len(tail.allowed) == 1
+
+    # rule-qualified with a separator inside the scope part
+    qualified = analysis.check(
+        nested, args, rules=("hot-concat",), allow=("hot-concat:*/kv_concat",)
+    )
+    assert qualified.clean and len(qualified.allowed) == 1
+
+    # a DIFFERENT tail does not match — the separator is load-bearing
+    miss = analysis.check(nested, args, rules=("hot-concat",), allow=("*/q_concat",))
+    assert not miss.clean and not miss.allowed
+
+    # the site WITHOUT an enclosing scope: '*/kv_concat' requires a parent
+    def flat(a, b):
+        with jax.named_scope("kv_concat"):
+            return jnp.concatenate([a, b], axis=1).sum()
+
+    top = analysis.check(flat, args, rules=("hot-concat",), allow=("*/kv_concat",))
+    assert not top.clean, "tail pattern must not match a parentless scope"
+    assert analysis.check(
+        flat, args, rules=("hot-concat",), allow=("*kv_concat",)
+    ).clean
 
 
 def test_unknown_rule_raises():
@@ -370,6 +529,12 @@ def test_trainer_emits_graphlint_event_with_planted_const(tmp_path):
     assert len(gl) == 1, "exactly one graphlint event per fit"
     assert gl[0]["ok"] is False and gl[0]["counts"]["error"] >= 1
     assert any(v["rule"] == "const-capture" for v in gl[0]["violations"])
+    # the trace-level fingerprint rides alongside as a graphcheck event —
+    # the planted 160 KB const shows up in its captured-const bytes
+    gc = [e for e in events if e["event"] == "graphcheck"]
+    assert len(gc) == 1, "exactly one graphcheck event per fit"
+    assert gc[0]["captured_const_bytes"] >= 160_000
+    assert gc[0]["n_ops"] >= 1 and "dtype_histogram" in gc[0]
 
 
 def test_trainer_graphlint_off_emits_nothing(tmp_path):
@@ -387,11 +552,13 @@ def test_trainer_graphlint_off_emits_nothing(tmp_path):
     )
     logger = MetricsLogger(str(tmp_path), use_tensorboard=False)
     trainer = Trainer(
-        loss_fn, config=TrainerConfig(max_steps=1, log_interval=1, graphlint=False), logger=logger
+        loss_fn,
+        config=TrainerConfig(max_steps=1, log_interval=1, graphlint=False, graphcheck=False),
+        logger=logger,
     )
     trainer.fit(state, iter([{"x": jnp.ones((2, 8))}] * 2))
     events = [json.loads(l) for l in open(os.path.join(str(tmp_path), "events.jsonl"))]
-    assert not [e for e in events if e["event"] == "graphlint"]
+    assert not [e for e in events if e["event"] in ("graphlint", "graphcheck")]
 
 
 # ------------------------------------------------------- flagship smoke (CPU)

@@ -1,0 +1,87 @@
+"""Committed-artifact schema pins: BENCH_*.json, contracts/*.json and
+contracts/ledger.json must stay machine-readable — the re-anchor reviewer,
+the bench-floor gate and graphcheck all parse them, and a malformed artifact
+should fail tier-1 here instead of confusing the next round."""
+
+import glob
+import json
+import os
+import re
+
+from perceiver_io_tpu.analysis.fingerprint import PROGRAMS, validate_contract
+from perceiver_io_tpu.analysis.ledger import validate_ledger
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CONTRACTS = os.path.join(REPO, "contracts")
+
+_ROUND_RE = re.compile(r"_r(\d+)\.json$")
+
+
+def _rounds(pattern):
+    out = {}
+    for path in sorted(glob.glob(os.path.join(REPO, pattern))):
+        m = _ROUND_RE.search(path)
+        assert m, f"{os.path.basename(path)} must end in _r<round>.json"
+        out[int(m.group(1))] = path
+    return out
+
+
+def test_bench_rounds_monotone_and_well_formed():
+    rounds = _rounds("BENCH_r*.json")
+    assert rounds, "no BENCH_r*.json artifacts committed"
+    # contiguous monotone numbering from round 1: a skipped or duplicated
+    # round breaks the floor gate's latest-artifact resolution
+    assert sorted(rounds) == list(range(1, max(rounds) + 1)), sorted(rounds)
+    for n, path in rounds.items():
+        doc = json.load(open(path))
+        base = os.path.basename(path)
+        for key, typ in (("n", int), ("cmd", str), ("rc", int), ("tail", str)):
+            assert isinstance(doc.get(key), typ), f"{base}: {key} must be {typ.__name__}"
+        assert doc["n"] == n, f"{base}: field n={doc['n']} != filename round {n}"
+        if doc.get("parsed") is not None:
+            parsed = doc["parsed"]
+            assert isinstance(parsed.get("metric"), str), base
+            assert isinstance(parsed.get("value"), (int, float)), base
+            assert isinstance(parsed.get("unit"), str), base
+
+
+def test_bench_extra_rounds_well_formed():
+    rounds = _rounds("BENCH_extra_r*.json")
+    for n, path in rounds.items():
+        base = os.path.basename(path)
+        doc = json.load(open(path))
+        assert isinstance(doc, dict) and doc, base
+        for name, entry in doc.items():
+            assert isinstance(entry, dict), f"{base}:{name}"
+            assert isinstance(entry.get("metric"), str), f"{base}:{name}"
+            assert isinstance(entry.get("value"), (int, float)), f"{base}:{name}"
+            assert isinstance(entry.get("unit"), str), f"{base}:{name}"
+
+
+def test_contract_files_validate_against_schema():
+    paths = sorted(glob.glob(os.path.join(CONTRACTS, "*.json")))
+    program_files = [p for p in paths if os.path.basename(p) != "ledger.json"]
+    assert program_files, "no program contracts committed under contracts/"
+    seen = set()
+    for path in program_files:
+        base = os.path.basename(path)
+        doc = json.load(open(path))
+        problems = validate_contract(doc)
+        assert problems == [], f"{base}: {problems}"
+        stem = base[: -len(".json")]
+        assert doc["program"] == stem, f"{base}: program field must match filename"
+        assert stem in PROGRAMS, f"{base}: unknown program (known: {PROGRAMS})"
+        assert doc["updated_reason"].strip(), f"{base}: empty updated_reason"
+        seen.add(stem)
+    # every flagship program is under contract — a dropped file would
+    # silently shrink the gate
+    assert seen == set(PROGRAMS), f"contracts cover {sorted(seen)}, want {sorted(PROGRAMS)}"
+
+
+def test_ledger_validates_and_cites_existing_artifacts():
+    doc = json.load(open(os.path.join(CONTRACTS, "ledger.json")))
+    assert validate_ledger(doc) == []
+    for name, floor in doc.get("floors", {}).items():
+        assert glob.glob(os.path.join(REPO, floor["artifact"])), (
+            f"floor {name} cites artifact pattern {floor['artifact']!r} with no match"
+        )

@@ -19,6 +19,10 @@ bucket plan; ``--overlap off`` lints the GSPMD step instead. When the host
 has fewer devices than the mesh needs, the CLI re-execs itself with that
 many virtual CPU devices (the __graft_entry__ dryrun trick).
 
+Exit codes: 0 — no violation at/above ``--fail-on``; 1 — violations found;
+3 — a rule or target build CRASHED (the lint itself is broken, which CI
+must not confuse with either verdict).
+
 Rule catalog and allowlist syntax: docs/static-analysis.md.
 """
 
@@ -26,43 +30,15 @@ from __future__ import annotations
 
 import argparse
 import json
-import os
-import re
 import sys
 
 
 def _ensure_devices(n: int) -> None:
-    """Re-exec with ``n`` virtual CPU devices when fewer are visible.
+    """Re-exec with ``n`` virtual CPU devices when fewer are visible
+    (shared respawn: utils/compat.respawn_cli_with_virtual_devices)."""
+    from perceiver_io_tpu.utils.compat import respawn_cli_with_virtual_devices
 
-    Mirrors ``__graft_entry__._respawn_with_virtual_devices``: XLA_FLAGS must
-    be set before backend init and the platform forced via jax.config (the
-    axon plugin presets JAX_PLATFORMS)."""
-    import subprocess
-
-    import jax
-
-    if len(jax.devices()) >= n:
-        return
-    if os.environ.get("_GRAPHLINT_RESPAWNED"):
-        raise RuntimeError(
-            f"already respawned once but still see {len(jax.devices())} devices "
-            f"(< {n}); virtual CPU device provisioning did not take effect"
-        )
-    script = os.path.abspath(__file__)
-    repo = os.path.dirname(os.path.dirname(script))
-    bootstrap = (
-        "import jax\n"
-        "jax.config.update('jax_platforms', 'cpu')\n"
-        f"import sys; sys.path.insert(0, {repo!r})\n"
-        f"sys.argv = [{script!r}] + {sys.argv[1:]!r}\n"
-        f"import runpy; runpy.run_path({script!r}, run_name='__main__')\n"
-    )
-    env = dict(os.environ)
-    env.pop("JAX_PLATFORMS", None)
-    env["_GRAPHLINT_RESPAWNED"] = "1"
-    flags = re.sub(r"--xla_force_host_platform_device_count=\S+", "", env.get("XLA_FLAGS", ""))
-    env["XLA_FLAGS"] = (flags + f" --xla_force_host_platform_device_count={n}").strip()
-    raise SystemExit(subprocess.call([sys.executable, "-c", bootstrap], env=env))
+    respawn_cli_with_virtual_devices(n, __file__, "_GRAPHLINT_RESPAWNED")
 
 
 def main(argv=None) -> int:
@@ -129,17 +105,27 @@ def main(argv=None) -> int:
         }.get(args.kernel_features, tuple(f for f in args.kernel_features.split(",") if f))
 
     budget = json.loads(args.collective_budget) if args.collective_budget else None
-    reports = lint_flagship(
-        geometry=args.geometry,
-        targets=tuple(t for t in args.targets.split(",") if t),
-        rules=tuple(args.rules.split(",")) if args.rules else None,
-        allow=tuple(args.allow),
-        compiled=args.compiled,
-        collective_budget=budget,
-        features=features,
-        mesh=mesh,
-        overlap=args.overlap == "on",
-    )
+    try:
+        reports = lint_flagship(
+            geometry=args.geometry,
+            targets=tuple(t for t in args.targets.split(",") if t),
+            rules=tuple(args.rules.split(",")) if args.rules else None,
+            allow=tuple(args.allow),
+            compiled=args.compiled,
+            collective_budget=budget,
+            features=features,
+            mesh=mesh,
+            overlap=args.overlap == "on",
+        )
+    except Exception as e:  # noqa: BLE001 — a rule/build CRASH is not a verdict
+        # exit 3, distinct from 1 (violations found): CI must not read "the
+        # linter itself broke" as "the graph got worse" — or, with
+        # --fail-on none, as a pass
+        import traceback
+
+        traceback.print_exc()
+        print(f"graphlint ERROR (rule or target build crashed): {e}")
+        return 3
 
     for report in reports.values():
         print(report.format())
