@@ -698,7 +698,9 @@ def main():
     p.add_argument("--skip-graphlint", action="store_true",
                    help="skip the static-analysis gate over the flagship "
                         "train/decode graphs (analysis/, tools/graphlint.py; "
-                        "runs by default in every mode)")
+                        "includes the dataflow rules — rng-key-reuse, "
+                        "dead-compute, cross-program-consistency — armed by "
+                        "the flagship policies; runs by default in every mode)")
     p.add_argument("--skip-graphcheck", action="store_true",
                    help="skip the compiled-graph contract diff against "
                         "contracts/ (analysis/fingerprint.py, "

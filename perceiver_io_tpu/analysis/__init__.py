@@ -14,11 +14,30 @@ lowered/compiled HLO of any jitted function against declared intent:
 
 Entry points: :func:`check` (pytest/programmatic), ``tools/graphlint.py``
 (CLI over the flagship functions), the trainer's ``graphlint`` event
-(obs/events.py) and bench.py's ``telemetry.graphlint`` block. Rule catalog
-and allowlist syntax: docs/static-analysis.md.
+(obs/events.py) and bench.py's ``telemetry.graphlint`` block. On top of
+the scope/shape rules, :mod:`dataflow` adds a def-use/provenance engine
+(value threading through pjit/scan/cond/shard_map/custom_vjp bodies) and
+the four dataflow rules — ``rng-key-reuse``, ``dead-compute``,
+``sharding-flow``, ``cross-program-consistency``. Rule catalog and
+allowlist syntax: docs/static-analysis.md.
 """
 
 from perceiver_io_tpu.analysis.check import GraphLintError, Report, check
+from perceiver_io_tpu.analysis.dataflow import (
+    CacheSite,
+    Dataflow,
+    DfNode,
+    DfValue,
+    ReplicatedKeyFinding,
+    ReuseFinding,
+    ShardingConflict,
+    analyze,
+    build,
+    cache_sites,
+    propagate_shardings,
+    replicated_key_findings,
+    rng_reuse_findings,
+)
 from perceiver_io_tpu.analysis.fingerprint import (
     DiffTolerances,
     FingerprintDiff,
@@ -37,11 +56,31 @@ from perceiver_io_tpu.analysis.graph import (
     trace,
 )
 from perceiver_io_tpu.analysis.memory import MemoryBreakdown, memory_breakdown
-from perceiver_io_tpu.analysis.rules import RULES, LintPolicy, Violation, register_rule
+from perceiver_io_tpu.analysis.rules import (
+    RULES,
+    CompanionProgram,
+    LintPolicy,
+    Violation,
+    register_rule,
+)
 
 __all__ = [
     "AvalInfo",
+    "CacheSite",
+    "CompanionProgram",
     "ConstInfo",
+    "Dataflow",
+    "DfNode",
+    "DfValue",
+    "ReplicatedKeyFinding",
+    "ReuseFinding",
+    "ShardingConflict",
+    "analyze",
+    "build",
+    "cache_sites",
+    "propagate_shardings",
+    "replicated_key_findings",
+    "rng_reuse_findings",
     "DiffTolerances",
     "FingerprintDiff",
     "GraphFingerprint",

@@ -139,6 +139,10 @@ def check(
         module once the rule runs, but pjit does not expose them before
         lowering (jax 0.4.37) — to audit such a fn without policy hints,
         pass ``compiled=True`` (or declare ``expect_donation=True``).
+        The dataflow rules (``rng-key-reuse``, ``dead-compute``,
+        ``sharding-flow``, ``cross-program-consistency``) are jaxpr-level
+        but policy-gated the same way: they run only when their policy
+        inputs are declared and otherwise land in ``rules_skipped``.
     :param name: label for reports (default: the function's ``__name__``).
     :param closed_jaxpr: a pre-traced ``ClosedJaxpr`` of ``fn(*args)`` to
         reuse (callers that also :func:`~perceiver_io_tpu.analysis.
@@ -181,6 +185,19 @@ def check(
             return policy.reshard_budget is not None
         return True
 
+    # jaxpr-level rules that are policy-gated like the compiled trio: they
+    # surface in rules_skipped when unarmed instead of silently running empty
+    def jaxpr_inputs_declared(rule_name: str) -> bool:
+        if rule_name == "rng-key-reuse":
+            return policy.check_rng
+        if rule_name == "dead-compute":
+            return policy.dead_compute_min_flops is not None
+        if rule_name == "sharding-flow":
+            return policy.sharding_flow is not None and policy.sharding_flow is not False
+        if rule_name == "cross-program-consistency":
+            return policy.companion is not None
+        return True
+
     run: List[str] = []
     skipped: List[str] = []
     raw: List[Violation] = []
@@ -191,6 +208,9 @@ def check(
             if not want:
                 skipped.append(rname)
                 continue
+        elif not jaxpr_inputs_declared(rname):
+            skipped.append(rname)
+            continue
         raw.extend(rule.fn(ctx))
         run.append(rname)
 

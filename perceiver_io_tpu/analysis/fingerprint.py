@@ -28,7 +28,6 @@ contract* (re-snapshot with ``--update --reason``), not a regression.
 
 from __future__ import annotations
 
-import contextlib
 import dataclasses
 import json
 import os
@@ -40,9 +39,10 @@ from perceiver_io_tpu.analysis.memory import memory_breakdown
 FINGERPRINT_SCHEMA_VERSION = 1
 
 # the flagship programs graphcheck snapshots; the sharded pair runs on the
-# DEFAULT_MESH_SPEC submesh (tools/graphcheck.py provisions virtual devices)
-PROGRAMS = ("train_flat", "train_sharded", "train_overlap", "prefill", "decode")
-DEFAULT_MESH_SPEC = "data=2,fsdp=2"
+# DEFAULT_MESH_SPEC submesh (tools/graphcheck.py provisions virtual devices).
+# Canonical definition lives in flagship.py (build_programs builds them for
+# BOTH the lint gate and these contracts); re-exported here for the CLIs.
+from perceiver_io_tpu.analysis.flagship import DEFAULT_MESH_SPEC, PROGRAMS  # noqa: E402
 
 
 @dataclasses.dataclass
@@ -527,43 +527,13 @@ def flagship_fingerprints(
     (``train_sharded`` GSPMD, ``train_overlap`` explicit shard_map) needs
     the ``mesh_spec`` submesh worth of devices — tools/graphcheck.py
     provisions virtual CPU devices when the host is short."""
-    from perceiver_io_tpu.analysis.flagship import build_targets
-    from perceiver_io_tpu.ops.flash_attention import default_flash, fast_kernels
+    from perceiver_io_tpu.analysis.flagship import build_programs, features_context
 
-    unknown = [p for p in programs if p not in PROGRAMS]
-    if unknown:
-        raise ValueError(f"unknown program(s) {unknown}; known: {PROGRAMS}")
-
-    if features is not None:
-        ctx: contextlib.AbstractContextManager = contextlib.ExitStack()
-        ctx.enter_context(default_flash(True))
-        ctx.enter_context(fast_kernels(set(features)))
-    else:
-        ctx = contextlib.nullcontext()
-
-    out: Dict[str, GraphFingerprint] = {}
-    with ctx:
-        flat = [p for p in ("train_flat", "prefill", "decode") if p in programs]
-        if flat:
-            targets = build_targets(
-                geometry,
-                targets=tuple({"train_flat": "train"}.get(p, p) for p in flat),
-            )
-            for p in flat:
-                t = targets[{"train_flat": "train"}.get(p, p)]
-                out[p] = fingerprint(t.fn, t.args, name=p)
-        sharded = [p for p in ("train_sharded", "train_overlap") if p in programs]
-        if sharded:
-            from perceiver_io_tpu.parallel.overlap import mesh_from_spec
-
-            mesh = mesh_from_spec(mesh_spec)
-            for p in sharded:
-                t = build_targets(
-                    geometry, targets=("train",), mesh=mesh,
-                    overlap=(p == "train_overlap"),
-                )["train"]
-                out[p] = fingerprint(t.fn, t.args, name=p)
-    return out
+    with features_context(features):
+        built = build_programs(programs, geometry=geometry, mesh_spec=mesh_spec)
+        return {
+            p: fingerprint(built[p].fn, built[p].args, name=p) for p in programs
+        }
 
 
 def check_contracts(

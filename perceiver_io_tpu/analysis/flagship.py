@@ -5,7 +5,13 @@ bench.py measure).
 ``tools/graphlint.py`` (CLI), bench.py's ``telemetry.graphlint`` block and
 ``tests/test_analysis.py``'s real-graph smoke all build the SAME functions
 through :func:`build_targets`, so the lint gate and the measured program
-can't drift apart. Geometries:
+can't drift apart; :func:`build_programs` extends that to the five
+graphcheck programs (adding the GSPMD and overlap-scheduled sharded train
+steps), shared by ``analysis/fingerprint.py``'s contracts and the dataflow
+rule gate (``tools/graphlint.py --programs all``, ``tasks.py perf``). The
+per-target policies arm the dataflow rules — rng-key-reuse and
+dead-compute everywhere, sharding-flow on the sharded steps, the decode ↔
+prefill cross-program companion. Geometries:
 
 - ``micro`` — the flagship architecture at toy sizes (same op structure,
   same scopes, seconds to compile on CPU). Graph-shape rules are geometry-
@@ -18,16 +24,22 @@ can't drift apart. Geometries:
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Dict, Optional, Sequence, Tuple
 
 from perceiver_io_tpu.analysis.check import Report, check
-from perceiver_io_tpu.analysis.rules import LintPolicy
+from perceiver_io_tpu.analysis.rules import CompanionProgram, LintPolicy
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # the known-good allowlist for DEFAULT kernel features:
 # - kv_concat: the concat prefix route (core/modules.py CrossAttention
 #   "kv_concat" scope) is the default until twoseg graduates from its
 #   staged A/B (PR 2, docs/performance.md) — under features=("twoseg",)
-#   the scope disappears from the trace entirely, which is the point;
+#   the scope disappears from the trace entirely, which is the point.
+#   This entry is LEDGER-DERIVED: :func:`default_allow` drops it the moment
+#   contracts/ledger.json moves twoseg to default_on, so graduation flips
+#   the allowlist in the same commit that flips the contract;
 # - perceiver_ar._attend: the RoPE frequency-table [prefix; latents]
 #   concat — a true sequence-axis concat, but of a (B, N, head_dim/2)
 #   table (~1 MB f32 at 16k vs the kv build's 64 MB), reviewed and accepted
@@ -35,6 +47,47 @@ DEFAULT_ALLOW: Tuple[str, ...] = (
     "hot-concat:*kv_concat*",
     "hot-concat:*perceiver_ar._attend",
 )
+
+# dead-compute threshold for the flagship policies: a dead matmul-class op
+# at/over 1 MFLOP is real lost work; smaller strays aggregate as warn/info
+DEAD_COMPUTE_MIN_FLOPS = 1 << 20
+
+
+def features_context(features: Optional[Sequence[str]]):
+    """The trace-time kernel feature context shared by every flagship
+    entry point (lint, the five-program gate, graphcheck fingerprints):
+    an explicit feature set also forces the flash routes on — feature sets
+    only exist there, and flash auto-enables on TPU only, so the traced
+    graph matches the TPU program the set actually changes. ``None`` keeps
+    the ambient/default kernels."""
+    import contextlib
+
+    from perceiver_io_tpu.ops.flash_attention import default_flash, fast_kernels
+
+    if features is None:
+        return contextlib.nullcontext()
+    ctx = contextlib.ExitStack()
+    ctx.enter_context(default_flash(True))
+    ctx.enter_context(fast_kernels(set(features)))
+    return ctx
+
+
+def default_allow(contracts_dir: Optional[str] = None) -> Tuple[str, ...]:
+    """The flagship allowlist under CURRENT ledger state: the ``kv_concat``
+    entry exists only while ``twoseg`` is not ``default_on`` in
+    ``contracts/ledger.json`` — once the feature graduates, the concat
+    route is no longer the shipped graph and allowlisting it would mask a
+    regression. Falls back to :data:`DEFAULT_ALLOW` when no ledger exists."""
+    from perceiver_io_tpu.analysis.ledger import default_on_features, load_ledger
+
+    contracts_dir = contracts_dir or os.path.join(_REPO_ROOT, "contracts")
+    try:
+        feats = default_on_features(load_ledger(contracts_dir))
+    except Exception:  # noqa: BLE001 — an unreadable ledger keeps the defaults
+        feats = ()
+    return tuple(
+        a for a in DEFAULT_ALLOW if not ("kv_concat" in a and "twoseg" in feats)
+    )
 
 GEOMETRIES = {
     # same architecture/op structure as the flagship, toy sizes; latents
@@ -124,6 +177,10 @@ def build_targets(
     # kernels' f32 score/accumulator islands are deliberate numerics and
     # live outside these scopes
     bf16_scopes = ("*qkv_proj*",) if dtype == jnp.bfloat16 else ()
+    # the dataflow rules run on every flagship target: RNG hygiene and dead
+    # compute are program-shape properties, not geometry or mesh properties
+    dataflow_policy = dict(check_rng=True, dead_compute_min_flops=DEAD_COMPUTE_MIN_FLOPS)
+    allow = default_allow()
 
     out: Dict[str, LintTarget] = {}
     if "train" in targets:
@@ -149,6 +206,7 @@ def build_targets(
                 # donation (and utils/compat.py deliberately drops it there)
                 expect_donation=backend != "cpu",
                 collective_budget=collective_budget,
+                **dataflow_policy,
             )
         else:
             from perceiver_io_tpu.parallel.mesh import shard_batch
@@ -192,28 +250,40 @@ def build_targets(
                 expect_donation=backend != "cpu",
                 expect_overlap=overlap,
                 collective_budget=budget,
+                # the sharded step's args carry committed NamedShardings —
+                # propagate them and predict GSPMD reshard points pre-compile
+                # (the GSPMD microbatch chunk slices along the data-sharded
+                # batch axis are REAL permutes — see train_sharded's
+                # contract — reported at warn severity, not gated)
+                sharding_flow=True,
+                **dataflow_policy,
             )
         out["train"] = LintTarget(
             name="train_step",
             fn=step,
             args=(state, batch),
             policy=policy,
-            allow=DEFAULT_ALLOW,
+            allow=allow,
         )
 
     if "prefill" in targets or "decode" in targets:
         from perceiver_io_tpu.generation import GenerationConfig, make_generate_fn
 
         prompt = jnp.asarray(rng.integers(0, config.vocab_size, size=(b, n)))
-        for tgt, new_tokens in (("prefill", 1), ("decode", g["decode_tokens"])):
-            if tgt not in targets:
-                continue
-            fn = make_generate_fn(
+        fns = {
+            tgt: make_generate_fn(
                 model,
                 g["latents"],
                 GenerationConfig(max_new_tokens=new_tokens, do_sample=True, top_k=10),
                 cache_dtype=dtype,
             )
+            # the prefill fn is always built: it is the decode target's
+            # cross-program companion even when only decode is linted
+            for tgt, new_tokens in (("prefill", 1), ("decode", g["decode_tokens"]))
+        }
+        for tgt, fn in fns.items():
+            if tgt not in targets:
+                continue
             out[tgt] = LintTarget(
                 name=tgt,
                 fn=fn,
@@ -221,8 +291,17 @@ def build_targets(
                 policy=LintPolicy(
                     bf16_scopes=bf16_scopes,
                     collective_budget=collective_budget,
+                    # the static guard ROADMAP item 4's cache interface is
+                    # held to: decode must agree with prefill on KV-cache
+                    # layout, dtype and append-index provenance
+                    companion=(
+                        CompanionProgram("prefill", fns["prefill"], (params, prompt))
+                        if tgt == "decode"
+                        else None
+                    ),
+                    **dataflow_policy,
                 ),
-                allow=DEFAULT_ALLOW,
+                allow=allow,
             )
     return out
 
@@ -250,17 +329,7 @@ def lint_flagship(
     so an explicit ``features`` also forces flash on (interpret-capable
     trace off-TPU), making the linted graph match the TPU program the
     feature set actually changes."""
-    import contextlib
-
-    from perceiver_io_tpu.ops.flash_attention import default_flash, fast_kernels
-
-    if features is not None:
-        ctx: contextlib.AbstractContextManager = contextlib.ExitStack()
-        ctx.enter_context(default_flash(True))
-        ctx.enter_context(fast_kernels(set(features)))
-    else:
-        ctx = contextlib.nullcontext()
-    with ctx:
+    with features_context(features):
         built = build_targets(
             geometry, targets, collective_budget=collective_budget, mesh=mesh, overlap=overlap
         )
@@ -275,6 +344,76 @@ def lint_flagship(
                 name=t.name,
             )
             for key, t in built.items()
+        }
+
+
+# the five flagship programs graphcheck snapshots and the dataflow rules
+# gate (tasks.py perf): flat train, the GSPMD and overlap-scheduled sharded
+# train steps on the DEFAULT_MESH_SPEC submesh, prefill, decode
+PROGRAMS = ("train_flat", "train_sharded", "train_overlap", "prefill", "decode")
+DEFAULT_MESH_SPEC = "data=2,fsdp=2"
+
+
+def build_programs(
+    programs: Sequence[str] = PROGRAMS,
+    geometry: str = "micro",
+    mesh_spec: str = DEFAULT_MESH_SPEC,
+) -> Dict[str, LintTarget]:
+    """The five flagship programs as lint targets — the SAME builds
+    :func:`~perceiver_io_tpu.analysis.fingerprint.flagship_fingerprints`
+    snapshots, so the lint gate and the contract gate cannot drift apart.
+    The sharded pair needs the ``mesh_spec`` submesh worth of devices
+    (CLIs respawn with virtual CPU devices when the host is short)."""
+    unknown = [p for p in programs if p not in PROGRAMS]
+    if unknown:
+        raise ValueError(f"unknown program(s) {unknown}; known: {PROGRAMS}")
+    out: Dict[str, LintTarget] = {}
+    flat = [p for p in ("train_flat", "prefill", "decode") if p in programs]
+    if flat:
+        built = build_targets(
+            geometry, targets=tuple({"train_flat": "train"}.get(p, p) for p in flat)
+        )
+        for p in flat:
+            t = built[{"train_flat": "train"}.get(p, p)]
+            out[p] = dataclasses.replace(t, name=p)
+    sharded = [p for p in ("train_sharded", "train_overlap") if p in programs]
+    if sharded:
+        from perceiver_io_tpu.parallel.overlap import mesh_from_spec
+
+        mesh = mesh_from_spec(mesh_spec)
+        for p in sharded:
+            t = build_targets(
+                geometry, targets=("train",), mesh=mesh, overlap=(p == "train_overlap")
+            )["train"]
+            out[p] = dataclasses.replace(t, name=p)
+    return out
+
+
+def lint_programs(
+    programs: Sequence[str] = PROGRAMS,
+    geometry: str = "micro",
+    mesh_spec: str = DEFAULT_MESH_SPEC,
+    rules: Optional[Sequence[str]] = None,
+    allow: Sequence[str] = (),
+    compiled: Optional[bool] = None,
+    features: Optional[Sequence[str]] = None,
+) -> Dict[str, Report]:
+    """Lint the five flagship programs (``tools/graphlint.py --programs``,
+    the ``tasks.py perf`` dataflow gate). Same ``features`` semantics as
+    :func:`lint_flagship`."""
+    with features_context(features):
+        built = build_programs(programs, geometry=geometry, mesh_spec=mesh_spec)
+        return {
+            name: check(
+                t.fn,
+                t.args,
+                rules=rules,
+                allow=tuple(t.allow) + tuple(allow),
+                policy=t.policy,
+                compiled=compiled,
+                name=name,
+            )
+            for name, t in built.items()
         }
 
 
@@ -316,6 +455,9 @@ def graphlint_telemetry(geometry: str = "micro", mesh_spec: Optional[str] = None
                 "warnings": r.count("warn"),
                 "allowed": len(r.allowed),
                 "violations": [v.key for v in r.violations],
+                # which rules actually ran (the dataflow rules are policy-
+                # gated — this records that the armed set covered them)
+                "rules": list(r.rules_run),
             }
             for k, r in reports.items()
         },

@@ -101,6 +101,31 @@ class LintPolicy:
     # 2}; a missing kind allows 0 and {} allows none. None disables. Ring
     # attention's deliberate permutes must be budgeted by the caller.
     reshard_budget: Optional[Dict[str, int]] = None
+    # rng-key-reuse (dataflow): armed when True — a PRNG key identity
+    # consumed by >= 2 random draws with no split/fold_in between them, and
+    # keys entering a shard_map region replicated (in_names = {}) that
+    # reach a draw without a device-index fold_in on the way (the PR-4
+    # replicated-dropout-key class). Inert until declared.
+    check_rng: bool = False
+    # dead-compute (dataflow): armed when set — ops whose outputs reach
+    # neither the jaxpr outputs nor an effect. FLOPs-weighted: a dead
+    # matmul-class op at/over this many estimated FLOPs is an error, other
+    # dead compute warn, dead data movement (reshape/broadcast/...) info.
+    dead_compute_min_flops: Optional[int] = None
+    # sharding-flow (dataflow): armed when declared — propagate input
+    # PartitionSpecs forward through the jaxpr and report predicted GSPMD
+    # reshard points BEFORE compile (the trace-time complement of the
+    # compiled-HLO implicit-reshard rule). True reads the committed
+    # NamedShardings off the (already device_put) args; or pass an explicit
+    # flat tuple with one PartitionSpec (or None) per arg leaf.
+    sharding_flow: Optional[object] = None
+    # cross-program-consistency (dataflow): the companion program this one
+    # must agree with on KV-cache layout, dtype and append-index provenance
+    # (decode declares prefill as its companion). Inert until declared.
+    companion: Optional["CompanionProgram"] = None
+    # scope labels that mark cache-append sites (core/attention.py labels
+    # its dynamic_update_slice writes "kv_cache_append")
+    cache_scopes: Tuple[str, ...] = ("*kv_cache_append*",)
     # collective-overlap: declare that the compiled module's collectives are
     # meant to overlap compute (the parallel/overlap.py scheduling claim).
     # On async backends (TPU) each *-start/*-done pair must have compute
@@ -116,6 +141,29 @@ class LintPolicy:
     overlap_kinds: Tuple[str, ...] = ("all-gather", "reduce-scatter")
     # per-rule severity overrides, e.g. {"hot-concat": "warn"}
     severity_overrides: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class CompanionProgram:
+    """The other half of a cross-program contract: a function + example
+    args whose traced graph the linted program is checked against (the
+    decode target names the prefill program here). The trace is built once
+    and cached — repeated checks against one companion pay one trace."""
+
+    name: str
+    fn: object
+    args: tuple
+    kwargs: Optional[dict] = None
+    _dataflow: Optional[object] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
+
+    def dataflow(self):
+        if self._dataflow is None:
+            from perceiver_io_tpu.analysis import dataflow as D
+
+            self._dataflow = D.analyze(self.fn, *self.args, **(self.kwargs or {}))
+        return self._dataflow
 
 
 class RuleContext:
@@ -143,6 +191,7 @@ class RuleContext:
         self._dropped_donations: Optional[List[str]] = None
         self._compiled = None
         self._compiled_text: Optional[str] = None
+        self._dataflow = None
 
     @property
     def closed_jaxpr(self):
@@ -161,6 +210,16 @@ class RuleContext:
         if self._consts is None:
             self._consts = list(G.iter_consts(self.closed_jaxpr))
         return self._consts
+
+    @property
+    def dataflow(self):
+        """The def-use/provenance graph (analysis/dataflow.py) — built once
+        from the shared trace and reused by every dataflow rule."""
+        if self._dataflow is None:
+            from perceiver_io_tpu.analysis import dataflow as D
+
+            self._dataflow = D.build(self.closed_jaxpr)
+        return self._dataflow
 
     def _ensure_lowered(self):
         if self._lowered is None:
@@ -716,6 +775,261 @@ def collective_overlap(ctx: RuleContext) -> List[Violation]:
                             ),
                         )
                     )
+    return out
+
+
+# ----------------------------------------------------------- dataflow rules
+
+
+@register_rule(
+    "rng-key-reuse",
+    severity="error",
+    needs="jaxpr",
+    doc="a PRNG key drawn from twice with no split/fold_in between, or a "
+    "replicated key reaching a draw inside shard_map without a device-index fold_in",
+)
+def rng_key_reuse(ctx: RuleContext) -> List[Violation]:
+    if not ctx.policy.check_rng:
+        return []
+    from perceiver_io_tpu.analysis import dataflow as D
+
+    df = ctx.dataflow
+    out: List[Violation] = []
+    for f in D.rng_reuse_findings(df):
+        sinks = [df.nodes[n] for n in f.sink_nids]
+        where = ", ".join(f"{s.primitive} @ {s.scope or '<top>'}" for s in sinks[:3])
+        origin = ""
+        if f.origin_nid is not None:
+            o = df.nodes[f.origin_nid]
+            origin = f" (key from {o.primitive} @ {o.scope or '<top>'})"
+        if f.kind == "draw-draw":
+            msg = (
+                f"one PRNG key feeds {len(f.sink_nids)} random draws with no "
+                f"split/fold_in between them{origin}: {where} — the draws are "
+                "bit-identical; split the key per consumer"
+            )
+        else:
+            d = df.nodes[f.derive_nids[0]]
+            msg = (
+                f"a PRNG key is drawn from AND re-derived with "
+                f"{d.primitive}{origin}: {where} — the child keys correlate "
+                "with the draw; split first, consume the children only"
+            )
+        out.append(
+            Violation(
+                rule="rng-key-reuse",
+                severity=_severity(ctx, "rng-key-reuse"),
+                scope=sinks[0].scope,
+                op=sinks[0].primitive,
+                message=msg,
+            )
+        )
+    for f in D.replicated_key_findings(df):
+        sink = df.nodes[f.sink_nid]
+        chain = df.provenance_to_input(f.sink_nid, max_ops=6)
+        out.append(
+            Violation(
+                rule="rng-key-reuse",
+                severity=_severity(ctx, "rng-key-reuse"),
+                scope=sink.scope,
+                op=sink.primitive,
+                message=(
+                    "a PRNG key enters the shard_map region REPLICATED "
+                    "(in_names={}) and reaches a random draw with no "
+                    "device-index fold_in on the path — every shard draws "
+                    "IDENTICAL randomness (fold in lax.axis_index first, as "
+                    "parallel/overlap.py does)"
+                    + (f"; path:\n{chain}" if chain else "")
+                ),
+            )
+        )
+    return out
+
+
+@register_rule(
+    "dead-compute",
+    severity="error",
+    needs="jaxpr",
+    doc="ops whose outputs reach neither the jaxpr outputs nor an effect, "
+    "FLOPs-weighted (dead matmul = error, dead reshape = info)",
+)
+def dead_compute(ctx: RuleContext) -> List[Violation]:
+    limit = ctx.policy.dead_compute_min_flops
+    if limit is None:
+        return []
+    from perceiver_io_tpu.analysis import dataflow as D
+
+    df = ctx.dataflow
+    out: List[Violation] = []
+    cheap: Dict[Tuple[str, str], int] = {}  # (severity, scope) -> count
+    for node in df.dead_nodes():
+        flops = D.node_flops(node, df.values)
+        if node.primitive in D.DATA_MOVEMENT_PRIMS:
+            sev = "info"
+        elif node.primitive in _COMPUTE_PRIMS and flops >= limit:
+            sev = _severity(ctx, "dead-compute")
+        else:
+            sev = "warn" if flops >= limit else "info"
+        if sev in ("info", "warn"):
+            cheap[(sev, node.scope)] = cheap.get((sev, node.scope), 0) + 1
+            continue
+        aval = df.values[node.outvals[0]].aval if node.outvals else None
+        shape = "x".join(map(str, aval.shape)) if aval else "?"
+        out.append(
+            Violation(
+                rule="dead-compute",
+                severity=sev,
+                scope=node.scope,
+                op=node.primitive,
+                message=(
+                    f"{node.primitive} ({shape}, ~{flops / 1e6:.1f} MFLOP) "
+                    "reaches neither the jaxpr outputs nor an effect — dead "
+                    "compute XLA may still schedule; chain:\n"
+                    + df.provenance_to_input(node.nid, max_ops=5)
+                ),
+            )
+        )
+    for (sev, scope), n in sorted(cheap.items()):
+        kind = "data-movement/cheap" if sev == "info" else "compute"
+        out.append(
+            Violation(
+                rule="dead-compute",
+                severity=sev,
+                scope=scope,
+                message=f"{n} dead {kind} op(s) (outputs reach no output/effect)",
+            )
+        )
+    return out
+
+
+@register_rule(
+    "sharding-flow",
+    severity="warn",
+    needs="jaxpr",
+    doc="predicted GSPMD reshard points from propagating the declared input "
+    "PartitionSpecs through the jaxpr (pre-compile)",
+)
+def sharding_flow(ctx: RuleContext) -> List[Violation]:
+    declared = ctx.policy.sharding_flow
+    if declared is None or declared is False:
+        return []
+    from perceiver_io_tpu.analysis import dataflow as D
+
+    df = ctx.dataflow
+    if declared is True:
+        import jax
+
+        leaves = jax.tree_util.tree_leaves((ctx.args, ctx.kwargs))
+        specs = []
+        for leaf in leaves:
+            s = getattr(leaf, "sharding", None)
+            specs.append(getattr(s, "spec", None))
+    else:
+        specs = list(declared)
+    if len(specs) != len(df.input_vids):
+        return []  # cannot align leaves with jaxpr inputs — stay silent
+    conflicts, _ = D.propagate_shardings(df, specs)
+    out = []
+    for c in conflicts:
+        node = df.nodes[c.nid]
+        predicted = (
+            "collective-permute" if c.kind in ("sliced-sharded-dim", "updated-sharded-dim")
+            else "all-to-all/collective-permute"
+        )
+        out.append(
+            Violation(
+                rule="sharding-flow",
+                severity=_severity(ctx, "sharding-flow"),
+                scope=node.scope,
+                op=node.primitive,
+                message=(
+                    f"{node.primitive} {c.kind} on dim {c.dim} "
+                    f"(mesh axes {c.axes}) — GSPMD will insert a {predicted} "
+                    "here to realign the layouts; chain:\n"
+                    + df.provenance_to_input(c.nid, max_ops=5)
+                ),
+            )
+        )
+    return out
+
+
+@register_rule(
+    "cross-program-consistency",
+    severity="error",
+    needs="jaxpr",
+    doc="the prefill and decode programs must agree on KV-cache layout, "
+    "dtype, and append-index provenance",
+)
+def cross_program_consistency(ctx: RuleContext) -> List[Violation]:
+    comp = ctx.policy.companion
+    if comp is None:
+        return []
+    from perceiver_io_tpu.analysis import dataflow as D
+
+    scopes = ctx.policy.cache_scopes
+    ours = D.cache_sites(ctx.dataflow, scopes)
+    theirs = D.cache_sites(comp.dataflow(), scopes)
+    if not ours and not theirs:
+        return []  # nothing cache-shaped to compare
+    sev = _severity(ctx, "cross-program-consistency")
+    out: List[Violation] = []
+
+    def multiset(sites):
+        counts: Dict[tuple, int] = {}
+        for s in sites:
+            counts[s.layout] = counts.get(s.layout, 0) + 1
+        return counts
+
+    our_prompt = [s for s in ours if s.phase == "prompt"]
+    their_prompt = [s for s in theirs if s.phase == "prompt"]
+    if multiset(our_prompt) != multiset(their_prompt):
+        ours_only = {k for k in multiset(our_prompt)} - {k for k in multiset(their_prompt)}
+        theirs_only = {k for k in multiset(their_prompt)} - {k for k in multiset(our_prompt)}
+        out.append(
+            Violation(
+                rule="cross-program-consistency",
+                severity=sev,
+                scope=our_prompt[0].scope if our_prompt else "",
+                message=(
+                    f"prompt-phase cache appends disagree with {comp.name}: "
+                    f"this program only: {sorted(ours_only)}; {comp.name} "
+                    f"only: {sorted(theirs_only)} — the two programs are "
+                    "building caches with different layout/dtype"
+                ),
+            )
+        )
+    loop_sites = [s for s in ours if s.phase == "loop"]
+    their_layouts = {(s.tail, s.dtype, s.rank, s.update_dims) for s in theirs}
+    for s in loop_sites:
+        if s.index_origin != "carried":
+            out.append(
+                Violation(
+                    rule="cross-program-consistency",
+                    severity=sev,
+                    scope=s.scope,
+                    op="dynamic_update_slice",
+                    message=(
+                        f"decode-loop cache append index provenance is "
+                        f"'{s.index_origin}', not the loop carry — the append "
+                        "position does not advance with the decoded length "
+                        "(cache slots will be overwritten or stale)"
+                    ),
+                )
+            )
+        if their_layouts and s.layout not in their_layouts:
+            out.append(
+                Violation(
+                    rule="cross-program-consistency",
+                    severity=sev,
+                    scope=s.scope,
+                    op="dynamic_update_slice",
+                    message=(
+                        f"decode-loop cache append {s.layout} matches no "
+                        f"{comp.name} cache site — the loop writes a cache "
+                        "layout/dtype the prompt pass never built"
+                    ),
+                )
+            )
     return out
 
 

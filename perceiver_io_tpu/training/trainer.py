@@ -103,11 +103,17 @@ class TrainerConfig:
     peak_flops_per_device: Optional[float] = None
     # static-analysis gate (analysis/): at the first step of each fit, the
     # train step's jaxpr is linted with the trace-only always-wrong rules
-    # and the result lands in events.jsonl as a `graphlint` event. Runs
-    # only when events are active (a logger is attached); one extra trace
-    # per fit. docs/static-analysis.md has the rule catalog.
+    # plus the dataflow rules (rng-key-reuse on the ACTUAL step+loss rng
+    # plumbing; dead-compute; sharding-flow when the fit-time state/batch
+    # carry NamedShardings) and the result lands in events.jsonl as a
+    # `graphlint` event. Runs only when events are active (a logger is
+    # attached); one extra trace per fit. docs/static-analysis.md has the
+    # rule catalog.
     graphlint: bool = True
-    graphlint_rules: tuple = ("const-capture", "callback-in-jit")
+    graphlint_rules: tuple = (
+        "const-capture", "callback-in-jit", "rng-key-reuse", "dead-compute",
+        "sharding-flow",
+    )
     graphlint_allow: tuple = ()
     # graph-contract telemetry (analysis/fingerprint.py): alongside the
     # graphlint event, the trace-level fingerprint of the ACTUAL train step
@@ -290,12 +296,22 @@ class Trainer:
 
         try:
             from perceiver_io_tpu import analysis
+            from perceiver_io_tpu.analysis.flagship import DEAD_COMPUTE_MIN_FLOPS
 
             report = analysis.check(
                 self._lint_step,
                 (state, batch),
                 rules=self.config.graphlint_rules,
                 allow=self.config.graphlint_allow,
+                # arm the dataflow rules against the ACTUAL trained step:
+                # sharding_flow=True reads whatever NamedShardings the
+                # fit-time state/batch carry (unsharded runs propagate
+                # nothing and stay silent)
+                policy=analysis.LintPolicy(
+                    check_rng=True,
+                    dead_compute_min_flops=DEAD_COMPUTE_MIN_FLOPS,
+                    sharding_flow=True,
+                ),
                 name="train_step",
                 closed_jaxpr=closed,
             )

@@ -153,10 +153,16 @@ def graphlint(args):
 def perf(args):
     """The standing perf-CI gate (docs/static-analysis.md): graphcheck —
     compiled-graph contracts vs contracts/, graduation-ledger validation,
-    committed-bench floors — then the graphlint rule gate. Extra args go to
-    tools/graphcheck.py (e.g. ``--programs train_flat,decode``)."""
+    committed-bench floors — then the graphlint rule gate, then the
+    dataflow rules (rng-key-reuse, dead-compute, sharding-flow,
+    cross-program-consistency) over all five flagship programs. Extra args
+    go to tools/graphcheck.py (e.g. ``--programs train_flat,decode``)."""
     run(sys.executable, "tools/graphcheck.py", *args.rest)
     run(sys.executable, "tools/graphlint.py", "--fail-on", "error")
+    # trace-only on purpose: graphcheck just compiled the same five
+    # programs; the dataflow rules need only the jaxpr
+    run(sys.executable, "tools/graphlint.py", "--programs", "all",
+        "--no-compiled", "--fail-on", "error")
 
 
 def main(argv=None):

@@ -399,6 +399,331 @@ def test_implicit_reshard_clean_within_budget_and_skipped_undeclared():
     assert report.rules_skipped == ("implicit-reshard",)
 
 
+# -------------------------------------------------------------- rng-key-reuse
+
+
+def test_rng_key_reuse_fires_on_double_draw():
+    def planted(key):
+        k1, _ = jax.random.split(key)
+        return jax.random.uniform(k1, (4,)) + jax.random.uniform(k1, (4,))
+
+    report = analysis.check(
+        planted, (jax.random.PRNGKey(0),), rules=("rng-key-reuse",),
+        policy=LintPolicy(check_rng=True),
+    )
+    assert [v.rule for v in report.violations] == ["rng-key-reuse"]
+    assert "split" in report.violations[0].message and not report.ok()
+
+
+def test_rng_key_reuse_clean_when_split_and_skipped_undeclared():
+    def clean(key):
+        k1, k2 = jax.random.split(key)
+        return jax.random.uniform(k1, (4,)) + jax.random.uniform(k2, (4,))
+
+    assert analysis.check(
+        clean, (jax.random.PRNGKey(0),), rules=("rng-key-reuse",),
+        policy=LintPolicy(check_rng=True),
+    ).clean
+
+    report = analysis.check(clean, (jax.random.PRNGKey(0),), rules=("rng-key-reuse",))
+    assert report.rules_skipped == ("rng-key-reuse",)
+
+
+def _shard_map_draw(fold_device_index: bool):
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from perceiver_io_tpu.utils.compat import shard_map
+
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(-1), ("data",))
+
+    def body(x, key):
+        if fold_device_index:
+            key = jax.random.fold_in(key, jax.lax.axis_index("data"))
+        return x * jax.random.uniform(key, x.shape)
+
+    fn = shard_map(
+        body, mesh=mesh, in_specs=(P("data"), P()), out_specs=P("data"),
+        check_rep=False,
+    )
+    return fn, (jnp.ones((8, 4)), jax.random.PRNGKey(0))
+
+
+def test_rng_key_reuse_fires_on_replicated_key_in_shard_map():
+    fn, args = _shard_map_draw(fold_device_index=False)
+    report = analysis.check(
+        fn, args, rules=("rng-key-reuse",), policy=LintPolicy(check_rng=True)
+    )
+    assert [v.rule for v in report.violations] == ["rng-key-reuse"]
+    assert "REPLICATED" in report.violations[0].message
+
+
+def test_rng_key_reuse_clean_with_device_index_fold():
+    fn, args = _shard_map_draw(fold_device_index=True)
+    assert analysis.check(
+        fn, args, rules=("rng-key-reuse",), policy=LintPolicy(check_rng=True)
+    ).clean
+
+
+def test_rng_key_reuse_catches_the_pr4_unfolded_overlap_key():
+    """The PR-4 regression, replayed statically: the REAL overlap step built
+    with its device-index fold_in stripped (the shipped bug) must be caught
+    by rng-key-reuse; the shipped step must lint clean. The runtime
+    draw-variance test (tests/test_overlap.py) pins the behavior; this pins
+    that the bug class can no longer reach runtime."""
+    from unittest import mock
+
+    from perceiver_io_tpu.parallel import make_mesh, shard_batch
+    from perceiver_io_tpu.parallel.overlap import OverlapConfig, make_overlap_train_step
+    from perceiver_io_tpu.training import TrainState, make_optimizer
+    from perceiver_io_tpu.training.loop import shard_train_state
+
+    def rng_loss(params, batch, rng):
+        u = jax.random.uniform(rng, ())  # the in-graph draw (dropout stand-in)
+        loss = jnp.mean(batch["x"]) * sum(jnp.sum(v) for v in jax.tree.leaves(params))
+        return loss * 0.0 + u, {"loss": u}
+
+    rng_loss.uniform_weighting = True
+
+    mesh = make_mesh(data=2, fsdp=4)
+    cfg = OverlapConfig(mesh=mesh, bucket_bytes=1 << 14, min_weight_size=32)
+    state = shard_train_state(
+        TrainState.create(
+            lambda *a, **k: None, {"w": jnp.ones((16, 8))},
+            make_optimizer(1e-2, optimizer="sgd"), jax.random.PRNGKey(1),
+        ),
+        mesh, min_weight_size=32,
+    )
+    batch = shard_batch({"x": jnp.ones((16, 8), jnp.float32)}, mesh)
+    policy = LintPolicy(check_rng=True)
+
+    shipped = make_overlap_train_step(rng_loss, cfg, microbatch=2, donate=False)
+    assert analysis.check(
+        shipped, (state, batch), rules=("rng-key-reuse",), policy=policy
+    ).clean
+
+    # strip the fold at trace time: exactly the code PR 4 shipped with
+    with mock.patch.object(jax.random, "fold_in", lambda key, data: key):
+        bugged = make_overlap_train_step(rng_loss, cfg, microbatch=2, donate=False)
+        report = analysis.check(
+            bugged, (state, batch), rules=("rng-key-reuse",), policy=policy
+        )
+    assert not report.ok(), "the PR-4 replicated-key bug must be caught statically"
+    assert all(v.rule == "rng-key-reuse" for v in report.violations)
+    assert "REPLICATED" in report.violations[0].message
+
+
+# --------------------------------------------------------------- dead-compute
+
+
+def test_dead_compute_weights_matmul_error_reshape_info():
+    def planted(x):
+        dead_mm = x @ x.T  # noqa: F841 — 33 MFLOP of dead compute
+        dead_rs = jnp.reshape(x, (-1,))  # noqa: F841 — dead data movement
+        return jnp.tanh(x).sum()
+
+    report = analysis.check(
+        planted, (jnp.ones((256, 256)),), rules=("dead-compute",),
+        policy=LintPolicy(dead_compute_min_flops=1 << 20),
+    )
+    errors = [v for v in report.violations if v.severity == "error"]
+    assert [v.op for v in errors] == ["dot_general"]
+    assert "MFLOP" in errors[0].message and not report.ok()
+    infos = [v for v in report.violations if v.severity == "info"]
+    assert infos and "data-movement" in infos[0].message
+
+
+def test_dead_compute_clean_and_skipped_undeclared():
+    def clean(x):
+        return (x @ x.T).sum()
+
+    policy = LintPolicy(dead_compute_min_flops=1 << 20)
+    assert analysis.check(
+        clean, (jnp.ones((128, 128)),), rules=("dead-compute",), policy=policy
+    ).clean
+    report = analysis.check(clean, (jnp.ones((128, 128)),), rules=("dead-compute",))
+    assert report.rules_skipped == ("dead-compute",)
+
+
+# -------------------------------------------------------------- sharding-flow
+
+
+def test_sharding_flow_predicts_reshard_points():
+    from jax.sharding import PartitionSpec as P
+
+    def planted(x, y):
+        a = x[0:2]  # slice along the data-sharded batch dim
+        return a.sum() + (x + y).sum()  # and a data-vs-fsdp elementwise join
+
+    report = analysis.check(
+        planted,
+        (jnp.ones((4, 4)), jnp.ones((4, 4))),
+        rules=("sharding-flow",),
+        policy=LintPolicy(sharding_flow=(P("data"), P("fsdp"))),
+    )
+    kinds = sorted(v.message.split(" ")[1] for v in report.violations)
+    assert kinds == ["mismatched-operands", "sliced-sharded-dim"]
+    assert all("chain:" in v.message for v in report.violations)
+
+
+def test_sharding_flow_clean_when_aligned_and_skipped_undeclared():
+    from jax.sharding import PartitionSpec as P
+
+    def clean(x, w):
+        return jnp.tanh(x @ w).sum()
+
+    args = (jnp.ones((8, 16)), jnp.ones((16, 4)))
+    assert analysis.check(
+        clean, args, rules=("sharding-flow",),
+        policy=LintPolicy(sharding_flow=(P("data"), P(None, "fsdp"))),
+    ).clean
+    report = analysis.check(clean, args, rules=("sharding-flow",))
+    assert report.rules_skipped == ("sharding-flow",)
+
+
+def test_sharding_flow_agrees_with_compiled_reshard_contracts():
+    """The acceptance pin: sharding-flow's pre-compile predictions must
+    agree with the compiled-HLO reshard findings recorded in the committed
+    contracts — train_sharded (GSPMD microbatch chunk slices along the
+    data-sharded batch axis) compiles with collective-permutes and must be
+    predicted; train_overlap (explicit shard_map, per-shard chunking) has
+    none and must predict none."""
+    from perceiver_io_tpu.analysis.flagship import build_programs
+
+    contracts_dir = os.path.join(os.path.dirname(os.path.dirname(__file__)), "contracts")
+    for name in ("train_sharded", "train_overlap"):
+        target = build_programs((name,))[name]
+        report = analysis.check(
+            target.fn, target.args, rules=("sharding-flow",),
+            policy=target.policy, compiled=False, name=name,
+        )
+        with open(os.path.join(contracts_dir, f"{name}.json")) as f:
+            coll = json.load(f)["fingerprint"].get("collectives", {})
+        compiled_reshards = sum(
+            coll.get(k, {}).get("count", 0) for k in ("all-to-all", "collective-permute")
+        )
+        predicted = len(report.violations)
+        assert (predicted > 0) == (compiled_reshards > 0), (
+            f"{name}: predicted {predicted} reshard point(s) vs "
+            f"{compiled_reshards} compiled reshard collective(s)\n{report.format()}"
+        )
+
+
+# -------------------------------------------------- cross-program-consistency
+
+
+def _cache_pair(loop_steps=0, bad_index=False, loop_dtype=None):
+    """A toy prefill/decode pair with labeled cache appends: the prompt
+    phase writes the prompt at offset 0, the decode loop appends one slot
+    at the carried length (or, planted, at a CONSTANT slot / wrong dtype)."""
+    from jax import lax
+
+    def prog(x):
+        dtype = jnp.dtype(loop_dtype) if loop_dtype else x.dtype
+        cache = jnp.zeros((2, 16, 4), dtype)
+        with jax.named_scope("prefill"), jax.named_scope("kv_cache_append"):
+            cache = lax.dynamic_update_slice(cache, x.astype(dtype), (0, 0, 0))
+        if loop_steps == 0:
+            return cache.sum()
+        length = jnp.asarray(x.shape[1], jnp.int32)
+
+        def step(carry, _):
+            cache, length = carry
+            upd = jnp.ones((2, 1, 4), dtype)
+            idx = jnp.zeros((), jnp.int32) if bad_index else length
+            with jax.named_scope("decode"), jax.named_scope("kv_cache_append"):
+                cache = lax.dynamic_update_slice(cache, upd, (0, idx, 0))
+            return (cache, length + 1), cache.sum()
+
+        (_, _), ys = lax.scan(step, (cache, length), None, length=loop_steps)
+        return ys.sum()
+
+    return prog
+
+
+def test_cross_program_consistency_clean_on_agreeing_pair():
+    from perceiver_io_tpu.analysis import CompanionProgram
+
+    x = jnp.ones((2, 4, 4))
+    report = analysis.check(
+        _cache_pair(loop_steps=3), (x,),
+        rules=("cross-program-consistency",),
+        policy=LintPolicy(
+            companion=CompanionProgram("prefill", _cache_pair(loop_steps=0), (x,))
+        ),
+    )
+    assert report.clean, report.format()
+
+
+def test_cross_program_consistency_fires_on_static_append_index():
+    from perceiver_io_tpu.analysis import CompanionProgram
+
+    x = jnp.ones((2, 4, 4))
+    report = analysis.check(
+        _cache_pair(loop_steps=3, bad_index=True), (x,),
+        rules=("cross-program-consistency",),
+        policy=LintPolicy(
+            companion=CompanionProgram("prefill", _cache_pair(loop_steps=0), (x,))
+        ),
+    )
+    assert not report.ok()
+    assert any("provenance" in v.message for v in report.violations)
+
+
+def test_cross_program_consistency_fires_on_dtype_mismatch():
+    from perceiver_io_tpu.analysis import CompanionProgram
+
+    x = jnp.ones((2, 4, 4))
+    report = analysis.check(
+        _cache_pair(loop_steps=3, loop_dtype=jnp.bfloat16), (x,),
+        rules=("cross-program-consistency",),
+        policy=LintPolicy(
+            companion=CompanionProgram("prefill", _cache_pair(loop_steps=0), (x,))
+        ),
+    )
+    assert not report.ok()
+    assert any("layout/dtype" in v.message for v in report.violations)
+
+
+def test_cross_program_consistency_skipped_without_companion():
+    report = analysis.check(
+        _cache_pair(), (jnp.ones((2, 4, 4)),), rules=("cross-program-consistency",)
+    )
+    assert report.rules_skipped == ("cross-program-consistency",)
+
+
+# ------------------------------------------------- ledger-derived allowlist
+
+
+def test_default_allow_derives_from_ledger(tmp_path):
+    from perceiver_io_tpu.analysis import ledger as L
+    from perceiver_io_tpu.analysis.flagship import DEFAULT_ALLOW, default_allow
+
+    # no ledger: the full static defaults
+    assert default_allow(str(tmp_path)) == DEFAULT_ALLOW
+    led = {
+        "schema_version": 1,
+        "features": {
+            "twoseg": {"state": "staged",
+                       "history": [{"state": "staged", "reason": "seed"}]}
+        },
+        "floors": {},
+    }
+    L.save_ledger(str(tmp_path), led)
+    assert default_allow(str(tmp_path)) == DEFAULT_ALLOW  # staged: entry stays
+
+    led = L.advance(led, "twoseg", "measured", "A/B ran", evidence={"ab": "BENCH_rX"})
+    led = L.advance(led, "twoseg", "default_on", "graduated")
+    L.save_ledger(str(tmp_path), led)
+    flipped = default_allow(str(tmp_path))
+    assert not any("kv_concat" in a for a in flipped), (
+        "graduating twoseg must drop the kv_concat allowlist entry"
+    )
+    assert any("perceiver_ar._attend" in a for a in flipped)
+
+    # today's repo ledger has twoseg staged, so the entry is still live
+    assert any("kv_concat" in a for a in default_allow())
+
+
 # ----------------------------------------------------- allowlist + report API
 
 

@@ -404,3 +404,24 @@ def test_graphlint_cli_exit_codes(monkeypatch, tmp_path):
 
     monkeypatch.setattr(flagship, "lint_flagship", boom)
     assert gl.main(["--targets", "train"]) == 3
+
+
+def test_graphlint_cli_unknown_rule_is_usage_error(capsys):
+    """A typo'd --rules name must exit with the argparse USAGE code (2) and
+    list the registered rules — not silently skip the rule (the old
+    behavior) and not crash as exit 3."""
+    import pytest
+
+    gl = _load_tool("graphlint")
+    with pytest.raises(SystemExit) as e:
+        gl.main(["--rules", "no-such-rule,hot-concat"])
+    assert e.value.code == 2
+    err = capsys.readouterr().err
+    assert "no-such-rule" in err and "registered rules" in err
+    assert "hot-concat" in err and "rng-key-reuse" in err
+
+    # same contract for --programs
+    with pytest.raises(SystemExit) as e2:
+        gl.main(["--programs", "bogus"])
+    assert e2.value.code == 2
+    assert "train_overlap" in capsys.readouterr().err

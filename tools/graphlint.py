@@ -11,6 +11,8 @@ this is the CI gate `tasks.py graphlint` wraps:
     python tools/graphlint.py --kernel-features twoseg            # A/B the lint
     python tools/graphlint.py --json graphlint.json --allow 'hot-concat:*mlp*'
     python tools/graphlint.py --mesh data=2,fsdp=4 --targets train  # sharded step
+    python tools/graphlint.py --programs all --no-compiled  # the 5 graphcheck
+                                                            # programs, dataflow rules
 
 ``--mesh data=N[,fsdp=M]`` lints the SHARDED flagship train step — by
 default the overlap-scheduled shard_map step (parallel/overlap.py) with the
@@ -19,9 +21,16 @@ bucket plan; ``--overlap off`` lints the GSPMD step instead. When the host
 has fewer devices than the mesh needs, the CLI re-execs itself with that
 many virtual CPU devices (the __graft_entry__ dryrun trick).
 
+``--programs all`` lints the five graphcheck programs (train_flat,
+train_sharded, train_overlap, prefill, decode) with per-program policies
+that arm the dataflow rules — rng-key-reuse, dead-compute, sharding-flow
+(on the sharded steps), cross-program-consistency (decode vs prefill).
+This is the gate ``tasks.py perf`` runs after graphcheck.
+
 Exit codes: 0 — no violation at/above ``--fail-on``; 1 — violations found;
-3 — a rule or target build CRASHED (the lint itself is broken, which CI
-must not confuse with either verdict).
+2 — usage error (e.g. an unknown ``--rules``/``--programs`` name — the
+message lists what is registered); 3 — a rule or target build CRASHED (the
+lint itself is broken, which CI must not confuse with either verdict).
 
 Rule catalog and allowlist syntax: docs/static-analysis.md.
 """
@@ -51,8 +60,16 @@ def main(argv=None) -> int:
                         "with --no-compiled elsewhere)")
     p.add_argument("--targets", default="train,prefill,decode",
                    help="comma list of flagship functions to lint")
+    p.add_argument("--programs", default=None, metavar="P1,P2|all",
+                   help="lint the five graphcheck programs instead of the "
+                        "--targets trio: train_flat, train_sharded (GSPMD), "
+                        "train_overlap (shard_map), prefill, decode — 'all' "
+                        "or a comma list; the sharded pair re-execs with "
+                        "virtual CPU devices when the host is short. This is "
+                        "the dataflow-rule gate `tasks.py perf` runs")
     p.add_argument("--rules", default=None,
-                   help="comma list of rules to run (default: all registered)")
+                   help="comma list of rules to run (default: all registered); "
+                        "unknown names are a usage error")
     p.add_argument("--allow", action="append", default=[],
                    help="extra allowlist entry (repeatable), fnmatch-ed against "
                         "'rule' and 'rule:scope' — e.g. 'hot-concat:*decode*'")
@@ -83,6 +100,40 @@ def main(argv=None) -> int:
                         "and a derived collective budget) or the GSPMD step (off)")
     args = p.parse_args(argv)
 
+    rules = None
+    if args.rules:
+        # a typo'd rule name must be a USAGE error (exit 2), not a silent
+        # skip and not an internal crash (exit 3) — list what exists
+        from perceiver_io_tpu.analysis.rules import RULES
+
+        rules = tuple(r for r in args.rules.split(",") if r)
+        unknown = [r for r in rules if r not in RULES]
+        if unknown:
+            p.error(
+                f"unknown rule(s) {', '.join(unknown)}; registered rules: "
+                f"{', '.join(sorted(RULES))}"
+            )
+
+    programs = None
+    if args.programs:
+        from perceiver_io_tpu.analysis.flagship import DEFAULT_MESH_SPEC, PROGRAMS
+
+        programs = (
+            tuple(PROGRAMS)
+            if args.programs == "all"
+            else tuple(x for x in args.programs.split(",") if x)
+        )
+        unknown_programs = [x for x in programs if x not in PROGRAMS]
+        if unknown_programs:
+            p.error(
+                f"unknown program(s) {', '.join(unknown_programs)}; known: "
+                f"{', '.join(PROGRAMS)}"
+            )
+        if any(x in ("train_sharded", "train_overlap") for x in programs):
+            from perceiver_io_tpu.parallel.overlap import parse_mesh_spec, required_devices
+
+            _ensure_devices(required_devices(parse_mesh_spec(DEFAULT_MESH_SPEC)))
+
     mesh = None
     if args.mesh:
         from perceiver_io_tpu.parallel.overlap import (
@@ -94,7 +145,7 @@ def main(argv=None) -> int:
         _ensure_devices(required_devices(parse_mesh_spec(args.mesh)))
         mesh = mesh_from_spec(args.mesh)
 
-    from perceiver_io_tpu.analysis.flagship import lint_flagship
+    from perceiver_io_tpu.analysis.flagship import lint_flagship, lint_programs
 
     features = None
     if args.kernel_features is not None:
@@ -106,17 +157,27 @@ def main(argv=None) -> int:
 
     budget = json.loads(args.collective_budget) if args.collective_budget else None
     try:
-        reports = lint_flagship(
-            geometry=args.geometry,
-            targets=tuple(t for t in args.targets.split(",") if t),
-            rules=tuple(args.rules.split(",")) if args.rules else None,
-            allow=tuple(args.allow),
-            compiled=args.compiled,
-            collective_budget=budget,
-            features=features,
-            mesh=mesh,
-            overlap=args.overlap == "on",
-        )
+        if programs is not None:
+            reports = lint_programs(
+                programs,
+                geometry=args.geometry,
+                rules=rules,
+                allow=tuple(args.allow),
+                compiled=args.compiled,
+                features=features,
+            )
+        else:
+            reports = lint_flagship(
+                geometry=args.geometry,
+                targets=tuple(t for t in args.targets.split(",") if t),
+                rules=rules,
+                allow=tuple(args.allow),
+                compiled=args.compiled,
+                collective_budget=budget,
+                features=features,
+                mesh=mesh,
+                overlap=args.overlap == "on",
+            )
     except Exception as e:  # noqa: BLE001 — a rule/build CRASH is not a verdict
         # exit 3, distinct from 1 (violations found): CI must not read "the
         # linter itself broke" as "the graph got worse" — or, with
