@@ -151,9 +151,18 @@ def telemetry_fields(flops, step_time, step_times_s=None, times_key: str = "step
         t["peak_flops_per_device"] = peak
         t["mfu"] = round(rate / peak, 4) if peak else None
     if step_times_s:
+        # same low-sample rule as StepTimer.summary: under LOW_N samples the
+        # percentiles are exact order statistics and the block says low_n —
+        # a 3-sample p99 printed as a tail estimate would be a fake number
+        from perceiver_io_tpu.utils.profiling import LOW_N, exact_percentile
+
+        low_n = len(step_times_s) < LOW_N
+        pct = exact_percentile if low_n else percentile
         t[times_key] = {
-            f"p{p}": round(percentile(step_times_s, p) * 1e3, 3) for p in (50, 90, 99)
+            f"p{p}": round(pct(step_times_s, p) * 1e3, 3) for p in (50, 90, 99)
         }
+        if low_n:
+            t[times_key]["low_n"] = True
     return {"telemetry": t}
 
 

@@ -28,7 +28,7 @@ from __future__ import annotations
 import contextlib
 import contextvars
 import time
-from dataclasses import asdict, dataclass, replace
+from dataclasses import asdict, dataclass
 from typing import Optional, Tuple
 
 import jax
@@ -392,6 +392,42 @@ def beam_search(
     return jnp.concatenate([prompt_tiled, best_seqs], axis=1), best_scores
 
 
+def _decode_step_body(model, mcfg, config, step_params, carry, pad_slots, pos_shift):
+    """One decode step over the fixed-capacity caches — the SHARED body of
+    :func:`generate`'s compiled scan and the host-driven step fn
+    (:func:`make_decode_fns`), so the two paths cannot drift: slide the
+    windows when full (expired slots derived from the start counters, the
+    roll-free analog of the reference's truncation), apply the model on the
+    last token, sample, handle EOS freezing. Callers own parameter
+    unpacking/dequantization and the ``decode`` named scope."""
+    cache, ca_start, sa_start, token, rng, done = carry
+    ca_cache, sa_caches = cache[0], cache[1:]
+    ca_idx = jnp.arange(ca_cache.capacity, dtype=jnp.int32)[None, :]
+    sa_idx = jnp.arange(sa_caches[0].capacity, dtype=jnp.int32)[None, :]
+
+    ca_full = (ca_cache.length - ca_start) >= mcfg.max_seq_len
+    ca_start = ca_start + ca_full.astype(jnp.int32)
+    sa_full = (sa_caches[0].length - sa_start) >= mcfg.max_latents
+    sa_start = sa_start + sa_full.astype(jnp.int32)
+
+    out = model.apply(
+        step_params,
+        token[:, None],
+        prefix_len=0,
+        pad_mask=pad_slots | (ca_idx < ca_start),
+        kv_cache=cache,
+        decode=True,
+        sa_pad_mask=sa_idx < sa_start,
+        pos_shift=pos_shift,
+    )
+    rng, step_rng = jax.random.split(rng)
+    sampled = _sample(out.logits[:, -1], step_rng, config)
+    if config.eos_token_id is not None:
+        sampled = jnp.where(done, config.pad_token_id, sampled)
+        done = done | (sampled == config.eos_token_id)
+    return (out.kv_cache, ca_start, sa_start, sampled, rng, done), sampled
+
+
 def make_generate_fn(
     model,
     num_latents: int = 1,
@@ -493,9 +529,6 @@ def generate(
     next_token = _sample(out.logits[:, -1], first_rng, config)
     cache = out.kv_cache
 
-    ca_idx = jnp.arange(ca_capacity, dtype=jnp.int32)[None, :]
-    sa_idx = jnp.arange(sa_capacity, dtype=jnp.int32)[None, :]
-
     decode_params, compute_dtype = _maybe_quantize_weights(model, params, weight_dtype)
     if _pack_enabled(b):
         packed_small, unpack_small = _pack_small_params(decode_params)
@@ -504,36 +537,11 @@ def generate(
 
     def step(carry, _):
         with jax.named_scope("decode"):
-            cache, ca_start, sa_start, token, rng, done = carry
             dp = decode_params if unpack_small is None else unpack_small(packed_small)
-            params = _maybe_dequantize_weights(dp, compute_dtype)
-            ca_cache, sa_caches = cache[0], cache[1:]
-
-            # slide: expire the oldest latent when the SA window is full, the
-            # oldest window position when the CA window is full (the analog of
-            # the reference's [:, -max_len+1:] truncation before appending).
-            # Expired slots are derived from the start counters, not carried.
-            ca_full = (ca_cache.length - ca_start) >= mcfg.max_seq_len
-            ca_start = ca_start + ca_full.astype(jnp.int32)
-            sa_full = (sa_caches[0].length - sa_start) >= mcfg.max_latents
-            sa_start = sa_start + sa_full.astype(jnp.int32)
-
-            out = model.apply(
-                params,
-                token[:, None],
-                prefix_len=0,
-                pad_mask=pad_slots | (ca_idx < ca_start),
-                kv_cache=cache,
-                decode=True,
-                sa_pad_mask=sa_idx < sa_start,
-                pos_shift=pos_shift,
+            step_params = _maybe_dequantize_weights(dp, compute_dtype)
+            return _decode_step_body(
+                model, mcfg, config, step_params, carry, pad_slots, pos_shift
             )
-            rng, step_rng = jax.random.split(rng)
-            sampled = _sample(out.logits[:, -1], step_rng, config)
-            if config.eos_token_id is not None:
-                sampled = jnp.where(done, config.pad_token_id, sampled)
-                done = done | (sampled == config.eos_token_id)
-            return (out.kv_cache, ca_start, sa_start, sampled, rng, done), sampled
 
     done0 = jnp.zeros((b,), bool)
     if config.eos_token_id is not None:
@@ -550,19 +558,120 @@ def generate(
     return jnp.concatenate([input_ids, tokens], axis=1)
 
 
+def make_decode_fns(
+    model,
+    num_latents: int = 1,
+    config: Optional[GenerationConfig] = None,
+    cache_dtype=jnp.float32,
+    weight_dtype=None,
+):
+    """The host-driven decode pair: ``(prefill_fn, step_fn)``.
+
+    - ``prefill_fn(params, input_ids, pad_mask=None, rng=None) ->
+      (first_token, state)`` — validation, cache allocation (same
+      ``max_new_tokens``-slack roll-free windows as :func:`generate`),
+      prompt pass, first sample, and weight quantization; ``state`` is a
+      dict pytree carrying the (possibly int8) decode params, caches,
+      window counters, rng and the slot masks.
+    - ``step_fn(state) -> (state, token)`` — exactly one scan-body
+      iteration (:func:`_decode_step_body` — literally the same code
+      :func:`generate`'s compiled scan runs, so the streams are token-exact
+      equal, rng chain included).
+
+    Both are jit-compiled; the per-token host dispatch costs more than the
+    fused scan, so this is the *serving-shaped* path: the instrumented
+    wrapper times every token through it (TTFT + a real TPOT distribution,
+    not a mean), and a continuous-batching scheduler steps requests through
+    ``step_fn`` between admissions (ROADMAP item 1).
+    """
+    config = config or GenerationConfig()
+    if config.max_new_tokens < 1:
+        raise ValueError("decode fns require max_new_tokens >= 1")
+    mcfg = model.config
+    compute_dtype = None if weight_dtype is None else getattr(model, "dtype", jnp.float32)
+
+    def prefill(params, input_ids, pad_mask=None, rng=None):
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        b, seq_len = input_ids.shape
+        prefix_len = _validate_window(mcfg, seq_len, num_latents)
+        _require_pads_in_prefix(pad_mask, prefix_len)
+
+        from perceiver_io_tpu.core.modules import CausalSequenceModel
+
+        ca_capacity = seq_len + config.max_new_tokens
+        sa_capacity = num_latents + config.max_new_tokens
+        cache = CausalSequenceModel.init_cache(
+            mcfg, b, ca_capacity=ca_capacity, sa_capacity=sa_capacity, dtype=cache_dtype
+        )
+        if pad_mask is None:
+            pad_mask = jnp.zeros((b, seq_len), bool)
+        pos_shift = pad_mask.sum(axis=1, keepdims=True).astype(jnp.int32)
+        pad_slots = jnp.zeros((b, ca_capacity), bool).at[:, :seq_len].set(pad_mask)
+
+        with jax.named_scope("prefill"), prefill_mode():
+            out = model.apply(
+                params, input_ids, prefix_len=prefix_len, pad_mask=pad_mask, kv_cache=cache
+            )
+        rng, first_rng = jax.random.split(rng)
+        next_token = _sample(out.logits[:, -1], first_rng, config)
+        done = jnp.zeros((b,), bool)
+        if config.eos_token_id is not None:
+            done = next_token == config.eos_token_id
+
+        decode_params, _ = _maybe_quantize_weights(model, params, weight_dtype)
+        zero = jnp.zeros((), jnp.int32)
+        state = {
+            "params": decode_params,
+            "cache": out.kv_cache,
+            "ca_start": zero,
+            "sa_start": zero,
+            "token": next_token,
+            "rng": rng,
+            "done": done,
+            "pad_slots": pad_slots,
+            "pos_shift": pos_shift,
+        }
+        return next_token, state
+
+    def step(state):
+        with jax.named_scope("decode"):
+            step_params = _maybe_dequantize_weights(state["params"], compute_dtype)
+            carry = (
+                state["cache"], state["ca_start"], state["sa_start"],
+                state["token"], state["rng"], state["done"],
+            )
+            carry, token = _decode_step_body(
+                model, mcfg, config, step_params, carry, state["pad_slots"], state["pos_shift"]
+            )
+            new_state = dict(
+                state, cache=carry[0], ca_start=carry[1], sa_start=carry[2],
+                token=carry[3], rng=carry[4], done=carry[5],
+            )
+            return new_state, token
+
+    return jax.jit(prefill), jax.jit(step)
+
+
 @dataclass
 class GenerationStats:
-    """Host-measured serving telemetry for one generate call (the
-    prefill/decode latency split TPU serving comparisons hinge on)."""
+    """Host-measured serving telemetry for one generate request (the
+    per-request numbers TPU serving comparisons gate on)."""
 
     batch: int
     prompt_len: int
-    new_tokens: int
-    prefill_s: float  # prompt pass + first token, measured on its own program
-    decode_s: float  # the remaining new_tokens - 1 tokens
-    per_token_s: float  # decode_s / (new_tokens - 1)
-    tokens_per_sec: float  # batch * new_tokens / (prefill_s + decode_s)
+    new_tokens: int  # requested
+    prefill_s: float  # TTFT: prompt pass + first token on the host clock
+    decode_s: float  # wall time for the remaining tokens
+    per_token_s: float  # MEAN TPOT — the percentiles live in the event/fields below
+    tokens_per_sec: float  # batch * tokens_out / (prefill_s + decode_s)
     compiled: bool  # True when THIS call paid a compile (timings include it)
+    # --- Spanline (PR 8) per-request SLO fields -------------------------
+    ttft_s: float = 0.0  # == prefill_s (serving-literature name)
+    tokens_out: int = 0  # tokens actually produced (== new_tokens unless error)
+    outcome: str = "ok"  # "ok" | "error"
+    tpot_p50_s: Optional[float] = None  # histogram-derived decode percentiles
+    tpot_p90_s: Optional[float] = None
+    tpot_p99_s: Optional[float] = None
 
 
 def make_instrumented_generate_fn(
@@ -572,64 +681,162 @@ def make_instrumented_generate_fn(
     cache_dtype=jnp.float32,
     weight_dtype=None,
     events=None,
+    registry=None,
+    on_token=None,
+    snapshot_interval_s: float = 30.0,
 ):
     """``fn(params, input_ids, pad_mask, rng) -> (tokens, GenerationStats)``
-    — :func:`make_generate_fn` with the prefill/decode latency split measured
-    per call and (optionally) logged to an ``obs.events.EventLog``.
+    — the serving measurement wrapper: host-driven decode
+    (:func:`make_decode_fns`) with EVERY token individually host-timed.
 
-    The whole decode loop is one compiled program (by design — see
-    :func:`make_generate_fn`), so the split cannot be timed inside it.
-    Instead a second compiled variant with ``max_new_tokens=1`` measures the
-    prefill (prompt pass + first token) on its own, and the full call's
-    remainder is decode time. That means **each call runs the prompt pass
-    twice** — this is the measurement wrapper for serving telemetry and
-    A/Bs, not the peak-throughput path. Compiles are tracked (and surfaced
-    as ``compile`` events): a call that compiled reports wall times
-    including the compile and says so in ``stats.compiled``.
+    Per call it records TTFT (prompt pass + first token) and a real
+    per-token decode-latency distribution — each token's wall time lands in
+    a log-bucketed ``obs.metrics.Histogram``, and the ``request`` event
+    emitted per call carries TTFT, TPOT p50/p90/p99 **from that histogram**
+    (not means), tokens in/out, the cache geometry, the sparse bucket
+    counts (``obs.slo`` merges them into run-level percentiles) and the
+    outcome. A request that dies mid-decode still emits its event with
+    ``outcome="error"`` and the partial TPOT data before the exception
+    re-raises (the same except-and-reraise guarantee ``fit_end`` makes).
+
+    The per-token host dispatch costs more than :func:`make_generate_fn`'s
+    fused scan — this is the measurement wrapper for serving telemetry and
+    A/Bs, not the peak-throughput path. Compiles are tracked (surfaced as
+    ``compile`` events, attributed to the request's span): a call that
+    compiled reports wall times including the compile and says so in
+    ``stats.compiled``.
+
+    ``registry`` (an ``obs.metrics.MetricsRegistry``; fresh one per fn when
+    None) accumulates cross-request counters/histograms and snapshots into
+    ``metrics`` event rows at most every ``snapshot_interval_s``.
+    ``on_token(i, token_array)`` observes each decoded token — the seam a
+    streaming consumer (or an abort-injection test) hangs off.
     """
     config = config or GenerationConfig()
     if config.max_new_tokens < 1:
         raise ValueError("instrumented generation requires max_new_tokens >= 1")
+    from perceiver_io_tpu.obs import trace as obs_trace
+    from perceiver_io_tpu.obs.metrics import Histogram, MetricsRegistry
     from perceiver_io_tpu.obs.recompile import RecompileTracker
 
     tracker = RecompileTracker(events=events)
-    prefill_fn = tracker.wrap(
-        make_generate_fn(
-            model, num_latents, replace(config, max_new_tokens=1), cache_dtype, weight_dtype
-        ),
-        "generate_prefill",
+    prefill_raw, step_raw = make_decode_fns(
+        model, num_latents, config, cache_dtype, weight_dtype
     )
-    full_fn = tracker.wrap(
-        make_generate_fn(model, num_latents, config, cache_dtype, weight_dtype),
-        "generate_full",
-    )
+    prefill_fn = tracker.wrap(prefill_raw, "generate_prefill")
+    step_fn = tracker.wrap(step_raw, "generate_decode_step")
+    registry = registry if registry is not None else MetricsRegistry()
+    m_requests = registry.counter("generate_requests_total")
+    m_cold = registry.counter("generate_cold_requests_total")
+    m_errors = registry.counter("generate_request_errors_total")
+    m_tokens = registry.counter("generate_tokens_out_total")
+    # WARM samples only: the cross-request histograms feed dashboards
+    # (Prometheus export / metrics snapshots) that never reset, so one
+    # compile-inflated sample would poison their tails forever. The
+    # per-request event still reports what THAT request experienced,
+    # compile included, flagged by `compiled` — consumers exclude it.
+    m_ttft = registry.histogram("generate_ttft_s")
+    m_tpot = registry.histogram("generate_tpot_s")
+    tracer = obs_trace.Tracer(events, flush_every=64) if events is not None else None
 
     def fn(params, input_ids, pad_mask=None, rng=None):
         b, prompt_len = input_ids.shape
         compiles_before = tracker.total_compiles
-        # timings force a HOST VALUE FETCH (float of one element), not
-        # block_until_ready: through the axon TPU tunnel block_until_ready
-        # is a no-op and would time only dispatch (see utils/profiling.py)
-        t0 = time.perf_counter()
-        float(prefill_fn(params, input_ids, pad_mask, rng)[0, -1])
-        prefill_s = time.perf_counter() - t0
-        t1 = time.perf_counter()
-        out = full_fn(params, input_ids, pad_mask, rng)
-        float(out[0, -1])
-        total_s = time.perf_counter() - t1
-        decode_s = max(total_s - prefill_s, 0.0)
+        request_id = obs_trace.new_span_id()
+        hist = Histogram("tpot_s")  # THIS request's decode latencies
+        toks = []
+        outcome, err = "ok", None
+        ttft = 0.0
+        span_cm = (
+            tracer.span("request", request_id=request_id)
+            if tracer is not None
+            else contextlib.nullcontext(None)
+        )
+        t_all0 = time.perf_counter()
+        with span_cm as sp:
+            try:
+                # timings force a HOST VALUE FETCH (float of one element),
+                # not block_until_ready: through the axon TPU tunnel
+                # block_until_ready is a no-op and would time only dispatch
+                c0 = tracker.total_compiles
+                t0 = time.perf_counter()
+                token, state = prefill_fn(params, input_ids, pad_mask, rng)
+                float(token[0])
+                ttft = time.perf_counter() - t0
+                if tracker.total_compiles == c0:
+                    m_ttft.record(ttft)
+                toks.append(token)
+                if on_token is not None:
+                    on_token(0, token)
+                for i in range(1, config.max_new_tokens):
+                    c0 = tracker.total_compiles
+                    t1 = time.perf_counter()
+                    state, token = step_fn(state)
+                    float(token[0])
+                    dt = time.perf_counter() - t1
+                    hist.record(dt)
+                    if tracker.total_compiles == c0:
+                        m_tpot.record(dt)
+                    toks.append(token)
+                    if on_token is not None:
+                        on_token(i, token)
+            except BaseException as e:  # noqa: BLE001 — event out, then reraise
+                outcome, err = "error", e
+            if sp is not None:
+                sp.set("outcome", outcome)
+                sp.set("tokens_out", len(toks))
+        elapsed = time.perf_counter() - t_all0
+        decode_s = max(elapsed - ttft, 0.0)
+        tokens_out = len(toks)
+        compiled = tracker.total_compiles > compiles_before
         stats = GenerationStats(
             batch=b,
             prompt_len=prompt_len,
             new_tokens=config.max_new_tokens,
-            prefill_s=round(prefill_s, 6),
+            prefill_s=round(ttft, 6),
             decode_s=round(decode_s, 6),
-            per_token_s=round(decode_s / max(config.max_new_tokens - 1, 1), 6),
-            tokens_per_sec=round(b * config.max_new_tokens / max(prefill_s + decode_s, 1e-9), 3),
-            compiled=tracker.total_compiles > compiles_before,
+            per_token_s=round(decode_s / max(tokens_out - 1, 1), 6),
+            tokens_per_sec=round(b * tokens_out / max(elapsed, 1e-9), 3),
+            compiled=compiled,
+            ttft_s=round(ttft, 6),
+            tokens_out=tokens_out,
+            outcome=outcome,
+            tpot_p50_s=hist.percentile(50),
+            tpot_p90_s=hist.percentile(90),
+            tpot_p99_s=hist.percentile(99),
         )
+        m_requests.inc()
+        m_tokens.inc(tokens_out * b)
+        if compiled:
+            m_cold.inc()
+        if outcome == "error":
+            m_errors.inc()
         if events is not None:
-            events.emit("generate", **asdict(stats))
+            row = asdict(stats)
+            row.update(
+                request_id=request_id,
+                span_id=None if tracer is None else sp.span_id,
+                # cache geometry: the fixed-capacity windows this request
+                # decoded against (the admission-relevant footprint)
+                ca_capacity=prompt_len + config.max_new_tokens,
+                sa_capacity=num_latents + config.max_new_tokens,
+                num_latents=num_latents,
+                tpot_hist=dict(sorted((str(k), v) for k, v in hist.counts.items())),
+            )
+            if hist.n and hist.n < 5:
+                row["tpot_low_n"] = True
+            if err is not None:
+                row["error"] = repr(err)
+            if row.get("span_id") is None:
+                row.pop("span_id", None)  # let the ambient span stamp it
+            events.emit("request", **row)
+            registry.maybe_emit(events, min_interval_s=snapshot_interval_s)
+            if tracer is not None:
+                tracer.flush()
+        if err is not None:
+            raise err
+        out = jnp.concatenate([input_ids] + [t[:, None] for t in toks], axis=1)
         return out, stats
 
+    fn.registry = registry  # exporter access (to_prometheus / snapshot)
     return fn

@@ -1,19 +1,38 @@
-"""Observability subsystem: structured run events, MFU/goodput accounting,
+"""Observability subsystem: structured run events, request/step spans, a
+metrics registry, per-request SLO aggregation, MFU/goodput accounting,
 recompile tracking, and labeled device-trace rollups.
 
-One measurement surface for every perf PR (ISSUE 1): the trainer emits
-``events.jsonl`` + ``run_manifest.json`` next to ``metrics.csv``; the
-benches report analytic MFU against a per-device peak-FLOPs table; traces
-captured with ``utils.profiling.trace`` aggregate by ``jax.named_scope``
-module instead of raw HLO op names (``obs.xplane``); and silent
-shape-driven recompiles surface as ``compile`` events
-(``obs.recompile``). Render a run directory with ``tools/obs_report.py``.
+One measurement surface for every perf PR (ISSUE 1) plus the request-level
+Spanline layer (ISSUE 8): the trainer emits ``events.jsonl`` +
+``run_manifest.json`` next to ``metrics.csv`` (sharded per process on
+multi-host programs, merged back by ``obs.events.merged_events``); host
+spans (``obs.trace``) attribute every ``fault.*``/``compile``/``resume``
+event to the step or request it happened in; instrumented generation emits
+per-request ``request`` rows aggregated by ``obs.slo``; counters/gauges/
+log-bucketed histograms live in ``obs.metrics`` with Prometheus/JSON
+exporters; the benches report analytic MFU against a per-device peak-FLOPs
+table; traces captured with ``utils.profiling.trace`` aggregate by
+``jax.named_scope`` module instead of raw HLO op names (``obs.xplane``);
+and silent shape-driven recompiles surface as ``compile`` events
+(``obs.recompile``). Render a run directory with ``tools/obs_report.py``;
+diff two runs with ``tools/obs_diff.py``.
 """
 
 from perceiver_io_tpu.obs.events import (  # noqa: F401
+    EVENT_SCHEMA_VERSION,
     EventLog,
     config_hash,
+    event_shards,
+    merged_events,
+    validate_events,
     write_run_manifest,
+)
+from perceiver_io_tpu.obs.metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    default_registry,
 )
 from perceiver_io_tpu.obs.mfu import (  # noqa: F401
     GoodputTracker,
@@ -21,14 +40,38 @@ from perceiver_io_tpu.obs.mfu import (  # noqa: F401
     device_peak_flops,
 )
 from perceiver_io_tpu.obs.recompile import RecompileTracker, shape_signature  # noqa: F401
+from perceiver_io_tpu.obs.slo import build_slo_report, write_slo_report  # noqa: F401
+from perceiver_io_tpu.obs.trace import (  # noqa: F401
+    Span,
+    Tracer,
+    current_span,
+    current_span_id,
+    host_device_breakdown,
+)
 
 __all__ = [
+    "EVENT_SCHEMA_VERSION",
     "EventLog",
     "config_hash",
+    "event_shards",
+    "merged_events",
+    "validate_events",
     "write_run_manifest",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "default_registry",
     "GoodputTracker",
     "clm_train_telemetry",
     "device_peak_flops",
     "RecompileTracker",
     "shape_signature",
+    "build_slo_report",
+    "write_slo_report",
+    "Span",
+    "Tracer",
+    "current_span",
+    "current_span_id",
+    "host_device_breakdown",
 ]

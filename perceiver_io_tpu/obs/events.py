@@ -1,35 +1,64 @@
 """Structured run events: a JSONL event sink + the run manifest.
 
 ``events.jsonl`` is the machine-readable companion of ``metrics.csv`` — one
-JSON object per line, every line carrying ``ts`` (epoch seconds) and
-``event`` (the kind). The trainer emits ``fit_start`` / ``log`` /
-``compile`` / ``eval`` / ``generate`` / ``graphlint`` (the static-analysis
-verdict on the train step's traced graph — analysis/, one event per fit) /
-``resume`` and the ``fault.*`` family (``fault.preempt`` / ``fault.skip`` /
-``fault.spike`` / ``fault.rollback`` / ``fault.halt`` /
+JSON object per line, every line carrying ``ts`` (epoch seconds),
+``event`` (the kind) and ``schema_version``. The trainer emits
+``fit_start`` / ``log`` / ``compile`` / ``eval`` / ``span`` (host
+step/fit/checkpoint spans — obs/trace.py) / ``graphlint`` (the
+static-analysis verdict on the train step's traced graph — analysis/, one
+event per fit) / ``resume`` and the ``fault.*`` family (``fault.preempt`` /
+``fault.skip`` / ``fault.spike`` / ``fault.rollback`` / ``fault.halt`` /
 ``fault.poison_batch`` / ``fault.fetch_retry`` — the fault-handling audit
 trail, training/faults.py, docs/robustness.md) / ``fit_end`` events through
-one :class:`EventLog`; ``tools/obs_report.py`` renders a run directory back
-into a summary table.
+one :class:`EventLog`; instrumented generation emits per-request
+``request`` rows (obs/slo.py aggregates them) and ``metrics`` registry
+snapshots (obs/metrics.py). ``tools/obs_report.py`` renders a run
+directory back into a summary table; ``tools/obs_diff.py`` diffs two runs.
 
 ``run_manifest.json`` pins what the run actually ran on: mesh shape,
 device kind/count, jax version, and a stable hash of the model/trainer
 configs — the context every perf number needs to be comparable later.
 
-Writes are gated to process 0 like ``training.metrics.MetricsLogger``
-(reference ``@rank_zero_only`` semantics): other processes get no-op sinks.
+Single-process runs gate writes to process 0 like
+``training.metrics.MetricsLogger`` (reference ``@rank_zero_only``
+semantics). Multi-process programs instead shard: every process writes its
+OWN ``events-p{process_index}.jsonl`` (a cross-host shared sink would
+interleave torn lines), and :func:`merged_events` k-way-merges the shards
+back into one stream with a monotonic-clock-skew-tolerant sort —
+``obs_report``/``obs_diff``/``obs.slo`` all read through it.
+
+Every row carries ``schema_version`` (:data:`EVENT_SCHEMA_VERSION`);
+:func:`validate_events` checks a stream against the per-kind required-field
+table plus span referential integrity, so schema drift fails a gate instead
+of silently confusing the next consumer. Rows emitted inside an open
+``obs.trace`` span are stamped with its ``span_id``.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import glob
 import hashlib
+import heapq
 import json
 import os
 import socket
 import time
 import warnings
-from typing import Dict, Optional
+from typing import Dict, Iterable, List, Optional
+
+# bump when a row's meaning changes incompatibly; validate_events pins it
+EVENT_SCHEMA_VERSION = 1
+
+
+def _process_topology() -> tuple:
+    """``(process_index, process_count)`` — (0, 1) before/without jax."""
+    try:
+        import jax
+
+        return int(jax.process_index()), int(jax.process_count())
+    except Exception:  # noqa: BLE001 — telemetry must work before jax init
+        return 0, 1
 
 
 class EventLog:
@@ -42,9 +71,26 @@ class EventLog:
     """
 
     def __init__(
-        self, log_dir: str, filename: str = "events.jsonl", main_process: Optional[bool] = None
+        self,
+        log_dir: str,
+        filename: str = "events.jsonl",
+        main_process: Optional[bool] = None,
+        process_index: Optional[int] = None,
+        process_count: Optional[int] = None,
     ):
-        if main_process is None:
+        if process_index is None or process_count is None:
+            pi, pc = _process_topology()
+            process_index = pi if process_index is None else process_index
+            process_count = pc if process_count is None else process_count
+        self.process_index = int(process_index)
+        self.process_count = int(process_count)
+        if self.process_count > 1 and filename == "events.jsonl":
+            # multi-process hygiene: one shard per process (every process
+            # writes — the fault/span events of process 3 matter too);
+            # merged_events() rebuilds the single stream
+            filename = f"events-p{self.process_index}.jsonl"
+            main_process = True
+        elif main_process is None:
             from perceiver_io_tpu.parallel.dist import is_main_process
 
             main_process = is_main_process()
@@ -60,25 +106,61 @@ class EventLog:
                 self._active = False
                 warnings.warn(f"EventLog disabled, cannot create {self.log_dir}: {e}")
 
+    def _row(self, event: str, fields: Dict) -> Dict:
+        row = {
+            "ts": round(time.time(), 6),
+            "event": str(event),
+            "schema_version": EVENT_SCHEMA_VERSION,
+        }
+        row.update(fields)
+        if "span_id" not in row:
+            # attribute the row to the innermost open host span (obs/trace):
+            # fault.* / resume / compile events become joinable to the step
+            # or request they happened in. span rows carry their own id.
+            from perceiver_io_tpu.obs.trace import current_span_id
+
+            sid = current_span_id()
+            if sid is not None:
+                row["span_id"] = sid
+        return row
+
+    @staticmethod
+    def _line(row: Dict) -> str:
+        # strict JSON: NaN/Inf (a diverged loss is exactly the run this
+        # log diagnoses) become null, not the invalid-JSON NaN extension
+        # that breaks jq / JSON.parse consumers of events.jsonl
+        try:
+            return json.dumps(row, default=str, allow_nan=False)
+        except ValueError:
+            return json.dumps(_nan_to_none(row), default=str, allow_nan=False)
+
     def emit(self, event: str, **fields) -> None:
         if not self._active:
             return
-        row = {"ts": round(time.time(), 6), "event": str(event)}
-        row.update(fields)
         try:
-            # strict JSON: NaN/Inf (a diverged loss is exactly the run this
-            # log diagnoses) become null, not the invalid-JSON NaN extension
-            # that breaks jq / JSON.parse consumers of events.jsonl
-            try:
-                line = json.dumps(row, default=str, allow_nan=False)
-            except ValueError:
-                line = json.dumps(_nan_to_none(row), default=str, allow_nan=False)
+            line = self._line(self._row(event, fields))
             with open(self.path, "a") as f:
                 f.write(line + "\n")
         except OSError as e:
             # the never-take-the-loop-down contract: a dead log filesystem
             # (disk full, run dir removed mid-run) deactivates the sink
             # instead of killing a long training run over telemetry
+            self._active = False
+            warnings.warn(f"EventLog deactivated, cannot write {self.path}: {e}")
+
+    def emit_rows(self, event: str, rows: Iterable[Dict]) -> None:
+        """Batch append: many rows of one kind through a single file open —
+        the span-buffer flush path (``obs.trace.Tracer``), where per-row
+        opens would tax the step loop."""
+        if not self._active:
+            return
+        try:
+            lines = [self._line(self._row(event, dict(r))) for r in rows]
+            if not lines:
+                return
+            with open(self.path, "a") as f:
+                f.write("\n".join(lines) + "\n")
+        except OSError as e:
             self._active = False
             warnings.warn(f"EventLog deactivated, cannot write {self.path}: {e}")
 
@@ -162,3 +244,144 @@ def write_run_manifest(
             # take the training loop down
             warnings.warn(f"run manifest not written to {log_dir}: {e}")
     return manifest
+
+
+# ---------------------------------------------------------------------------
+# reading the stream back: shard discovery, merge, validation
+# ---------------------------------------------------------------------------
+
+
+def event_shards(run_dir: str) -> List[str]:
+    """The event files of a run directory: ``events.jsonl`` (single-process)
+    and/or ``events-p*.jsonl`` (one per process), index-sorted."""
+    out = []
+    single = os.path.join(run_dir, "events.jsonl")
+    if os.path.exists(single):
+        out.append(single)
+
+    def _pidx(path):
+        try:
+            return int(os.path.basename(path)[len("events-p") : -len(".jsonl")])
+        except ValueError:
+            return 1 << 30
+    out.extend(sorted(glob.glob(os.path.join(run_dir, "events-p*.jsonl")), key=_pidx))
+    return out
+
+
+def read_event_file(path: str) -> List[Dict]:
+    """Parse one shard; a torn tail line (killed run) is skipped, torn lines
+    elsewhere too (the validator, not the reader, complains about those)."""
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rows.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return rows
+
+
+def merged_events(run_dir: str) -> List[Dict]:
+    """One event stream for the run, whatever the process count.
+
+    K-way merge of the shards by timestamp with a **monotonic-clock-skew
+    guard**: within a shard, file order is authoritative (it is the order
+    the process actually emitted in), so each row's sort key is the running
+    max of its shard's timestamps — a row whose wall clock stepped backwards
+    (NTP slew mid-run) cannot be sorted before its own predecessors; across
+    shards, skewed clocks degrade interleaving accuracy but never reorder
+    any single process's history. Ties break on (shard index, row index),
+    keeping the merge deterministic."""
+    streams = []
+    for shard_i, path in enumerate(event_shards(run_dir)):
+        rows = read_event_file(path)
+        keyed = []
+        ts_eff = float("-inf")
+        for row_i, row in enumerate(rows):
+            try:
+                ts = float(row.get("ts", 0.0))
+            except (TypeError, ValueError):
+                ts = 0.0
+            ts_eff = max(ts_eff, ts)
+            keyed.append(((ts_eff, shard_i, row_i), row))
+        streams.append(keyed)
+    return [row for _, row in heapq.merge(*streams, key=lambda kr: kr[0])]
+
+
+# per-kind required fields (validate_events); kinds not listed are allowed —
+# the table pins the CONSUMED schema, not an exhaustive vocabulary
+_REQUIRED_FIELDS: Dict[str, tuple] = {
+    "fit_start": ("start_step", "max_steps"),
+    "fit_end": ("step", "aborted"),
+    "log": ("step",),
+    "eval": ("step",),
+    "compile": ("fn", "wall_s", "n_compiles"),
+    "resume": ("from_step", "to_step"),
+    "span": ("name", "span_id", "t_start", "t_end", "dur_ms", "process_index", "attrs"),
+    "request": ("request_id", "batch", "prompt_len", "ttft_s", "outcome", "tokens_out"),
+    "metrics": ("counters", "gauges", "histograms"),
+    "graphlint": (),
+    "graphcheck": (),
+}
+
+
+def validate_events(path: str, strict_spans: bool = True) -> List[str]:
+    """Validate an event stream (a run directory or one shard file);
+    returns a list of problems (empty = valid).
+
+    Checks every row parses as strict JSON, carries ``ts``/``event``/
+    ``schema_version`` (pinned to :data:`EVENT_SCHEMA_VERSION`), and has the
+    per-kind required fields; a torn line is tolerated only as the LAST line
+    of its shard. With ``strict_spans`` every ``span_id``/``parent_id``
+    reference must resolve to a ``span`` row in the same (merged) stream —
+    the property that makes fault events attributable after the fact."""
+    problems: List[str] = []
+    shards = event_shards(path) if os.path.isdir(path) else [path]
+    if not shards:
+        return [f"{path}: no events.jsonl / events-p*.jsonl"]
+    rows: List[Dict] = []
+    for shard in shards:
+        name = os.path.basename(shard)
+        with open(shard) as f:
+            lines = [ln for ln in (l.strip() for l in f) if ln]
+        for i, line in enumerate(lines):
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                if i == len(lines) - 1:
+                    continue  # torn tail of a killed run: expected
+                problems.append(f"{name}:{i + 1}: unparseable line mid-file")
+                continue
+            if not isinstance(row, dict):
+                problems.append(f"{name}:{i + 1}: row is not an object")
+                continue
+            rows.append(row)
+            kind = row.get("event")
+            if not isinstance(kind, str):
+                problems.append(f"{name}:{i + 1}: missing/invalid 'event'")
+                continue
+            if not isinstance(row.get("ts"), (int, float)):
+                problems.append(f"{name}:{i + 1} [{kind}]: missing/invalid 'ts'")
+            if row.get("schema_version") != EVENT_SCHEMA_VERSION:
+                problems.append(
+                    f"{name}:{i + 1} [{kind}]: schema_version "
+                    f"{row.get('schema_version')!r} != {EVENT_SCHEMA_VERSION}"
+                )
+            for field in _REQUIRED_FIELDS.get(kind, ()):
+                if field not in row:
+                    problems.append(f"{name}:{i + 1} [{kind}]: missing field {field!r}")
+    if strict_spans:
+        span_ids = {r.get("span_id") for r in rows if r.get("event") == "span"}
+        for r in rows:
+            kind = r.get("event")
+            sid = r.get("span_id")
+            if kind != "span" and sid is not None and sid not in span_ids:
+                problems.append(f"[{kind}] span_id {sid!r} has no span row in the stream")
+            if kind == "span":
+                pid = r.get("parent_id")
+                if pid is not None and pid not in span_ids:
+                    problems.append(f"[span {r.get('name')}] parent_id {pid!r} unresolvable")
+    return problems

@@ -1,0 +1,283 @@
+"""Metrics registry: counters, gauges and log-bucketed latency histograms.
+
+The substrate the serving road publishes into (ROADMAP item 1: the
+continuous-batching scheduler's queue depth, admission rate and per-request
+latencies all land here): record paths are a dict update under a lock —
+cheap enough for per-token calls — and the registry exports three ways:
+
+- ``snapshot()`` — plain JSON dict (what lands in a ``metrics`` event row;
+  ``maybe_emit`` rate-limits the rows so per-request callers can snapshot
+  opportunistically without flooding events.jsonl);
+- ``to_prometheus()`` — Prometheus text exposition (counters, gauges, and
+  cumulative ``_bucket{le=...}`` histogram series) for scrape endpoints;
+- per-histogram ``percentile()`` — p50/p99 **from the buckets**, not means.
+
+Histograms are log-bucketed: bucket ``i`` covers ``[GROWTH**i, GROWTH**(i+1))``
+with ``GROWTH = 2**0.25`` (~19% wide), so a reported percentile is the bucket's
+geometric midpoint — within ~9% of the true order statistic at any scale from
+microseconds to minutes, with O(1) record cost and a sparse dict of counts
+that merges exactly across histograms (the property ``obs/slo.py`` uses to
+aggregate per-request TPOT histograms into run percentiles).
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import threading
+import time
+from typing import Dict, Optional
+
+# bucket width factor: 2**0.25 per bucket — 4 buckets per octave, ~9% max
+# midpoint error; shared by every histogram so counts merge exactly
+GROWTH = 2.0**0.25
+_LOG_GROWTH = math.log(GROWTH)
+# values at or below this clamp into the bottom bucket (zero/negative
+# latencies are clock-resolution artifacts, not data)
+_MIN_VALUE = 1e-9
+_MIN_INDEX = int(math.floor(math.log(_MIN_VALUE) / _LOG_GROWTH))
+
+
+def bucket_index(value: float) -> int:
+    """The log-bucket index of a positive value (clamped at the bottom)."""
+    v = float(value)
+    if not v > _MIN_VALUE:
+        return _MIN_INDEX
+    return max(int(math.floor(math.log(v) / _LOG_GROWTH)), _MIN_INDEX)
+
+
+def bucket_bounds(index: int) -> tuple:
+    return (GROWTH**index, GROWTH ** (index + 1))
+
+
+def bucket_mid(index: int) -> float:
+    """Geometric midpoint — the representative value of one bucket."""
+    return GROWTH ** (index + 0.5)
+
+
+def percentile_from_counts(counts: Dict[int, int], p: float) -> Optional[float]:
+    """Nearest-rank percentile over sparse ``{bucket_index: count}`` —
+    returns the hit bucket's geometric midpoint, or None when empty.
+    ``counts`` may be the merge of many histograms (bucket bounds are
+    global), which is exactly how run-level SLO percentiles are built."""
+    if not 0.0 <= p <= 100.0:
+        raise ValueError(f"percentile p must be in [0, 100], got {p}")
+    total = sum(counts.values())
+    if total == 0:
+        return None
+    target = max(int(math.ceil(p / 100.0 * total)), 1)
+    seen = 0
+    for idx in sorted(counts):
+        seen += counts[idx]
+        if seen >= target:
+            return bucket_mid(idx)
+    return bucket_mid(max(counts))  # unreachable; defensive
+
+
+def merge_counts(*count_dicts: Dict) -> Dict[int, int]:
+    """Sum sparse bucket-count dicts (string keys from JSON round-trips are
+    accepted)."""
+    out: Dict[int, int] = {}
+    for d in count_dicts:
+        for k, v in (d or {}).items():
+            out[int(k)] = out.get(int(k), 0) + int(v)
+    return out
+
+
+class Counter:
+    """Monotonic counter. ``inc`` is the only mutation."""
+
+    def __init__(self, name: str, help: str = ""):
+        self.name, self.help = name, help
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError("counters only go up; use a Gauge")
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Gauge:
+    """Last-write-wins scalar (queue depth, inflight requests, ...)."""
+
+    def __init__(self, name: str, help: str = ""):
+        self.name, self.help = name, help
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    def add(self, n: float) -> None:
+        with self._lock:
+            self._value += float(n)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Histogram:
+    """Log-bucketed distribution (see module docstring). Standalone-usable:
+    the instrumented generate fn keeps one per request for the TPOT
+    percentiles its ``request`` event carries."""
+
+    def __init__(self, name: str, help: str = ""):
+        self.name, self.help = name, help
+        self.counts: Dict[int, int] = {}
+        self.n = 0
+        self.sum = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+        self._lock = threading.Lock()
+
+    def record(self, value: float) -> None:
+        v = float(value)
+        idx = bucket_index(v)
+        with self._lock:
+            self.counts[idx] = self.counts.get(idx, 0) + 1
+            self.n += 1
+            self.sum += v
+            self.min = v if self.min is None else min(self.min, v)
+            self.max = v if self.max is None else max(self.max, v)
+
+    def percentile(self, p: float) -> Optional[float]:
+        """Bucket-midpoint percentile, clamped into the observed [min, max]
+        (a one-sample histogram reports the sample, not its bucket's
+        midpoint)."""
+        out = percentile_from_counts(self.counts, p)
+        if out is None:
+            return None
+        if self.min is not None:
+            out = min(max(out, self.min), self.max)
+        return out
+
+    def to_dict(self) -> Dict:
+        d = {
+            "n": self.n,
+            "sum": round(self.sum, 9),
+            "min": self.min,
+            "max": self.max,
+            "counts": {str(k): v for k, v in sorted(self.counts.items())},
+        }
+        if self.n:
+            for p in (50, 90, 99):
+                d[f"p{p}"] = self.percentile(p)
+            if self.n < 5:
+                # the low-sample convention shared with StepTimer.summary:
+                # a 3-sample p99 is an order statistic, not a tail estimate
+                d["low_n"] = True
+        return d
+
+
+def _prom_name(name: str) -> str:
+    return re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+
+
+class MetricsRegistry:
+    """Get-or-create registry of named metrics; the name is the identity
+    (asking twice returns the same object, asking with a different type
+    raises)."""
+
+    def __init__(self):
+        self._metrics: Dict[str, object] = {}
+        self._lock = threading.Lock()
+        self._last_emit = 0.0
+
+    def _get(self, name: str, cls, help: str):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, help)
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {type(m).__name__}"
+                )
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get(name, Counter, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get(name, Gauge, help)
+
+    def histogram(self, name: str, help: str = "") -> Histogram:
+        return self._get(name, Histogram, help)
+
+    def __len__(self) -> int:
+        return len(self._metrics)
+
+    def snapshot(self) -> Dict:
+        """JSON-ready state of every metric — the ``metrics`` event body."""
+        out: Dict = {"counters": {}, "gauges": {}, "histograms": {}}
+        with self._lock:
+            items = list(self._metrics.items())
+        for name, m in items:
+            if isinstance(m, Counter):
+                out["counters"][name] = m.value
+            elif isinstance(m, Gauge):
+                out["gauges"][name] = m.value
+            elif isinstance(m, Histogram):
+                out["histograms"][name] = m.to_dict()
+        return out
+
+    def emit_snapshot(self, events) -> None:
+        """One ``metrics`` event row with the full snapshot."""
+        events.emit("metrics", **self.snapshot())
+        self._last_emit = time.monotonic()
+
+    def maybe_emit(self, events, min_interval_s: float = 30.0) -> bool:
+        """Rate-limited :meth:`emit_snapshot` — call it opportunistically
+        from hot-ish paths (per request, per log window); at most one row
+        per ``min_interval_s``. Returns True when a row was written."""
+        if events is None or not self._metrics:
+            return False
+        now = time.monotonic()
+        if now - self._last_emit < min_interval_s:
+            return False
+        self.emit_snapshot(events)
+        return True
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition of the registry (counters/gauges as-is,
+        histograms as cumulative ``_bucket{le="..."}`` series + _sum/_count)."""
+        lines = []
+        with self._lock:
+            items = sorted(self._metrics.items())
+        for name, m in items:
+            pname = _prom_name(name)
+            if m.help:
+                lines.append(f"# HELP {pname} {m.help}")
+            if isinstance(m, Counter):
+                lines.append(f"# TYPE {pname} counter")
+                lines.append(f"{pname} {m.value:g}")
+            elif isinstance(m, Gauge):
+                lines.append(f"# TYPE {pname} gauge")
+                lines.append(f"{pname} {m.value:g}")
+            elif isinstance(m, Histogram):
+                lines.append(f"# TYPE {pname} histogram")
+                cum = 0
+                for idx in sorted(m.counts):
+                    cum += m.counts[idx]
+                    le = bucket_bounds(idx)[1]
+                    lines.append(f'{pname}_bucket{{le="{le:g}"}} {cum}')
+                lines.append(f'{pname}_bucket{{le="+Inf"}} {m.n}')
+                lines.append(f"{pname}_sum {m.sum:g}")
+                lines.append(f"{pname}_count {m.n}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+_DEFAULT = MetricsRegistry()
+
+
+def default_registry() -> MetricsRegistry:
+    """The process-wide registry (callers that want isolation construct
+    their own — the instrumented generate fn does)."""
+    return _DEFAULT

@@ -1,0 +1,99 @@
+"""Per-request SLO aggregation — ``request`` events → an SLO report artifact.
+
+The serving literature gates on per-request percentiles (TTFT / TPOT
+p50/p99 in the Gemma-on-TPU comparison, per-request latency under mixed
+prefill/decode in Ragged Paged Attention); this module turns the
+``request`` rows ``generation.make_instrumented_generate_fn`` emits into
+those numbers:
+
+- **TTFT** percentiles are exact order statistics over the per-request
+  scalars (``utils.profiling.summarize_latencies`` — nearest-rank + a
+  ``low_n`` mark under 5 samples, never an interpolated fake tail);
+- **TPOT** percentiles are derived from the **merged per-request
+  histograms**: every request row carries its sparse log-bucket counts
+  (``tpot_hist``; global bucket bounds — ``obs.metrics.GROWTH``), so
+  merging is exact addition and the run-level p99 is a real distribution
+  percentile over every decoded token, not a mean of means.
+
+``build_slo_report`` prefers **warm** requests (excluding calls that paid a
+compile) for the latency sections — compile-inflated latencies are not
+steady state — falling back to all requests (flagged) when every call
+compiled. ``write_slo_report`` persists ``slo_report.json`` next to
+``events.jsonl``; ``tools/obs_diff.py`` diffs two runs' SLO percentiles
+under declared tolerances.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+SLO_REPORT_SCHEMA_VERSION = 1
+
+
+def iter_requests(events: List[Dict]) -> List[Dict]:
+    return [e for e in events if e.get("event") == "request"]
+
+
+def build_slo_report(events: List[Dict]) -> Optional[Dict]:
+    """The SLO aggregate of one run's event stream (None when the run made
+    no requests)."""
+    from perceiver_io_tpu.obs.metrics import merge_counts, percentile_from_counts
+    from perceiver_io_tpu.utils.profiling import summarize_latencies
+
+    requests = iter_requests(events)
+    if not requests:
+        return None
+    outcomes: Dict[str, int] = {}
+    for r in requests:
+        o = str(r.get("outcome", "?"))
+        outcomes[o] = outcomes.get(o, 0) + 1
+    ok = [r for r in requests if r.get("outcome") == "ok"]
+    warm = [r for r in ok if not r.get("compiled")]
+    latency_pool, warm_only = (warm, True) if warm else (ok, False)
+
+    report: Dict = {
+        "schema_version": SLO_REPORT_SCHEMA_VERSION,
+        "n_requests": len(requests),
+        "outcomes": outcomes,
+        "error_rate": round(outcomes.get("error", 0) / len(requests), 6),
+        "tokens_in": sum(int(r.get("prompt_len", 0)) * int(r.get("batch", 1)) for r in requests),
+        "tokens_out": sum(int(r.get("tokens_out", 0)) * int(r.get("batch", 1)) for r in requests),
+        "warm_only": warm_only,
+        "n_latency_requests": len(latency_pool),
+    }
+    if latency_pool:
+        ttfts = [float(r["ttft_s"]) for r in latency_pool if r.get("ttft_s") is not None]
+        if ttfts:
+            report["ttft_s"] = {
+                k: round(v, 6) if isinstance(v, float) else v
+                for k, v in summarize_latencies(ttfts).items()
+            }
+        merged = merge_counts(*(r.get("tpot_hist", {}) for r in latency_pool))
+        n_tokens = sum(merged.values())
+        if n_tokens:
+            tpot = {
+                f"p{p}": round(percentile_from_counts(merged, p), 6) for p in (50, 90, 99)
+            }
+            tpot["n"] = n_tokens
+            if n_tokens < 5:
+                tpot["low_n"] = True
+            report["tpot_s"] = tpot
+        tps = [float(r["tokens_per_sec"]) for r in latency_pool if r.get("tokens_per_sec")]
+        if tps:
+            report["tokens_per_sec_mean"] = round(sum(tps) / len(tps), 3)
+    return report
+
+
+def write_slo_report(run_dir: str, filename: str = "slo_report.json") -> Optional[Dict]:
+    """Aggregate the run directory's (merged, shard-aware) event stream and
+    persist the report beside it; returns the report (None when there are
+    no requests — nothing is written)."""
+    from perceiver_io_tpu.obs.events import merged_events
+
+    report = build_slo_report(merged_events(run_dir))
+    if report is not None:
+        with open(os.path.join(run_dir, filename), "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+    return report

@@ -12,6 +12,7 @@ collectives (the NCCL-free equivalent of DDP/FSDP strategies, SURVEY §2.7).
 
 from __future__ import annotations
 
+import contextlib
 import time
 from dataclasses import dataclass
 from typing import Callable, Dict, Iterable, Optional, Sequence
@@ -92,6 +93,15 @@ class TrainerConfig:
     # structured events.jsonl + run_manifest.json next to metrics.csv
     # (written only when a logger is attached)
     events: bool = True
+    # host spans (obs/trace.py): a `fit` span wrapping the run (published
+    # ambient, so producer-thread events — fault.poison_batch /
+    # fault.fetch_retry — attach to it), a per-step `step` span carrying
+    # input_wait_ms/dispatch_ms attrs, and `checkpoint`/`eval` spans; every
+    # fault.*/resume/graphlint/compile event emitted inside one is stamped
+    # with its span_id, making incidents attributable to the exact step.
+    # Span rows are buffered and flushed at log boundaries and fit exits
+    # (per-step file appends would tax a millisecond-scale TPU step).
+    spans: bool = True
     # analytic per-sample accounting for MFU/throughput log fields: latent
     # tokens per sample and fwd+bwd model FLOPs per sample
     # (obs.mfu.clm_train_telemetry derives both from a CLM config); None
@@ -439,6 +449,20 @@ class Trainer:
         peak = cfg.peak_flops_per_device
         if peak is None:
             peak = device_peak_flops()
+        # host spans (obs/trace.py): the fit span opens BEFORE fit_start so
+        # fit_start/resume — and, via the ambient fallback, producer-thread
+        # fault events — are stamped with its span_id
+        tracer = None
+        span_stack = contextlib.ExitStack()
+        if events is not None and cfg.spans:
+            from perceiver_io_tpu.obs.trace import Tracer
+
+            tracer = Tracer(events)
+            span_stack.enter_context(
+                tracer.span("fit", ambient=True, start_step=int(state.step))
+            )
+        from perceiver_io_tpu.obs.trace import maybe_span
+
         if events is not None:
             events.emit("fit_start", start_step=int(state.step), max_steps=cfg.max_steps)
             if resume_info is not None:
@@ -530,6 +554,12 @@ class Trainer:
             pending_batch = None
             pending_exc = None
             input_wait_s = 0.0
+            # the open per-iteration span: closed at the NEXT iteration's
+            # top (or in the finally below) rather than a with-block, so the
+            # log/eval/checkpoint tail of an iteration stays inside its step
+            # span and fault events emitted anywhere in the iteration carry
+            # its span_id
+            step_span = None
             # perf_counter, matching GoodputTracker's clock: the goodput
             # subtraction must not mix monotonic and wall (NTP-steppable) time
             t0 = time.perf_counter()
@@ -545,6 +575,10 @@ class Trainer:
                         # cleanup parks unconsumed batches
                         preempted = True
                         break
+                    if tracer is not None:
+                        if step_span is not None:
+                            tracer.end(step_span)
+                        step_span = tracer.start("step")
                     # input_wait: host time BLOCKED obtaining the batch this
                     # step consumes — the double buffer below drives it to ~0
                     t_in = time.perf_counter()
@@ -558,7 +592,10 @@ class Trainer:
                         batch, pending_batch = pending_batch, None
                     else:
                         batch = self._prepare_batch(next(train_iter))
-                    input_wait_s += time.perf_counter() - t_in
+                    step_wait_s = time.perf_counter() - t_in
+                    input_wait_s += step_wait_s
+                    if step_span is not None:
+                        step_span.set("input_wait_ms", round(step_wait_s * 1e3, 3))
                     if lint_pending:
                         lint_pending = False
                         with goodput.measure("graphlint"):
@@ -571,7 +608,15 @@ class Trainer:
                                 self._graphlint(events, state, batch, closed)
                             if cfg.graphcheck:
                                 self._graphcheck(events, state, batch, closed)
+                    t_dispatch = time.perf_counter()
                     state, metrics = self._train_step(state, batch)
+                    if step_span is not None:
+                        # host wall of ISSUING the step (trace+compile on a
+                        # miss, dispatch otherwise) — device compute is async
+                        # and comes from the xplane rollup side of the join
+                        step_span.set(
+                            "dispatch_ms", round((time.perf_counter() - t_dispatch) * 1e3, 3)
+                        )
                     if cfg.input_double_buffer and i + 1 < cfg.max_steps:
                         # the step above is dispatched asynchronously: issue
                         # the NEXT batch's device_put now so the host->device
@@ -589,6 +634,8 @@ class Trainer:
                     window.append(metrics)
                     window_samples += _leading_dim(batch)
                     step = i = int(state.step)
+                    if step_span is not None:
+                        step_span.set("step", step)
 
                     if sentinel is not None:
                         decision = self._sentinel_decide(sentinel, events, metrics, step)
@@ -689,6 +736,8 @@ class Trainer:
                         self._log(step, avg)
                         if events is not None:
                             events.emit("log", step=step, **avg)
+                        if tracer is not None:
+                            tracer.flush()  # span rows land once per window
                         window, window_samples, t0 = [], 0, time.perf_counter()
                         input_wait_s = 0.0
                         window_overhead0 = goodput.overhead()
@@ -700,7 +749,8 @@ class Trainer:
                         # so the two buckets never double-count the same seconds
                         eval_t0 = time.perf_counter()
                         compile_s0 = self.recompiles.total_compile_s
-                        val_metrics = self.validate(state, val_loader)
+                        with maybe_span(tracer, "eval"):
+                            val_metrics = self.validate(state, val_loader)
                         goodput.add(
                             "eval",
                             (time.perf_counter() - eval_t0)
@@ -710,11 +760,14 @@ class Trainer:
                         if events is not None:
                             events.emit("eval", step=step, **val_metrics)
                         if self.checkpoints is not None:
-                            with goodput.measure("checkpoint"):
+                            with goodput.measure("checkpoint"), maybe_span(tracer, "checkpoint"):
                                 self.checkpoints.save(state, metrics=val_metrics, config=model_config)
                         for cb in self.callbacks:
                             cb(self, state, step)
             finally:
+                if step_span is not None:
+                    tracer.end(step_span)
+                    step_span = None
                 parked = False
                 if prefetch is not None:
                     prefetch.close()
@@ -758,7 +811,7 @@ class Trainer:
                     # over the same directory — full state (exact resume
                     # needs the optimizer), no fresh val metric required,
                     # and retention can never evict the best-val step
-                    with goodput.measure("checkpoint"):
+                    with goodput.measure("checkpoint"), maybe_span(tracer, "checkpoint"):
                         pm = CheckpointManager(
                             cfg.checkpoint_dir, max_to_keep=None, monitor=None
                         )
@@ -777,11 +830,16 @@ class Trainer:
                     monitor=None,
                     save_weights_only=self.config.save_weights_only,
                 )
-                with goodput.measure("checkpoint"):
+                with goodput.measure("checkpoint"), maybe_span(tracer, "checkpoint"):
                     final_mngr.save(state, config=model_config)
                     final_mngr.close()
         except BaseException:
             self._release_guard(guard)
+            # close + flush the fit span BEFORE fit_end: an aborted run's
+            # stream still resolves every span_id its fault events carry
+            span_stack.close()
+            if tracer is not None:
+                tracer.flush()
             if events is not None:
                 events.emit(
                     "fit_end",
@@ -792,6 +850,9 @@ class Trainer:
                 )
             raise
         self._release_guard(guard)
+        span_stack.close()
+        if tracer is not None:
+            tracer.flush()
         if events is not None:
             events.emit(
                 "fit_end",

@@ -65,16 +65,15 @@ class StepTimer:
 
     def summary(self) -> Dict[str, float]:
         """The percentile summary the class docstring promises: p50/p90/p99
-        plus mean and sample count. (``bench.py`` builds its telemetry
-        percentiles from :func:`percentile` directly — its samples need
-        per-chain normalization before summarizing.)"""
-        return {
-            "mean": self.mean(),
-            "p50": self.percentile(50),
-            "p90": self.percentile(90),
-            "p99": self.percentile(99),
-            "n": float(len(self.steps)),
-        }
+        plus mean and sample count. Below :data:`LOW_N` samples the
+        percentiles are exact order statistics (nearest rank, no
+        interpolation) and the row carries ``low_n`` — a 3-sample window has
+        no p99 tail, and interpolating one would print a fake number
+        consumers (obs_report, obs_diff) cannot distinguish from a real
+        tail. (``bench.py`` builds its telemetry percentiles from
+        :func:`percentile` directly — its samples need per-chain
+        normalization before summarizing — and applies the same rule.)"""
+        return summarize_latencies(self.steps)
 
     def steps_per_sec(self) -> float:
         return 1.0 / self.mean()
@@ -90,3 +89,43 @@ def percentile(values: Sequence[float], p: float) -> float:
     import numpy as np
 
     return float(np.percentile(list(values), p))
+
+
+# below this many samples, percentile summaries switch to exact order
+# statistics and are marked low_n (interpolated tails over 3 points are
+# extrapolation dressed up as measurement)
+LOW_N = 5
+
+
+def exact_percentile(values: Sequence[float], p: float) -> float:
+    """Nearest-rank percentile: the smallest order statistic covering at
+    least p% of the sample — always an observed value, never interpolated."""
+    if not values:
+        raise ValueError("percentile of an empty sequence")
+    if not 0.0 <= p <= 100.0:
+        raise ValueError(f"percentile p must be in [0, 100], got {p}")
+    s = sorted(float(v) for v in values)
+    import math
+
+    return s[max(int(math.ceil(p / 100.0 * len(s))) - 1, 0)]
+
+
+def summarize_latencies(values: Sequence[float]) -> Dict[str, float]:
+    """``{mean, p50, p90, p99, n[, low_n]}`` — the shared latency-summary
+    shape (StepTimer.summary, span breakdowns, SLO aggregation). Below
+    :data:`LOW_N` samples: exact order statistics plus ``low_n: True``."""
+    vals = [float(v) for v in values]
+    if not vals:
+        raise ValueError("No timed steps (after warmup discard)")
+    low_n = len(vals) < LOW_N
+    pct = exact_percentile if low_n else percentile
+    out = {
+        "mean": sum(vals) / len(vals),
+        "p50": pct(vals, 50),
+        "p90": pct(vals, 90),
+        "p99": pct(vals, 99),
+        "n": float(len(vals)),
+    }
+    if low_n:
+        out["low_n"] = True
+    return out

@@ -10,7 +10,8 @@ equivalent with the same task names:
     python tasks.py docker [--tag TAG]
     python tasks.py bench [...args]    # the driver benchmark (real chip)
     python tasks.py graphlint [...]    # static-analysis gate (compiled graphs)
-    python tasks.py perf [...]         # perf CI: graphcheck contracts + graphlint + bench floors
+    python tasks.py perf [...]         # perf CI: graphcheck contracts + graphlint + bench floors + obs gate
+    python tasks.py obs [...]          # observability gate (spans/requests/SLO + obs_diff self-check)
     python tasks.py dryrun [...]       # 8-virtual-device multichip certification
     python tasks.py chaos [...]        # fault-injection gate (preempt/NaN/torn-save)
 """
@@ -150,12 +151,26 @@ def graphlint(args):
 
 
 @task
+def obs(args):
+    """Observability gate (tools/obs_gate.py; docs/observability.md): a
+    10-step synthetic fit + instrumented generate requests, event-stream
+    schema/span validation, obs_report render, obs_diff run-vs-itself
+    (must be clean). Extra args pass through (e.g. ``--baseline DIR``,
+    ``--out DIR --keep`` to record a new baseline)."""
+    run(sys.executable, "tools/obs_gate.py", *args.rest)
+
+
+@task
 def perf(args):
     """The standing perf-CI gate (docs/static-analysis.md): graphcheck —
     compiled-graph contracts vs contracts/, graduation-ledger validation,
     committed-bench floors — then the graphlint rule gate, then the
     dataflow rules (rng-key-reuse, dead-compute, sharding-flow,
-    cross-program-consistency) over all five flagship programs. Extra args
+    cross-program-consistency) over all five flagship programs, then the
+    observability gate — the RUNTIME leg: with ``OBS_BASELINE_RUN`` set to
+    a recorded baseline run directory (``tasks.py obs --out DIR --keep``),
+    obs_diff classifies MFU/goodput/step-p99/SLO drift against it under
+    declared tolerances (stale = not comparable ≠ regression). Extra args
     go to tools/graphcheck.py (e.g. ``--programs train_flat,decode``)."""
     run(sys.executable, "tools/graphcheck.py", *args.rest)
     run(sys.executable, "tools/graphlint.py", "--fail-on", "error")
@@ -163,6 +178,11 @@ def perf(args):
     # programs; the dataflow rules need only the jaxpr
     run(sys.executable, "tools/graphlint.py", "--programs", "all",
         "--no-compiled", "--fail-on", "error")
+    obs_cmd = [sys.executable, "tools/obs_gate.py"]
+    baseline = os.environ.get("OBS_BASELINE_RUN")
+    if baseline:
+        obs_cmd += ["--baseline", baseline]
+    run(*obs_cmd)
 
 
 def main(argv=None):

@@ -85,3 +85,50 @@ def test_ledger_validates_and_cites_existing_artifacts():
         assert glob.glob(os.path.join(REPO, floor["artifact"])), (
             f"floor {name} cites artifact pattern {floor['artifact']!r} with no match"
         )
+
+
+def test_smoke_fit_event_stream_validates(tmp_path):
+    """The event stream a real (tiny) fit writes must pass validate_events —
+    the runtime analog of the BENCH_* pins above: silent schema drift in
+    events.jsonl fails tier-1 here instead of confusing obs_report/obs_diff
+    (and the re-anchor reviewer) a round later."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from perceiver_io_tpu.models.text import CausalLanguageModel, CausalLanguageModelConfig
+    from perceiver_io_tpu.obs.events import EVENT_SCHEMA_VERSION, merged_events, validate_events
+    from perceiver_io_tpu.training import (
+        MetricsLogger,
+        TrainState,
+        Trainer,
+        TrainerConfig,
+        clm_loss_fn,
+        make_optimizer,
+    )
+
+    config = CausalLanguageModelConfig(
+        vocab_size=50, max_seq_len=24, max_latents=8, num_channels=32,
+        num_heads=4, num_self_attention_layers=2, cross_attention_dropout=0.5,
+    )
+    model = CausalLanguageModel(config)
+    t = np.random.default_rng(0).integers(0, 50, size=(4, config.max_seq_len + 1))
+    batch = {"labels": jnp.asarray(t[:, 1:]), "input_ids": jnp.asarray(t[:, :-1]),
+             "pad_mask": None}
+    params = model.init(jax.random.PRNGKey(0), batch["input_ids"], prefix_len=16)
+    state = TrainState.create(model.apply, params, make_optimizer(1e-3), jax.random.PRNGKey(1))
+    logger = MetricsLogger(str(tmp_path), use_tensorboard=False)
+    trainer = Trainer(
+        clm_loss_fn(model.apply, max_latents=config.max_latents),
+        logger=logger,
+        config=TrainerConfig(max_steps=3, log_interval=2, prefetch_batches=0),
+    )
+    trainer.fit(state, iter([batch] * 3), model_config=config)
+    trainer.close()
+    logger.close()
+
+    assert validate_events(str(tmp_path)) == [], "smoke-fit event stream drifted"
+    events = merged_events(str(tmp_path))
+    assert all(e["schema_version"] == EVENT_SCHEMA_VERSION for e in events)
+    kinds = {e["event"] for e in events}
+    assert {"fit_start", "log", "compile", "span", "fit_end"} <= kinds

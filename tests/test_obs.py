@@ -415,6 +415,36 @@ def test_steptimer_percentile_summary():
         percentile([1.0], 150)
 
 
+def test_steptimer_summary_low_n_uses_exact_order_statistics():
+    """Satellite fix: under 5 samples the summary must report exact order
+    statistics (nearest rank — the p99 of 3 samples IS the max) and mark
+    the row low_n, instead of interpolating a fake tail."""
+    from perceiver_io_tpu.utils.profiling import StepTimer, exact_percentile
+
+    timer = StepTimer(warmup=1)
+    timer._times = [99.0, 1.0, 10.0, 2.0]  # 3 retained samples
+    s = timer.summary()
+    assert s["low_n"] is True and s["n"] == 3
+    assert s["p50"] == 2.0  # the middle observation, not an interpolation
+    assert s["p90"] == 10.0 and s["p99"] == 10.0  # the max — no fake tail
+    assert s["mean"] == pytest.approx(13.0 / 3)
+    # ≥5 samples: interpolated percentiles, no low_n mark
+    timer._times = [99.0] + [float(i) for i in range(1, 6)]
+    s5 = timer.summary()
+    assert "low_n" not in s5 and s5["p99"] == pytest.approx(4.96)
+    assert exact_percentile([3.0, 1.0, 2.0], 0) == 1.0
+    with pytest.raises(ValueError):
+        exact_percentile([], 50)
+    # bench telemetry blocks apply the same rule
+    import bench
+
+    t = bench.telemetry_fields(None, 1.0, [0.1, 0.2, 0.3])["telemetry"]
+    assert t["step_ms"]["low_n"] is True
+    assert t["step_ms"]["p99"] == pytest.approx(300.0)  # exact max, in ms
+    t5 = bench.telemetry_fields(None, 1.0, [0.1] * 5)["telemetry"]
+    assert "low_n" not in t5["step_ms"]
+
+
 # -------------------------------------------------------------- goodput
 
 
@@ -488,7 +518,10 @@ def test_obs_report_renders_run_summary(tmp_path):
 # ------------------------------------------------------------ generation
 
 
-def test_instrumented_generation_stats_and_events(tmp_path):
+def test_instrumented_generation_stats_and_request_events(tmp_path):
+    """Acceptance pin: one `request` event per request, carrying TTFT and
+    histogram-derived TPOT p50/p99 (not means), tokens in/out, cache
+    geometry and outcome; spans + compile events attributed per request."""
     from perceiver_io_tpu.generation import GenerationConfig, make_instrumented_generate_fn
 
     model, config = tiny_clm()
@@ -497,23 +530,476 @@ def test_instrumented_generation_stats_and_events(tmp_path):
     params = model.init(jax.random.PRNGKey(0), prompt, prefix_len=8)
     events = EventLog(str(tmp_path), main_process=True)
     fn = make_instrumented_generate_fn(
-        model, num_latents=4, config=GenerationConfig(max_new_tokens=4), events=events
+        model, num_latents=4, config=GenerationConfig(max_new_tokens=6), events=events
     )
     out, stats = fn(params, prompt)
-    assert out.shape == (2, 16)
+    assert out.shape == (2, 18)
     assert stats.compiled  # first call pays the compiles
     assert stats.prefill_s > 0 and stats.decode_s >= 0
+    assert stats.ttft_s == stats.prefill_s
     assert stats.tokens_per_sec > 0
-    assert stats.batch == 2 and stats.prompt_len == 12 and stats.new_tokens == 4
+    assert stats.batch == 2 and stats.prompt_len == 12 and stats.new_tokens == 6
+    assert stats.tokens_out == 6 and stats.outcome == "ok"
 
     out2, stats2 = fn(params, prompt)
     assert not stats2.compiled  # warm call: no recompile
     assert np.array_equal(np.asarray(out), np.asarray(out2))  # same rng default
+    # TPOT percentiles are histogram-derived and ordered
+    assert stats2.tpot_p50_s > 0
+    assert stats2.tpot_p50_s <= stats2.tpot_p90_s <= stats2.tpot_p99_s
 
     evs = read_events(tmp_path)
-    gen_events = [e for e in evs if e["event"] == "generate"]
-    assert len(gen_events) == 2
-    assert gen_events[0]["per_token_s"] >= 0
-    # both compiled programs surfaced as compile events on the first call
-    compile_fns = {e["fn"] for e in evs if e["event"] == "compile"}
-    assert compile_fns == {"generate_prefill", "generate_full"}
+    reqs = [e for e in evs if e["event"] == "request"]
+    assert len(reqs) == 2  # one request event per request
+    for r in reqs:
+        assert r["ttft_s"] > 0
+        assert r["tpot_p50_s"] > 0 and r["tpot_p99_s"] >= r["tpot_p50_s"]
+        assert sum(r["tpot_hist"].values()) == 5  # 5 decode steps recorded
+        assert r["outcome"] == "ok" and r["tokens_out"] == 6
+        assert r["ca_capacity"] == 18 and r["sa_capacity"] == 10
+        assert r["schema_version"] == 1
+    # the cross-request registry records WARM samples only (a dashboard
+    # histogram never resets, so one compile sample would poison its tail
+    # forever): request 1's compiling prefill + first decode step are out
+    assert fn.registry.counter("generate_cold_requests_total").value == 1
+    assert fn.registry.histogram("generate_ttft_s").n == 1
+    assert fn.registry.histogram("generate_tpot_s").n == 9  # 4 warm + 5 warm
+    # both compiled programs surfaced as compile events on the first call,
+    # attributed to the request span that paid them
+    compiles = [e for e in evs if e["event"] == "compile"]
+    assert {e["fn"] for e in compiles} == {"generate_prefill", "generate_decode_step"}
+    span_ids = {e["span_id"] for e in evs if e["event"] == "span"}
+    assert reqs[0]["span_id"] in span_ids
+    assert all(c["span_id"] == reqs[0]["span_id"] for c in compiles)
+    # the stream validates (schema_version + required fields + span refs)
+    from perceiver_io_tpu.obs.events import validate_events
+
+    assert validate_events(str(tmp_path)) == []
+
+
+def test_streamed_decode_matches_compiled_scan():
+    """make_decode_fns' host-driven loop must be token-exact equal to
+    generate()'s compiled scan — same body, same rng chain — including
+    sampling and EOS freezing."""
+    from perceiver_io_tpu.generation import GenerationConfig, generate, make_decode_fns
+
+    model, config = tiny_clm()
+    rng = np.random.default_rng(1)
+    prompt = jnp.asarray(rng.integers(0, config.vocab_size, size=(2, 12)))
+    params = model.init(jax.random.PRNGKey(0), prompt, prefix_len=8)
+    for gc in (
+        GenerationConfig(max_new_tokens=6, do_sample=True, temperature=0.8, top_k=10),
+        GenerationConfig(max_new_tokens=5, eos_token_id=3),
+    ):
+        ref = generate(model, params, prompt, num_latents=4, config=gc, rng=jax.random.PRNGKey(7))
+        prefill_fn, step_fn = make_decode_fns(model, num_latents=4, config=gc)
+        token, state = prefill_fn(params, prompt, None, jax.random.PRNGKey(7))
+        toks = [token]
+        for _ in range(1, gc.max_new_tokens):
+            state, token = step_fn(state)
+            toks.append(token)
+        streamed = jnp.concatenate([prompt] + [t[:, None] for t in toks], axis=1)
+        assert np.array_equal(np.asarray(ref), np.asarray(streamed))
+
+
+def test_instrumented_generation_abort_emits_error_request(tmp_path):
+    """A request that dies mid-decode must still emit its `request` event
+    with outcome="error" and the partial TPOT data, then re-raise (the
+    fit_end except-and-reraise guarantee, request-level)."""
+    from perceiver_io_tpu.generation import GenerationConfig, make_instrumented_generate_fn
+
+    model, config = tiny_clm()
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(rng.integers(0, config.vocab_size, size=(2, 12)))
+    params = model.init(jax.random.PRNGKey(0), prompt, prefix_len=8)
+    events = EventLog(str(tmp_path), main_process=True)
+
+    def die_at_3(i, token):
+        if i == 3:
+            raise RuntimeError("consumer died mid-decode")
+
+    fn = make_instrumented_generate_fn(
+        model, num_latents=4, config=GenerationConfig(max_new_tokens=8),
+        events=events, on_token=die_at_3,
+    )
+    with pytest.raises(RuntimeError, match="consumer died mid-decode"):
+        fn(params, prompt)
+    reqs = [e for e in read_events(tmp_path) if e["event"] == "request"]
+    assert len(reqs) == 1
+    r = reqs[0]
+    assert r["outcome"] == "error"
+    assert "consumer died mid-decode" in r["error"]
+    assert r["tokens_out"] == 4  # tokens 0..3 were produced before the abort
+    assert sum(r["tpot_hist"].values()) == 3  # partial TPOT samples survive
+    assert r["ttft_s"] > 0
+    # the error outcome rides the span and the registry error counter
+    spans = [e for e in read_events(tmp_path) if e["event"] == "span"]
+    assert any(s["attrs"].get("outcome") == "error" for s in spans)
+    assert fn.registry.counter("generate_request_errors_total").value == 1
+
+
+# ------------------------------------------------------------------ spans
+
+
+def test_tracer_span_nesting_ids_and_ambient(tmp_path):
+    from perceiver_io_tpu.obs.trace import Tracer, current_span_id
+
+    events = EventLog(str(tmp_path), main_process=True)
+    tracer = Tracer(events)
+    assert current_span_id() is None
+    with tracer.span("outer", kind="test") as outer:
+        assert current_span_id() == outer.span_id
+        with tracer.span("inner") as inner:
+            assert inner.parent_id == outer.span_id
+            assert current_span_id() == inner.span_id
+            inner.set("k", 7)
+        assert current_span_id() == outer.span_id
+    assert current_span_id() is None
+    tracer.flush()
+    rows = [e for e in read_events(tmp_path) if e["event"] == "span"]
+    by_name = {r["name"]: r for r in rows}
+    assert by_name["inner"]["parent_id"] == by_name["outer"]["span_id"]
+    assert by_name["inner"]["attrs"] == {"k": 7}
+    assert by_name["outer"]["attrs"] == {"kind": "test"}
+    assert by_name["outer"]["parent_id"] is None
+    for r in rows:
+        assert r["dur_ms"] >= 0 and r["t_end"] >= r["t_start"]
+        assert r["process_index"] == 0
+
+    # ambient fallback: a FOREIGN thread's emit attaches to the ambient span
+    import threading
+
+    seen = {}
+    with tracer.span("fit", ambient=True) as fit:
+        t = threading.Thread(target=lambda: seen.update(sid=current_span_id()))
+        t.start()
+        t.join()
+    assert seen["sid"] == fit.span_id
+
+    # decorator form
+    @tracer.traced("worker")
+    def work():
+        return current_span_id()
+
+    sid = work()
+    tracer.flush()
+    names = [e["name"] for e in read_events(tmp_path) if e["event"] == "span"]
+    assert "worker" in names and sid is not None
+
+
+def test_event_rows_carry_schema_version_and_current_span(tmp_path):
+    from perceiver_io_tpu.obs.events import EVENT_SCHEMA_VERSION
+    from perceiver_io_tpu.obs.trace import Tracer
+
+    events = EventLog(str(tmp_path), main_process=True)
+    tracer = Tracer(events)
+    events.emit("custom", a=1)
+    with tracer.span("step") as sp:
+        events.emit("fault.skip", step=3, reason="nonfinite", skips=1)
+    tracer.flush()
+    rows = read_events(tmp_path)
+    assert all(r["schema_version"] == EVENT_SCHEMA_VERSION for r in rows)
+    assert "span_id" not in rows[0]  # no open span at emit time
+    fault = [r for r in rows if r["event"] == "fault.skip"][0]
+    assert fault["span_id"] == sp.span_id  # stamped by the open span
+
+
+def test_trainer_emits_step_spans_with_phases(tmp_path):
+    run_tiny_fit(tmp_path)
+    events = read_events(tmp_path)
+    spans = [e for e in events if e["event"] == "span"]
+    steps = [s for s in spans if s["name"] == "step"]
+    fits = [s for s in spans if s["name"] == "fit"]
+    assert len(fits) == 1 and len(steps) == 4  # one span per step
+    for s in steps:
+        assert s["parent_id"] == fits[0]["span_id"]
+        assert "input_wait_ms" in s["attrs"] and "dispatch_ms" in s["attrs"]
+        assert "step" in s["attrs"]
+    assert [s["attrs"]["step"] for s in steps] == [1, 2, 3, 4]
+    # fit_start and log rows are attributed (fit / step span respectively)
+    by_event = {e["event"]: e for e in events}
+    assert by_event["fit_start"]["span_id"] == fits[0]["span_id"]
+    assert by_event["log"]["span_id"] in {s["span_id"] for s in steps}
+    # the whole stream validates, span references included
+    from perceiver_io_tpu.obs.events import validate_events
+
+    assert validate_events(str(tmp_path)) == []
+
+
+def test_host_device_breakdown_joins_spans_and_rollups(tmp_path):
+    """The correlation hook: step spans (host) + golden-xplane named-scope
+    rollups (device) produce the per-step breakdown obs_report renders."""
+    from perceiver_io_tpu.obs import xplane as ox
+    from perceiver_io_tpu.obs.trace import host_device_breakdown
+
+    buf, _ops = golden_xplane()
+    path = os.path.join(str(tmp_path), "golden.xplane.pb")
+    with open(path, "wb") as f:
+        f.write(buf)
+    rollups = ox.rollup(path)
+    span_rows = [
+        {"event": "span", "name": "step", "dur_ms": float(d),
+         "attrs": {"input_wait_ms": 0.5, "dispatch_ms": 2.0}}
+        for d in (10.0, 12.0, 11.0, 50.0, 13.0)
+    ] + [{"event": "span", "name": "checkpoint", "dur_ms": 30.0, "attrs": {}}]
+    bd = host_device_breakdown(span_rows, rollups)
+    assert bd["steps"] == 5
+    assert bd["step_ms"]["p50"] == 12.0 and "low_n" not in bd["step_ms"]
+    assert bd["input_wait_ms"] == pytest.approx(0.5)
+    assert bd["dispatch_ms"] == pytest.approx(2.0)
+    assert bd["checkpoint"] == {"count": 1, "total_ms": 30.0}
+    # device totals: golden plane is 8250 ps, 5 steps
+    assert bd["device"]["total_ms"] == pytest.approx(8250 / 1e9, abs=1e-9)
+    assert bd["device"]["per_step_ms"] == pytest.approx(8250 / 5 / 1e9, abs=1e-9)
+    scopes = {s["scope"] for s in bd["device"]["top_scopes"]}
+    assert "perceiver_ar/cross_attend" in scopes
+
+
+def test_fault_and_resume_events_carry_resolvable_span_ids(tmp_path):
+    """Acceptance pin (chaos-scenario span attribution): every fault.* and
+    resume event of a preempt + sentinel-rollback + auto-resume run carries
+    a span_id whose span row is present in the same stream."""
+    from perceiver_io_tpu.training import (
+        MetricsLogger,
+        SentinelConfig,
+        TrainState,
+        Trainer,
+        TrainerConfig,
+        make_optimizer,
+    )
+
+    def loss_fn(params, batch, rng):
+        pred = batch["x"] @ params["w"]
+        loss = jnp.mean((pred - batch["y"]) ** 2)
+        return loss, {"loss": loss}
+
+    def fresh_state():
+        return TrainState.create(
+            None, {"w": jnp.zeros((3,))}, make_optimizer(1e-2), jax.random.PRNGKey(0)
+        )
+
+    def batches(poison_at=()):
+        rng = np.random.default_rng(0)
+        import itertools
+
+        for i in itertools.count(1):
+            x = rng.normal(size=(4, 3)).astype(np.float32)
+            y = (x @ np.ones(3)).astype(np.float32)
+            if i in poison_at:
+                x = x.copy()
+                x[0, 0] = np.nan
+            yield {"x": x, "y": y}
+
+    cfg = dict(
+        log_interval=1, checkpoint_dir=str(tmp_path / "ckpt"), prefetch_batches=0,
+        input_double_buffer=False, graphlint=False, graphcheck=False,
+    )
+    logger = MetricsLogger(str(tmp_path / "logs"), use_tensorboard=False)
+    # phase 1: checkpoint at step 3 (val), sentinel skips at the poison
+    # steps 5-6 then rolls back to it, programmatic preemption at step 7
+    tr = Trainer(
+        loss_fn,
+        config=TrainerConfig(
+            max_steps=9, val_interval=3,
+            sentinel=SentinelConfig(skip_limit=2, rollback_limit=2), **cfg
+        ),
+        logger=logger,
+    )
+    orig = tr._train_step
+
+    def tripping(state, batch, _orig=orig):
+        out = _orig(state, batch)
+        if int(out[0].step) == 7:
+            tr._preempt_guard.trip()
+        return out
+
+    tr._train_step = tripping
+    val_batch = next(batches())
+    tr.fit(
+        fresh_state(), batches(poison_at=(5, 6)), val_loader=[val_batch], model_config=None
+    )
+    tr.close()
+    # phase 2: auto-resume appends a resume event to the same stream
+    tr2 = Trainer(loss_fn, config=TrainerConfig(max_steps=8, **cfg), logger=logger)
+    tr2.fit(fresh_state(), batches(), resume="auto")
+    tr2.close()
+    logger.close()
+
+    events = []
+    with open(tmp_path / "logs" / "events.jsonl") as f:
+        events = [json.loads(line) for line in f if line.strip()]
+    span_ids = {e["span_id"] for e in events if e["event"] == "span"}
+    audited = [
+        e for e in events if e["event"].startswith("fault.") or e["event"] == "resume"
+    ]
+    kinds = {e["event"] for e in audited}
+    assert "fault.skip" in kinds and "fault.rollback" in kinds
+    assert "fault.preempt" in kinds and "resume" in kinds
+    for e in audited:
+        assert e.get("span_id") in span_ids, f"{e['event']} not span-attributed: {e}"
+    from perceiver_io_tpu.obs.events import validate_events
+
+    assert validate_events(str(tmp_path / "logs")) == []
+
+
+# --------------------------------------------------- events: shards, schema
+
+
+def test_eventlog_shards_per_process_and_merge(tmp_path):
+    from perceiver_io_tpu.obs.events import EventLog, merged_events
+
+    d = str(tmp_path)
+    # synthetic two-process program: each process writes its own shard
+    e0 = EventLog(d, process_index=0, process_count=2)
+    e1 = EventLog(d, process_index=1, process_count=2)
+    assert os.path.basename(e0.path) == "events-p0.jsonl"
+    assert os.path.basename(e1.path) == "events-p1.jsonl"
+    assert e1._active  # non-zero processes WRITE in sharded mode
+    e0.emit("a", seq=0)
+    e1.emit("b", seq=0)
+    e0.emit("c", seq=1)
+    merged = merged_events(d)
+    assert [e["event"] for e in merged] in (["a", "b", "c"], ["b", "a", "c"])
+
+    # clock-skew tolerance: a shard whose wall clock stepped BACKWARDS keeps
+    # its own file order (per-process history is authoritative)
+    import json as _json
+
+    with open(os.path.join(d, "events-p1.jsonl"), "a") as f:
+        f.write(_json.dumps({"ts": 1.0, "event": "late", "schema_version": 1}) + "\n")
+    merged = merged_events(d)
+    names = [e["event"] for e in merged]
+    assert names.index("late") > names.index("b")  # never reordered before b
+
+
+def test_validate_events_catches_drift(tmp_path):
+    from perceiver_io_tpu.obs.events import EventLog, validate_events
+
+    d = str(tmp_path)
+    events = EventLog(d, main_process=True)
+    events.emit("fit_start", start_step=0, max_steps=2)
+    events.emit("fit_end", step=2, aborted=False)
+    assert validate_events(d) == []
+
+    # a torn TAIL line is tolerated (killed runs are expected)...
+    with open(events.path) as f:
+        clean = f.read()
+    with open(events.path, "a") as f:
+        f.write('{"ts": 1, "event": "log", "step"')
+    assert validate_events(d) == []
+    # ...but planted drift is not: missing schema_version, missing required
+    # field, unresolvable span reference
+    with open(events.path, "w") as f:
+        f.write(clean)
+    with open(events.path, "a") as f:
+        f.write(json.dumps({"ts": 1.0, "event": "log", "step": 1}) + "\n")  # no version
+        f.write(json.dumps({"ts": 1.0, "event": "compile", "schema_version": 1}) + "\n")
+        f.write(
+            json.dumps(
+                {"ts": 1.0, "event": "fault.skip", "schema_version": 1, "span_id": "dead"}
+            )
+            + "\n"
+        )
+    problems = validate_events(d)
+    assert any("schema_version" in p for p in problems)
+    assert any("compile" in p and "fn" in p for p in problems)
+    assert any("dead" in p for p in problems)
+
+
+# ------------------------------------------------------------------ metrics
+
+
+def test_metrics_registry_counters_gauges_histograms():
+    from perceiver_io_tpu.obs.metrics import MetricsRegistry, bucket_index
+
+    reg = MetricsRegistry()
+    c = reg.counter("requests_total", help="total requests")
+    c.inc()
+    c.inc(2)
+    assert c.value == 3
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = reg.gauge("queue_depth")
+    g.set(5)
+    g.add(-2)
+    assert g.value == 3
+    h = reg.histogram("latency_s")
+    for v in (0.001, 0.002, 0.002, 0.004, 0.1, 0.1, 0.1, 0.1, 0.1, 0.1):
+        h.record(v)
+    assert h.n == 10 and h.min == 0.001 and h.max == 0.1
+    # bucket-derived percentiles: within one bucket width of the truth
+    assert h.percentile(50) == pytest.approx(0.1, rel=0.25)
+    assert h.percentile(99) == pytest.approx(0.1, rel=0.25)
+    assert h.percentile(10) == pytest.approx(0.001, rel=0.25)  # nearest rank: 1st of 10
+    # same name returns the same metric; wrong type raises
+    assert reg.counter("requests_total") is c
+    with pytest.raises(TypeError):
+        reg.gauge("requests_total")
+    # snapshot carries everything, histogram percentiles included
+    snap = reg.snapshot()
+    assert snap["counters"]["requests_total"] == 3
+    assert snap["gauges"]["queue_depth"] == 3
+    assert snap["histograms"]["latency_s"]["n"] == 10
+    assert "p99" in snap["histograms"]["latency_s"]
+    assert "low_n" not in snap["histograms"]["latency_s"]
+    # low-sample histograms say so
+    h2 = reg.histogram("rare_s")
+    h2.record(1.0)
+    assert reg.snapshot()["histograms"]["rare_s"]["low_n"] is True
+    # one-sample percentile clamps to the observation, not the bucket mid
+    assert h2.percentile(99) == 1.0
+    assert bucket_index(0.0) == bucket_index(-1.0)  # clamped, no crash
+
+
+def test_metrics_prometheus_and_event_snapshot(tmp_path):
+    from perceiver_io_tpu.obs.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    reg.counter("gen_requests", help="requests served").inc(4)
+    reg.gauge("inflight").set(2)
+    h = reg.histogram("ttft_seconds")
+    h.record(0.5)
+    h.record(1.5)
+    text = reg.to_prometheus()
+    assert "# TYPE gen_requests counter" in text
+    assert "gen_requests 4" in text
+    assert "# TYPE inflight gauge" in text
+    assert "# TYPE ttft_seconds histogram" in text
+    assert 'ttft_seconds_bucket{le="+Inf"} 2' in text
+    assert "ttft_seconds_count 2" in text
+    # cumulative bucket counts are monotone
+    import re
+
+    cums = [int(m) for m in re.findall(r'ttft_seconds_bucket\{le="[^+]*"\} (\d+)', text)]
+    assert cums == sorted(cums)
+
+    events = EventLog(str(tmp_path), main_process=True)
+    reg.emit_snapshot(events)
+    assert not reg.maybe_emit(events, min_interval_s=60)  # rate-limited
+    rows = [e for e in read_events(tmp_path) if e["event"] == "metrics"]
+    assert len(rows) == 1
+    assert rows[0]["counters"]["gen_requests"] == 4
+    assert rows[0]["histograms"]["ttft_seconds"]["n"] == 2
+
+
+def test_histogram_counts_merge_exactly():
+    """The property SLO aggregation rests on: merging two histograms' sparse
+    counts equals recording every sample into one histogram."""
+    from perceiver_io_tpu.obs.metrics import (
+        Histogram,
+        merge_counts,
+        percentile_from_counts,
+    )
+
+    a, b, both = Histogram("a"), Histogram("b"), Histogram("both")
+    rng = np.random.default_rng(3)
+    for _ in range(200):
+        v = float(rng.lognormal(-5, 1))
+        (a if rng.random() < 0.5 else b).record(v)
+        both.record(v)
+    merged = merge_counts(a.counts, {str(k): v for k, v in b.counts.items()})
+    assert merged == both.counts
+    for p in (50, 90, 99):
+        assert percentile_from_counts(merged, p) == pytest.approx(
+            percentile_from_counts(both.counts, p)
+        )

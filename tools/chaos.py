@@ -139,6 +139,25 @@ def _events(run_dir, kind):
         return [json.loads(l) for l in f if json.loads(l).get("event") == kind]
 
 
+def _assert_span_attributed(run_dir):
+    """Spanline contract (ISSUE 8): every fault.*/resume event in a chaos
+    run must carry a span_id whose span row is in the same stream — an
+    incident nobody can attribute to its step is an incident half-logged."""
+    path = os.path.join(run_dir, "logs", "events.jsonl")
+    with open(path) as f:
+        rows = [json.loads(l) for l in f if l.strip()]
+    span_ids = {r.get("span_id") for r in rows if r.get("event") == "span"}
+    audited = [
+        r for r in rows
+        if r.get("event", "").startswith("fault.") or r.get("event") == "resume"
+    ]
+    for r in audited:
+        assert r.get("span_id") in span_ids, (
+            f"{r['event']} event not attributable to a span in-stream: {r}"
+        )
+    return len(audited)
+
+
 # ---------------------------------------------------------------------------
 # scenarios
 # ---------------------------------------------------------------------------
@@ -181,8 +200,10 @@ def scenario_preempt(tmp, mesh=None, tag="preempt"):
     ckpt = os.path.join(run_dir, "ckpt")
     leftovers = [n for n in os.listdir(ckpt) if ".orbax-checkpoint-tmp" in n]
     assert not leftovers, f"tmp checkpoint leftovers: {leftovers}"
+    n_attr = _assert_span_attributed(run_dir)
     print(f"chaos: {tag} ok — killed at {kill_at}, resumed, "
-          f"{len(ref)} losses match <= {TOL:g} (worst {worst:.1e})")
+          f"{len(ref)} losses match <= {TOL:g} (worst {worst:.1e}), "
+          f"{n_attr} fault/resume events span-attributed")
 
 
 def scenario_preempt_mesh(tmp):
@@ -274,6 +295,7 @@ def scenario_nan_skip(tmp):
     w_at = snapshots[poison_fetch - 1][2]
     assert np.array_equal(w_before, w_at), "skip did not hold params"
     assert not np.isnan(losses[poison_fetch:]).any(), "NaN leaked past the skip"
+    _assert_span_attributed(run_dir)
     print(f"chaos: nan_skip ok — poison batch at step {poison_fetch} skipped in-graph, "
           f"params held, final loss {losses[-1]:.4f} finite")
 
@@ -303,6 +325,7 @@ def scenario_nan_rollback(tmp):
     assert rb[0]["from_step"] == 7 and rb[0]["to_step"] == 4, rb
     finite = [l for l in losses if np.isfinite(l)]
     assert np.isfinite(losses[-1]) and len(finite) >= n_steps, "run did not recover"
+    _assert_span_attributed(run_dir)
     print(f"chaos: nan_rollback ok — skip_limit tripped at step 7, rolled back to 4, "
           f"run completed with final loss {losses[-1]:.4f}")
 

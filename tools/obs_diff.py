@@ -1,0 +1,305 @@
+"""Runtime-regression differ: two runs' telemetry → regression / improvement
+/ neutral verdicts under declared tolerances.
+
+graphcheck (PRs 6-7) pins the *static* surface — a change can keep its
+compiled-graph contract byte-identical and still regress wall-clock, and
+nothing noticed. This tool is the runtime leg: it loads two run
+directories' (shard-merged) ``events.jsonl`` + ``run_manifest.json``,
+REFUSES non-comparable pairs (different mesh / device / model geometry /
+jax — the same stale-contract discipline as ``diff_fingerprints``: that is
+exit 2, *not* a regression), and classifies the delta in every shared
+runtime metric:
+
+- throughput/utilization from ``log`` rows: MFU, goodput, tokens/sec,
+  input_wait;
+- step-latency percentiles from the per-step ``span`` rows (p50/p99 of the
+  host step wall; ``low_n`` windows classify neutral — a 3-sample p99 is
+  not evidence);
+- serving SLO percentiles from ``request`` rows via ``obs.slo`` (TTFT and
+  histogram-derived TPOT p50/p99, error rate).
+
+    python tools/obs_diff.py BASELINE_RUN CANDIDATE_RUN [--json]
+        [--tolerance mfu=0.1 --tolerance step_ms_p99=0.3]
+
+Exit codes (mirrors tools/graphcheck.py): 0 clean (improvements included),
+1 regression, 2 not-comparable / missing telemetry, 3 internal error.
+Wired into ``tasks.py obs`` (run-vs-itself must be clean) and — behind the
+``OBS_BASELINE_RUN`` knob — ``tasks.py perf``, giving the perf ledger's
+floors a runtime counterpart. docs/observability.md#runtime-diffing has the
+comparability rules.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # direct `python tools/obs_diff.py` invocation
+    sys.path.insert(0, _REPO)
+
+
+# metric -> (better direction, tolerance kind, default tolerance). rel
+# tolerances are fractions of the baseline; abs tolerances are absolute
+# deltas (goodput/error_rate are already fractions). Tail percentiles get
+# looser defaults than medians — they are noisier on short runs.
+METRICS: Dict[str, tuple] = {
+    "mfu": ("higher", "rel", 0.05),
+    "goodput": ("higher", "abs", 0.03),
+    "tokens_per_sec": ("higher", "rel", 0.05),
+    "steps_per_sec": ("higher", "rel", 0.05),
+    "input_wait_ms": ("lower", "rel", 0.50),
+    "step_ms_p50": ("lower", "rel", 0.10),
+    "step_ms_p99": ("lower", "rel", 0.25),
+    "ttft_s_p50": ("lower", "rel", 0.10),
+    "ttft_s_p99": ("lower", "rel", 0.25),
+    "tpot_s_p50": ("lower", "rel", 0.10),
+    "tpot_s_p99": ("lower", "rel", 0.25),
+    "error_rate": ("lower", "abs", 0.0),
+}
+
+# manifest fields that must match for two runs' numbers to be comparable at
+# all (diff_fingerprints discipline: a mismatch is a STALE baseline, not a
+# regression) — mesh/devices/process topology, model geometry, jax version
+_COMPARABILITY_KEYS = (
+    "backend",
+    "device_kind",
+    "device_count",
+    "process_count",
+    "mesh",
+    "jax_version",
+    "model_config",
+)
+
+
+@dataclasses.dataclass
+class Delta:
+    metric: str
+    kind: str  # "regression" | "improvement" | "neutral"
+    old: Optional[float]
+    new: Optional[float]
+    detail: str = ""
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class RunDiff:
+    comparable: bool
+    reason: str  # why not comparable ("" when comparable)
+    deltas: List[Delta]
+
+    @property
+    def regressions(self) -> List[Delta]:
+        return [d for d in self.deltas if d.kind == "regression"]
+
+    @property
+    def improvements(self) -> List[Delta]:
+        return [d for d in self.deltas if d.kind == "improvement"]
+
+    def ok(self) -> bool:
+        return self.comparable and not self.regressions
+
+    def format(self) -> str:
+        if not self.comparable:
+            return f"obs_diff: NOT COMPARABLE — {self.reason}"
+        head = (
+            f"obs_diff: {len(self.regressions)} regression(s), "
+            f"{len(self.improvements)} improvement(s), "
+            f"{len(self.deltas) - len(self.regressions) - len(self.improvements)} neutral"
+        )
+        lines = [head]
+        order = {"regression": 0, "improvement": 1, "neutral": 2}
+        for d in sorted(self.deltas, key=lambda d: (order[d.kind], d.metric)):
+            old = "-" if d.old is None else f"{d.old:.6g}"
+            new = "-" if d.new is None else f"{d.new:.6g}"
+            note = f"  ({d.detail})" if d.detail else ""
+            lines.append(f"  [{d.kind:<11}] {d.metric}: {old} -> {new}{note}")
+        return "\n".join(lines)
+
+    def to_dict(self) -> dict:
+        return {
+            "comparable": self.comparable,
+            "reason": self.reason,
+            "ok": self.ok(),
+            "deltas": [d.to_dict() for d in self.deltas],
+        }
+
+
+def _median(vals: List[float]) -> Optional[float]:
+    if not vals:
+        return None
+    s = sorted(vals)
+    n = len(s)
+    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
+def summarize_run(run_dir: str) -> dict:
+    """``{manifest, metrics, low_n}`` — the comparable surface of one run
+    directory. Metrics are medians over ``log`` rows (robust to one cold
+    window), step percentiles over ``span`` rows, SLO percentiles from
+    ``request`` rows; ``low_n`` names the percentile families whose sample
+    count is below the exact-order-statistics threshold."""
+    from perceiver_io_tpu.obs.events import merged_events
+    from perceiver_io_tpu.obs.slo import build_slo_report
+    from perceiver_io_tpu.utils.profiling import summarize_latencies
+
+    manifest_path = os.path.join(run_dir, "run_manifest.json")
+    manifest = None
+    if os.path.exists(manifest_path):
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+    events = merged_events(run_dir)
+    metrics: Dict[str, float] = {}
+    low_n: List[str] = []
+
+    logs = [e for e in events if e.get("event") == "log"]
+    for key in ("mfu", "goodput", "tokens_per_sec", "steps_per_sec", "input_wait_ms"):
+        med = _median([float(e[key]) for e in logs if isinstance(e.get(key), (int, float))])
+        if med is not None:
+            metrics[key] = med
+
+    steps = [
+        e for e in events if e.get("event") == "span" and e.get("name") == "step"
+    ]
+    # warm steps only: a step span that absorbed a compile / graphlint /
+    # graphcheck pass is wall-clock-dominated by it (the first step's span
+    # is ~the XLA compile), and those events are stamped with their step
+    # span's id — so exclusion is exact, not positional. Diffing
+    # compile-inflated p99s would gate on compiler variance, the same
+    # reason obs_report/obs.slo are warm-only.
+    overhead_sids = {
+        e.get("span_id")
+        for e in events
+        if e.get("event") in ("compile", "graphlint", "graphcheck")
+    }
+    warm_steps = [e for e in steps if e.get("span_id") not in overhead_sids]
+    if warm_steps:
+        s = summarize_latencies([float(e["dur_ms"]) for e in warm_steps])
+        metrics["step_ms_p50"] = s["p50"]
+        metrics["step_ms_p99"] = s["p99"]
+        if s.get("low_n"):
+            low_n.append("step_ms")
+
+    slo = build_slo_report(events)
+    if slo is not None:
+        metrics["error_rate"] = float(slo.get("error_rate", 0.0))
+        ttft = slo.get("ttft_s")
+        if ttft:
+            metrics["ttft_s_p50"] = float(ttft["p50"])
+            metrics["ttft_s_p99"] = float(ttft["p99"])
+            if ttft.get("low_n"):
+                low_n.append("ttft_s")
+        tpot = slo.get("tpot_s")
+        if tpot:
+            metrics["tpot_s_p50"] = float(tpot["p50"])
+            metrics["tpot_s_p99"] = float(tpot["p99"])
+            if tpot.get("low_n"):
+                low_n.append("tpot_s")
+    return {"run_dir": os.path.abspath(run_dir), "manifest": manifest, "metrics": metrics,
+            "low_n": low_n, "n_events": len(events)}
+
+
+def comparability_problems(old: dict, new: dict) -> List[str]:
+    """Manifest mismatches that make a perf comparison meaningless."""
+    om, nm = old.get("manifest"), new.get("manifest")
+    if om is None or nm is None:
+        missing = [s["run_dir"] for s, m in ((old, om), (new, nm)) if m is None]
+        return [f"missing run_manifest.json in {d}" for d in missing]
+    out = []
+    for key in _COMPARABILITY_KEYS:
+        if om.get(key) != nm.get(key):
+            ov, nv = om.get(key), nm.get(key)
+            if key == "model_config":  # too big to print whole
+                ov, nv = "<baseline model_config>", "<differs>"
+            out.append(f"{key}: {ov!r} != {nv!r}")
+    return out
+
+
+def diff_runs(
+    old: dict, new: dict, tolerances: Optional[Dict[str, float]] = None
+) -> RunDiff:
+    """Classify every metric present in BOTH summaries. A metric whose
+    sample count was low_n on either side is neutral (annotated) — exact
+    order statistics over <5 samples are data, not tails."""
+    problems = comparability_problems(old, new)
+    if problems:
+        return RunDiff(comparable=False, reason="; ".join(problems), deltas=[])
+    if not old["metrics"] or not new["metrics"]:
+        empty = [s["run_dir"] for s in (old, new) if not s["metrics"]]
+        return RunDiff(
+            comparable=False,
+            reason="no runtime metrics in " + ", ".join(empty),
+            deltas=[],
+        )
+    tolerances = tolerances or {}
+    deltas: List[Delta] = []
+    for metric, (direction, tol_kind, tol_default) in METRICS.items():
+        o, n = old["metrics"].get(metric), new["metrics"].get(metric)
+        if o is None and n is None:
+            continue
+        if o is None or n is None:
+            deltas.append(
+                Delta(metric, "neutral", o, n, "present in only one run")
+            )
+            continue
+        family = metric.rsplit("_p", 1)[0]
+        if family in old["low_n"] or family in new["low_n"]:
+            deltas.append(Delta(metric, "neutral", o, n, "low_n sample"))
+            continue
+        tol = float(tolerances.get(metric, tol_default))
+        margin = tol * abs(o) if tol_kind == "rel" else tol
+        worse = (o - n) if direction == "higher" else (n - o)
+        kind = "regression" if worse > margin else (
+            "improvement" if -worse > margin else "neutral"
+        )
+        pct = f"{(n - o) / o * 100:+.1f}%" if o else f"{n - o:+.4g}"
+        deltas.append(Delta(metric, kind, o, n, pct))
+    return RunDiff(comparable=True, reason="", deltas=deltas)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("baseline", help="baseline run directory")
+    p.add_argument("candidate", help="candidate run directory")
+    p.add_argument("--json", action="store_true", help="machine-readable output")
+    p.add_argument(
+        "--tolerance",
+        action="append",
+        default=[],
+        metavar="METRIC=TOL",
+        help="override a tolerance (repeatable), e.g. --tolerance mfu=0.1",
+    )
+    args = p.parse_args(argv)
+    tolerances = {}
+    for spec in args.tolerance:
+        if "=" not in spec:
+            p.error(f"--tolerance wants METRIC=TOL, got {spec!r}")
+        k, v = spec.split("=", 1)
+        if k not in METRICS:
+            p.error(f"unknown metric {k!r} (known: {', '.join(sorted(METRICS))})")
+        tolerances[k] = float(v)
+    try:
+        old = summarize_run(args.baseline)
+        new = summarize_run(args.candidate)
+        diff = diff_runs(old, new, tolerances)
+    except Exception as e:  # noqa: BLE001 — CI must see crash != verdict
+        print(f"obs_diff: internal error: {e}", file=sys.stderr)
+        return 3
+    if args.json:
+        print(json.dumps({"baseline": old["run_dir"], "candidate": new["run_dir"],
+                          **diff.to_dict()}, indent=2))
+    else:
+        print(diff.format())
+    if not diff.comparable:
+        return 2
+    return 0 if diff.ok() else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

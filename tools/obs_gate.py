@@ -1,0 +1,217 @@
+"""The observability CI gate (``tasks.py obs``; wired into ``tasks.py perf``).
+
+End-to-end certification that the Spanline surface holds together: run a
+10-step synthetic CLM fit with full telemetry plus a few instrumented
+generate requests into one run directory, then
+
+1. ``obs.events.validate_events`` — every row parses, carries
+   ``schema_version`` and its kind's required fields, and every
+   ``span_id``/``parent_id`` reference resolves (schema drift or a span
+   leak fails the gate, not the next consumer);
+2. assert the stream's shape: step spans for every step, one ``request``
+   row per generate call with histogram-derived TPOT percentiles, a
+   ``metrics`` registry snapshot, an SLO report that aggregates them;
+3. ``tools/obs_report.py`` renders the directory (a renderer crash is a
+   gate failure);
+4. ``tools/obs_diff.py`` run-vs-itself must be CLEAN (a self-diff that
+   regresses means the differ, not the run, is broken);
+5. with ``--baseline RUN_DIR`` (``tasks.py perf`` passes the committed
+   baseline from ``$OBS_BASELINE_RUN``), diff baseline → this run and fail
+   on regression; a non-comparable baseline exits 2 (stale, not red).
+
+    python tools/obs_gate.py [--out DIR] [--steps N] [--requests N]
+        [--baseline RUN_DIR] [--keep]
+
+Exit codes: 0 clean, 1 gate failure (validation/shape/self-diff/baseline
+regression), 2 stale baseline (not comparable), 3 internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _load_tool(name: str):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_REPO, "tools", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod  # dataclasses resolve cls.__module__ through here
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def run_workload(out_dir: str, steps: int, requests: int) -> None:
+    """The synthetic workload: a tiny CLM fit + instrumented generates, all
+    logging into ``out_dir`` (the same model family the flagship uses, CPU
+    geometry — the gate certifies the telemetry plumbing, not perf)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from perceiver_io_tpu.generation import GenerationConfig, make_instrumented_generate_fn
+    from perceiver_io_tpu.models.text import CausalLanguageModel, CausalLanguageModelConfig
+    from perceiver_io_tpu.obs import clm_train_telemetry
+    from perceiver_io_tpu.training import (
+        MetricsLogger,
+        TrainState,
+        Trainer,
+        TrainerConfig,
+        clm_loss_fn,
+        make_optimizer,
+    )
+
+    config = CausalLanguageModelConfig(
+        vocab_size=64, max_seq_len=24, max_latents=8, num_channels=32,
+        num_heads=4, num_self_attention_layers=2, cross_attention_dropout=0.5,
+    )
+    model = CausalLanguageModel(config)
+    rng = np.random.default_rng(0)
+    t = rng.integers(0, config.vocab_size, size=(4, config.max_seq_len + 1))
+    batch = {
+        "labels": jnp.asarray(t[:, 1:]),
+        "input_ids": jnp.asarray(t[:, :-1]),
+        "pad_mask": None,
+    }
+    params = model.init(jax.random.PRNGKey(0), batch["input_ids"], prefix_len=16)
+    state = TrainState.create(
+        model.apply, params, make_optimizer(1e-3), jax.random.PRNGKey(1)
+    )
+    tokens_per_sample, flops_per_sample = clm_train_telemetry(config)
+    logger = MetricsLogger(out_dir, use_tensorboard=False)
+    trainer = Trainer(
+        clm_loss_fn(model.apply, max_latents=config.max_latents),
+        logger=logger,
+        config=TrainerConfig(
+            max_steps=steps,
+            log_interval=max(steps // 2, 1),
+            prefetch_batches=0,
+            tokens_per_sample=tokens_per_sample,
+            flops_per_sample=flops_per_sample,
+        ),
+    )
+    state = trainer.fit(state, iter([batch] * steps), model_config=config)
+    prompt = jnp.asarray(rng.integers(0, config.vocab_size, size=(2, 12)))
+    fn = make_instrumented_generate_fn(
+        model,
+        num_latents=4,
+        config=GenerationConfig(max_new_tokens=8),
+        events=trainer._ensure_events(),
+        snapshot_interval_s=0.0,  # a metrics snapshot per request: gate-visible
+    )
+    for _ in range(requests):
+        fn(state.params, prompt)
+    trainer.close()
+    logger.close()
+
+
+def check_stream(out_dir: str, steps: int, requests: int) -> list:
+    """Validation + shape assertions; returns a list of problems."""
+    from perceiver_io_tpu.obs.events import merged_events, validate_events
+    from perceiver_io_tpu.obs.slo import write_slo_report
+
+    problems = list(validate_events(out_dir))
+    events = merged_events(out_dir)
+    kinds = [e.get("event") for e in events]
+    step_spans = [
+        e for e in events if e.get("event") == "span" and e.get("name") == "step"
+    ]
+    if len(step_spans) != steps:
+        problems.append(f"expected {steps} step spans, found {len(step_spans)}")
+    reqs = [e for e in events if e.get("event") == "request"]
+    if len(reqs) != requests:
+        problems.append(f"expected {requests} request events, found {len(reqs)}")
+    for r in reqs:
+        if r.get("tpot_p50_s") is None or r.get("tpot_p99_s") is None:
+            problems.append("request event missing histogram-derived TPOT percentiles")
+        if not r.get("tpot_hist"):
+            problems.append("request event missing its tpot_hist bucket counts")
+    if "metrics" not in kinds:
+        problems.append("no metrics registry snapshot row in the stream")
+    if "fit_end" not in kinds:
+        problems.append("no fit_end row in the stream")
+    slo = write_slo_report(out_dir)
+    if slo is None:
+        problems.append("SLO report empty despite request events")
+    elif "tpot_s" not in slo:
+        problems.append("SLO report lacks merged TPOT percentiles")
+    return problems
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--out", default=None, help="run dir (default: a temp dir)")
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--requests", type=int, default=3)
+    p.add_argument("--baseline", default=None, help="committed baseline run dir to diff against")
+    p.add_argument("--keep", action="store_true", help="keep the run dir (implied by --out)")
+    args = p.parse_args(argv)
+
+    out_dir = args.out or tempfile.mkdtemp(prefix="obs_gate_")
+    keep = args.keep or args.out is not None
+    try:
+        print(f"obs_gate: running {args.steps}-step fit + {args.requests} requests -> {out_dir}")
+        run_workload(out_dir, args.steps, args.requests)
+
+        problems = check_stream(out_dir, args.steps, args.requests)
+        if problems:
+            print("obs_gate: event-stream validation FAILED:")
+            for pr in problems:
+                print(f"  - {pr}")
+            return 1
+        print("obs_gate: event stream valid (schema, spans, requests, SLO report)")
+
+        obs_report = _load_tool("obs_report")
+        text = obs_report.render(out_dir)
+        for line in text.splitlines():
+            print(f"  | {line}")
+
+        obs_diff = _load_tool("obs_diff")
+        self_summary = obs_diff.summarize_run(out_dir)
+        self_diff = obs_diff.diff_runs(self_summary, self_summary)
+        if not self_diff.ok():
+            print("obs_gate: run-vs-itself diff NOT clean (differ broken):")
+            print(self_diff.format())
+            return 1
+        print("obs_gate: obs_diff run-vs-itself clean")
+
+        if args.baseline:
+            base = obs_diff.summarize_run(args.baseline)
+            diff = obs_diff.diff_runs(base, self_summary)
+            print(diff.format())
+            if not diff.comparable:
+                print("obs_gate: baseline STALE (not comparable) — re-record it")
+                return 2
+            if not diff.ok():
+                print("obs_gate: runtime REGRESSION vs committed baseline")
+                return 1
+        with open(os.path.join(out_dir, "slo_report.json")) as f:
+            slo = json.load(f)
+        print(
+            "obs_gate: OK — "
+            f"{slo['n_requests']} requests, tpot_p99={slo['tpot_s']['p99']}s"
+        )
+        return 0
+    except Exception as e:  # noqa: BLE001 — CI must see crash != verdict
+        print(f"obs_gate: internal error: {e}", file=sys.stderr)
+        import traceback
+
+        traceback.print_exc()
+        return 3
+    finally:
+        if not keep:
+            shutil.rmtree(out_dir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

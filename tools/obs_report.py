@@ -1,29 +1,33 @@
-"""Render a run directory's telemetry (``events.jsonl`` +
-``run_manifest.json``) into a plain-text run summary.
+"""Render a run directory's telemetry (``events.jsonl`` /
+``events-p*.jsonl`` shards + ``run_manifest.json``) into a plain-text run
+summary.
 
     python tools/obs_report.py <run_dir> [--max-compile-rows N]
 
 Sections: the manifest (what the run ran on), event counts, compile events
 (the recompile audit — a second compile of the same function within one
 process is a shape leak; resumed runs legitimately append another first
-compile), the
-latest throughput/MFU/goodput log row, the goodput breakdown from
-``fit_end``, and generation latency stats. Stdlib-only: runs anywhere the
-run directory can be copied to.
+compile), the latest throughput/MFU/goodput log row, the per-step
+host/device breakdown from ``span`` rows (input_wait → dispatch → compute,
+the device side joined from an xplane capture when one sits in the run
+dir), the goodput breakdown from ``fit_end``, and per-request SLO stats
+(TTFT + histogram-derived TPOT percentiles from ``request`` rows).
+Stdlib-only: runs anywhere the run directory can be copied to (the shard
+merge and percentile math are inlined; the optional device join upgrades
+itself through ``perceiver_io_tpu.obs`` when the package is importable).
 """
 
 from __future__ import annotations
 
 import argparse
+import glob
 import json
+import math
 import os
 from typing import Dict, List, Optional
 
 
-def load_events(run_dir: str) -> List[Dict]:
-    path = os.path.join(run_dir, "events.jsonl")
-    if not os.path.exists(path):
-        return []
+def _read_shard(path: str) -> List[Dict]:
     events = []
     with open(path) as f:
         for line in f:
@@ -35,6 +39,35 @@ def load_events(run_dir: str) -> List[Dict]:
             except json.JSONDecodeError:
                 continue  # a torn tail line from a killed run is expected
     return events
+
+
+def load_events(run_dir: str) -> List[Dict]:
+    """All shards of the run, merged into one stream. Uses the canonical
+    skew-tolerant merge (``obs.events.merged_events``) when the package is
+    importable; the stdlib fallback concatenates shards sorted by ``ts``."""
+    try:
+        from perceiver_io_tpu.obs.events import merged_events
+
+        return merged_events(run_dir)
+    except ImportError:
+        pass
+    paths = []
+    single = os.path.join(run_dir, "events.jsonl")
+    if os.path.exists(single):
+        paths.append(single)
+    paths.extend(sorted(glob.glob(os.path.join(run_dir, "events-p*.jsonl"))))
+    events = []
+    for p in paths:
+        events.extend(_read_shard(p))
+    if len(paths) > 1:
+        events.sort(key=lambda e: float(e.get("ts", 0.0)))
+    return events
+
+
+def _pct(values: List[float], p: float) -> float:
+    """Nearest-rank percentile (stdlib; exact order statistic)."""
+    s = sorted(values)
+    return s[max(int(math.ceil(p / 100.0 * len(s))) - 1, 0)]
 
 
 def load_manifest(run_dir: str) -> Optional[Dict]:
@@ -122,6 +155,50 @@ def render(run_dir: str, max_compile_rows: int = 20) -> str:
                 continue
             lines.append(f"  {key}: {_fmt(last[key])}")
 
+    spans = [e for e in events if e.get("event") == "span"]
+    steps = [s for s in spans if s.get("name") == "step"]
+    if steps:
+        lines.append("")
+        lines.append(f"== step breakdown ({len(steps)} step spans) ==")
+        durs = [float(s["dur_ms"]) for s in steps]
+        low = "  (low_n: exact order statistics)" if len(durs) < 5 else ""
+        lines.append(
+            f"  step_ms: p50 {_pct(durs, 50):.4g}  p99 {_pct(durs, 99):.4g}  "
+            f"mean {sum(durs)/len(durs):.4g}{low}"
+        )
+        for attr in ("input_wait_ms", "dispatch_ms"):
+            vals = [
+                float(s["attrs"][attr])
+                for s in steps
+                if isinstance(s.get("attrs"), dict) and attr in s["attrs"]
+            ]
+            if vals:
+                lines.append(f"  {attr}: mean {sum(vals)/len(vals):.4g}")
+        for phase in ("checkpoint", "eval"):
+            rows = [s for s in spans if s.get("name") == phase]
+            if rows:
+                total = sum(float(s["dur_ms"]) for s in rows)
+                lines.append(f"  {phase}: {len(rows)}x, total {total:.4g} ms")
+        # device side of the join: an xplane capture in the run dir rolls up
+        # by named scope (needs the package; silently host-only without it)
+        pbs = glob.glob(os.path.join(run_dir, "**", "*.xplane.pb"), recursive=True)
+        if pbs:
+            try:
+                from perceiver_io_tpu.obs.trace import host_device_breakdown
+                from perceiver_io_tpu.obs.xplane import rollup
+
+                bd = host_device_breakdown(spans, rollup(sorted(pbs)[-1]))
+                dev = bd.get("device")
+                if dev:
+                    lines.append(
+                        f"  device: {dev['total_ms']:.4g} ms total, "
+                        f"{dev['per_step_ms']:.4g} ms/step"
+                    )
+                    for sc in dev["top_scopes"][:5]:
+                        lines.append(f"    {sc['ms']:9.3f} ms  {sc['scope'][:80]}")
+            except ImportError:
+                lines.append("  (xplane capture present; install the package for the device join)")
+
     ends = [e for e in events if e.get("event") == "fit_end"]
     if ends:
         end = ends[-1]
@@ -132,26 +209,67 @@ def render(run_dir: str, max_compile_rows: int = 20) -> str:
                 continue
             lines.append(f"  {key}: {_fmt(end[key])}")
 
-    gens = [e for e in events if e.get("event") == "generate"]
-    if gens:
+    # per-request SLO stats; "generate" is the pre-request-event legacy kind
+    reqs = [e for e in events if e.get("event") in ("request", "generate")]
+    if reqs:
         lines.append("")
-        lines.append(f"== generation ({len(gens)} calls) ==")
+        outcomes: Dict[str, int] = {}
+        for r in reqs:
+            o = str(r.get("outcome", "ok"))
+            outcomes[o] = outcomes.get(o, 0) + 1
+        lines.append(
+            f"== requests ({len(reqs)}: "
+            + ", ".join(f"{k} {v}" for k, v in sorted(outcomes.items()))
+            + ") =="
+        )
+        ok = [r for r in reqs if r.get("outcome", "ok") == "ok"]
         # steady-state stats exclude calls that paid a compile; when EVERY
         # call compiled there is no steady state — say so instead of
         # presenting compile-inflated latencies as clean numbers
-        warm = [g for g in gens if not g.get("compiled")]
+        warm = [g for g in ok if not g.get("compiled")]
         if warm:
-            note = "  (warm calls only)" if len(warm) < len(gens) else ""
+            note = "  (warm requests only)" if len(warm) < len(ok) else ""
         else:
-            warm = gens
-            note = "  (ALL calls paid a compile — latencies include it)"
-        for key in ("prefill_s", "per_token_s", "tokens_per_sec"):
-            vals = [float(g[key]) for g in warm if key in g]
-            if vals:
+            warm = ok
+            note = "  (ALL requests paid a compile — latencies include it)"
+        for key in ("ttft_s", "prefill_s", "per_token_s", "tokens_per_sec"):
+            vals = [float(g[key]) for g in warm if g.get(key) is not None]
+            if vals and not (key == "prefill_s" and any("ttft_s" in g for g in warm)):
                 lines.append(
                     f"  {key}: mean {sum(vals)/len(vals):.4g}  "
                     f"min {min(vals):.4g}  max {max(vals):.4g}" + note
                 )
+        # TPOT percentiles over every decoded token: merged per-request
+        # log-bucket histograms (exact addition — global bucket bounds).
+        # Canonical math lives in obs.metrics (the bucket base is
+        # load-bearing for every committed tpot_hist); the inline copy is
+        # only the no-package fallback, same pattern as load_events.
+        try:
+            from perceiver_io_tpu.obs.metrics import merge_counts, percentile_from_counts
+
+            merged = merge_counts(*((g.get("tpot_hist") or {}) for g in warm))
+            hist_pct = lambda p: percentile_from_counts(merged, p)  # noqa: E731
+        except ImportError:
+            merged = {}
+            for g in warm:
+                for k, v in (g.get("tpot_hist") or {}).items():
+                    merged[int(k)] = merged.get(int(k), 0) + int(v)
+            growth = 2.0 ** 0.25  # must track obs.metrics.GROWTH
+
+            def hist_pct(p, _n=None):
+                n = sum(merged.values())
+                target, seen = max(int(math.ceil(p / 100.0 * n)), 1), 0
+                for idx in sorted(merged):
+                    seen += merged[idx]
+                    if seen >= target:
+                        return growth ** (idx + 0.5)
+        n_tok = sum(merged.values())
+        if n_tok:
+            low = "  (low_n)" if n_tok < 5 else ""
+            lines.append(
+                f"  tpot_s ({n_tok} tokens): p50 {hist_pct(50):.4g}  "
+                f"p90 {hist_pct(90):.4g}  p99 {hist_pct(99):.4g}{low}" + note
+            )
     return "\n".join(lines)
 
 
