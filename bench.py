@@ -123,6 +123,11 @@ _GRAPHLINT_STATUS = None
 # record-in-every-artifact contract; the hard gate is `tasks.py perf`
 _GRAPHCHECK_STATUS = None
 
+# the measured cost of always-on training probes (obs/probes.py): probed vs
+# unprobed step wall time on THIS invocation's geometry, resolved by train
+# mode (a recorded number, not a vibe — docs/observability.md#probes)
+_PROBE_OVERHEAD = None
+
 
 def telemetry_fields(flops, step_time, step_times_s=None, times_key: str = "step_ms") -> dict:
     """The ``telemetry`` block every bench result carries: device kind, the
@@ -144,6 +149,8 @@ def telemetry_fields(flops, step_time, step_times_s=None, times_key: str = "step
         t["graphlint"] = _GRAPHLINT_STATUS
     if _GRAPHCHECK_STATUS is not None:
         t["graphcheck"] = _GRAPHCHECK_STATUS
+    if _PROBE_OVERHEAD is not None:
+        t["probe_overhead"] = _PROBE_OVERHEAD
     if flops is not None:
         peak = device_peak_flops()
         rate = flops / step_time
@@ -731,6 +738,11 @@ def main():
                         "default off (GSPMD) until the TPU A/B lands "
                         "(docs/performance.md round 7; tools/overlap_ab.py)")
     p.add_argument("--out", default=None, help="extra mode: JSON artifact path (e.g. BENCH_extra_r3.json)")
+    p.add_argument("--skip-probe-overhead", action="store_true",
+                   help="train mode: skip the probed-vs-unprobed step A/B "
+                        "(obs/probes.py; telemetry.probe_overhead records the "
+                        "cost of always-on training probes — runs by default, "
+                        "one extra compile of the probed step variant)")
     args = p.parse_args()
 
     if args.kernel_features is not None:
@@ -884,6 +896,50 @@ def main():
     timer = StepTimer(warmup=1)
     step_time = scan_step_time(step, state, batch, args.steps, timer=timer)
     tokens_per_sec = b * n / step_time
+
+    global _PROBE_OVERHEAD
+    if not args.skip_probe_overhead and overlap_cfg is None:
+        # the cost of always-on training probes as a recorded number: the
+        # SAME step compiled with the Probeline stats (obs/probes.py) timed
+        # over a shorter chain, against the unprobed measurement above
+        from perceiver_io_tpu.obs.probes import ProbeConfig
+
+        probed_step = make_train_step(
+            clm_loss_fn(model.apply, max_latents=args.latents),
+            jit=False,
+            microbatch=microbatch,
+            probes=ProbeConfig(),
+        )
+
+        # scan_step_time's body keeps only metrics["loss"], which would let
+        # XLA dead-code-eliminate every probe reduction and time the
+        # unprobed graph; the probe outputs must stay live, as they are in
+        # the trainer (where they are returned to the host)
+        @functools.partial(jax.jit, static_argnums=2)
+        def run_probed(state, batch, k):
+            def body(s, _):
+                s, metrics = probed_step(s, batch)
+                return s, (metrics["loss"], metrics["probes"])
+
+            _, (losses, stats) = jax.lax.scan(body, state, None, length=k)
+            return losses[-1], jax.tree.map(lambda x: x[-1], stats)
+
+        def probed_call(k):
+            loss, stats = run_probed(state, batch, k)
+            jax.block_until_ready(stats)
+            return float(loss)
+
+        probed_time = robust_slope(
+            probed_call, TIMER_CHAIN, TIMER_CHAIN + max(args.steps // 5, 3)
+        )
+        _PROBE_OVERHEAD = {
+            "unprobed_step_ms": round(step_time * 1e3, 3),
+            "probed_step_ms": round(probed_time * 1e3, 3),
+            "overhead_frac": round(probed_time / step_time - 1.0, 4),
+        }
+        print(f"probe_overhead {_PROBE_OVERHEAD['overhead_frac']:+.2%} "
+              f"({_PROBE_OVERHEAD['unprobed_step_ms']} -> "
+              f"{_PROBE_OVERHEAD['probed_step_ms']} ms/step)", flush=True)
 
     # analytic A100 reference: same step FLOPs at MFU_BAR..MFU_LOW
     flops = train_step_flops(config, b, prefix_dropout_keep=0.5)

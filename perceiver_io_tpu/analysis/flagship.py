@@ -133,6 +133,7 @@ def build_targets(
     mesh=None,
     overlap: bool = True,
     microbatch: Optional[int] = None,
+    probes=None,
 ) -> Dict[str, LintTarget]:
     """Build the flagship functions and their lint policies.
 
@@ -144,6 +145,11 @@ def build_targets(
     expected_collectives`; ``overlap=False`` lints the GSPMD step instead
     (no overlap claim — XLA owns the schedule). ``microbatch`` defaults to
     2 on the sharded step (the chunk-interleaving claim needs >= 2 chunks).
+
+    ``probes``: an ``obs.probes.ProbeConfig`` compiles the Probeline
+    numerics telemetry into the (unsharded) TRAIN target — the
+    ``train_probed`` contract program; its committed fingerprint proves
+    probes add zero collectives, no callbacks and bounded const/temp bytes.
 
     Trace-time kernel features (``fast_kernels``) must be active around BOTH
     this call and the subsequent ``check`` — callers own the feature
@@ -198,8 +204,17 @@ def build_targets(
         tx = make_optimizer(1e-3, gradient_clip=1.0, moment_dtype="bfloat16")
         state = TrainState.create(model.apply, params, tx, jax.random.PRNGKey(1))
         loss_fn = clm_loss_fn(model.apply, max_latents=g["latents"])
+        if probes is not None and mesh is not None:
+            # loud, not dropped: a caller asking to fingerprint/lint a probed
+            # SHARDED step would otherwise get a verdict about the unprobed
+            # graph (the overlap step rejects probes in make_train_step; the
+            # GSPMD sharded contract program simply isn't built probed yet)
+            raise ValueError(
+                "probes= is only supported for the unsharded train target "
+                "(the train_probed contract program); drop mesh= or probes="
+            )
         if mesh is None:
-            step = make_train_step(loss_fn)
+            step = make_train_step(loss_fn, probes=probes)
             policy = LintPolicy(
                 bf16_scopes=bf16_scopes,
                 # the train step donates its state; XLA:CPU does not commit
@@ -347,10 +362,14 @@ def lint_flagship(
         }
 
 
-# the five flagship programs graphcheck snapshots and the dataflow rules
-# gate (tasks.py perf): flat train, the GSPMD and overlap-scheduled sharded
-# train steps on the DEFAULT_MESH_SPEC submesh, prefill, decode
-PROGRAMS = ("train_flat", "train_sharded", "train_overlap", "prefill", "decode")
+# the flagship programs graphcheck snapshots and the dataflow rules gate
+# (tasks.py perf): flat train, the Probeline-instrumented flat train (the
+# contract that probes add zero collectives/callbacks and bounded bytes),
+# the GSPMD and overlap-scheduled sharded train steps on the
+# DEFAULT_MESH_SPEC submesh, prefill, decode
+PROGRAMS = (
+    "train_flat", "train_probed", "train_sharded", "train_overlap", "prefill", "decode"
+)
 DEFAULT_MESH_SPEC = "data=2,fsdp=2"
 
 
@@ -359,7 +378,7 @@ def build_programs(
     geometry: str = "micro",
     mesh_spec: str = DEFAULT_MESH_SPEC,
 ) -> Dict[str, LintTarget]:
-    """The five flagship programs as lint targets — the SAME builds
+    """The flagship programs as lint targets — the SAME builds
     :func:`~perceiver_io_tpu.analysis.fingerprint.flagship_fingerprints`
     snapshots, so the lint gate and the contract gate cannot drift apart.
     The sharded pair needs the ``mesh_spec`` submesh worth of devices
@@ -376,6 +395,11 @@ def build_programs(
         for p in flat:
             t = built[{"train_flat": "train"}.get(p, p)]
             out[p] = dataclasses.replace(t, name=p)
+    if "train_probed" in programs:
+        from perceiver_io_tpu.obs.probes import ProbeConfig
+
+        t = build_targets(geometry, targets=("train",), probes=ProbeConfig())["train"]
+        out["train_probed"] = dataclasses.replace(t, name="train_probed")
     sharded = [p for p in ("train_sharded", "train_overlap") if p in programs]
     if sharded:
         from perceiver_io_tpu.parallel.overlap import mesh_from_spec
@@ -398,7 +422,7 @@ def lint_programs(
     compiled: Optional[bool] = None,
     features: Optional[Sequence[str]] = None,
 ) -> Dict[str, Report]:
-    """Lint the five flagship programs (``tools/graphlint.py --programs``,
+    """Lint the flagship programs (``tools/graphlint.py --programs``,
     the ``tasks.py perf`` dataflow gate). Same ``features`` semantics as
     :func:`lint_flagship`."""
     with features_context(features):

@@ -605,4 +605,14 @@ class MultiHeadAttention(nn.Module):
             ]
             o = jnp.concatenate(o_chunks, axis=1)
 
-        return AttentionOutput(last_hidden_state=self.merge_output(o), kv_cache=new_cache)
+        # Probeline tap (obs/probes.py): per-attention-output numerics stats
+        # when a probe collector is tracing — a pure no-op otherwise, so the
+        # unprobed graph stays bitwise identical. Repeated calls uniquify
+        # (attention.out, attention.out#1, ...) in forward order, giving
+        # per-layer resolution through the shared module.
+        from perceiver_io_tpu.obs.probes import probe
+
+        return AttentionOutput(
+            last_hidden_state=probe("attention.out", self.merge_output(o)),
+            kv_cache=new_cache,
+        )

@@ -33,6 +33,7 @@ from flax import struct
 from jax import lax
 
 from perceiver_io_tpu.core.attention import AttentionOutput, KVCache, MultiHeadAttention, init_kv_cache
+from perceiver_io_tpu.obs.probes import probe
 from perceiver_io_tpu.ops.layernorm import FusedLayerNorm
 from perceiver_io_tpu.core.config import CausalSequenceModelConfig
 from perceiver_io_tpu.core.position import positions
@@ -590,7 +591,10 @@ class SelfAttentionBlock(nn.Module):
                 cache_i,
                 deterministic,
             )
-            x = out.last_hidden_state
+            # Probeline tap (obs/probes.py): traces zero ops unless a probe
+            # collector is open — per-layer activation stats ride out as aux
+            # outputs of the same compiled program
+            x = probe(f"{self.name or 'self_attn'}.layer_{i}", out.last_hidden_state)
             if kv_cache_updated is not None:
                 kv_cache_updated.append(out.kv_cache)
         return BlockOutput(
@@ -1025,6 +1029,7 @@ class PerceiverAR(nn.Module):
                     keep_idx = jnp.sort(keep_idx, axis=-1)
             with jax.named_scope("embed"):
                 x_emb, frq = self.input_adapter.embed_compact(x, keep_idx, prefix_len)
+            x_emb = probe("perceiver_ar.embed", x_emb)
             x_prefix, x_latent = x_emb[:, :keep], x_emb[:, keep:]
             frq_prefix, frq_latent = frq[:, :keep], frq[:, keep:]
             return self._attend(
@@ -1044,6 +1049,7 @@ class PerceiverAR(nn.Module):
                 x_emb, frq = self.input_adapter(x, positions(b, n, shift=shift))
                 pad_latent, pad_prefix = pad_mask[:, prefix_len:], pad_mask[:, :prefix_len]
 
+        x_emb = probe("perceiver_ar.embed", x_emb)
         x_latent, x_prefix = x_emb[:, prefix_len:], x_emb[:, :prefix_len]
         frq_latent, frq_prefix = frq[:, prefix_len:], frq[:, :prefix_len]
 
@@ -1131,7 +1137,7 @@ class PerceiverAR(nn.Module):
             )
         with jax.named_scope("self_attend"):
             sa_out = self.self_attention(
-                ca_out.last_hidden_state,
+                probe("perceiver_ar.cross_attend", ca_out.last_hidden_state),
                 None,
                 frq_latent,
                 frq_latent,
@@ -1297,7 +1303,8 @@ class PerceiverAR(nn.Module):
             )
         with jax.named_scope("self_attend"):
             sa_out = self.self_attention(
-                ca_out.last_hidden_state, sa_pad_mask, frq_q, frq_q, sa_cache, deterministic
+                probe("perceiver_ar.cross_attend", ca_out.last_hidden_state),
+                sa_pad_mask, frq_q, frq_q, sa_cache, deterministic,
             )
         new_cache = (ca_out.kv_cache,) + tuple(sa_out.kv_cache)
         return BlockOutput(last_hidden_state=sa_out.last_hidden_state, kv_cache=new_cache)
@@ -1467,5 +1474,5 @@ class CausalSequenceModel(nn.Module):
         with jax.named_scope("logits"):
             if self.config.output_norm:
                 h = self.out_norm(h)
-            logits = self.output_adapter(h, attend=self.input_adapter.attend)
+            logits = probe("logits", self.output_adapter(h, attend=self.input_adapter.attend))
         return CausalModelOutput(last_hidden_state=h, logits=logits, kv_cache=out.kv_cache)

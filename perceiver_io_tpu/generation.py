@@ -392,14 +392,22 @@ def beam_search(
     return jnp.concatenate([prompt_tiled, best_seqs], axis=1), best_scores
 
 
-def _decode_step_body(model, mcfg, config, step_params, carry, pad_slots, pos_shift):
+def _decode_step_body(model, mcfg, config, step_params, carry, pad_slots, pos_shift, health=False):
     """One decode step over the fixed-capacity caches — the SHARED body of
     :func:`generate`'s compiled scan and the host-driven step fn
     (:func:`make_decode_fns`), so the two paths cannot drift: slide the
     windows when full (expired slots derived from the start counters, the
     roll-free analog of the reference's truncation), apply the model on the
     last token, sample, handle EOS freezing. Callers own parameter
-    unpacking/dequantization and the ``decode`` named scope."""
+    unpacking/dequantization and the ``decode`` named scope.
+
+    ``health=True`` (trace-time static — the Probeline decode gauges,
+    obs/probes.py) additionally returns a third element: the in-graph
+    decode-health dict (KV-cache occupancy fraction, mean logit entropy,
+    non-finite logit fraction) computed from this step's logits and the
+    post-append cache. The default ``False`` returns the historical
+    2-tuple and traces zero extra ops, keeping :func:`generate`'s fused
+    scan bitwise identical."""
     cache, ca_start, sa_start, token, rng, done = carry
     ca_cache, sa_caches = cache[0], cache[1:]
     ca_idx = jnp.arange(ca_cache.capacity, dtype=jnp.int32)[None, :]
@@ -425,7 +433,12 @@ def _decode_step_body(model, mcfg, config, step_params, carry, pad_slots, pos_sh
     if config.eos_token_id is not None:
         sampled = jnp.where(done, config.pad_token_id, sampled)
         done = done | (sampled == config.eos_token_id)
-    return (out.kv_cache, ca_start, sa_start, sampled, rng, done), sampled
+    carry_out = (out.kv_cache, ca_start, sa_start, sampled, rng, done)
+    if not health:
+        return carry_out, sampled
+    from perceiver_io_tpu.obs.probes import decode_health
+
+    return carry_out, sampled, decode_health(out.logits[:, -1], out.kv_cache[0], ca_start)
 
 
 def make_generate_fn(
@@ -564,6 +577,7 @@ def make_decode_fns(
     config: Optional[GenerationConfig] = None,
     cache_dtype=jnp.float32,
     weight_dtype=None,
+    probes: bool = False,
 ):
     """The host-driven decode pair: ``(prefill_fn, step_fn)``.
 
@@ -583,6 +597,14 @@ def make_decode_fns(
     wrapper times every token through it (TTFT + a real TPOT distribution,
     not a mean), and a continuous-batching scheduler steps requests through
     ``step_fn`` between admissions (ROADMAP item 1).
+
+    ``probes=True`` (trace-time static — the Probeline decode gauges,
+    obs/probes.py, docs/observability.md#probes) adds a ``"probe"`` entry to
+    the state dict: the in-graph decode-health stats (KV-cache occupancy
+    fraction, mean logit entropy, non-finite logit fraction) computed by the
+    SAME compiled step, read by the instrumented wrapper into the metrics
+    registry and the per-request ``request`` event. Off (default) the
+    compiled pair is bitwise today's.
     """
     config = config or GenerationConfig()
     if config.max_new_tokens < 1:
@@ -631,6 +653,12 @@ def make_decode_fns(
             "pad_slots": pad_slots,
             "pos_shift": pos_shift,
         }
+        if probes:
+            from perceiver_io_tpu.obs.probes import decode_health
+
+            # the prompt pass's health (token 0): same gauges, same scopes,
+            # so the state pytree is uniform across prefill and every step
+            state["probe"] = decode_health(out.logits[:, -1], out.kv_cache[0], zero)
         return next_token, state
 
     def step(state):
@@ -640,13 +668,17 @@ def make_decode_fns(
                 state["cache"], state["ca_start"], state["sa_start"],
                 state["token"], state["rng"], state["done"],
             )
-            carry, token = _decode_step_body(
-                model, mcfg, config, step_params, carry, state["pad_slots"], state["pos_shift"]
+            stepped = _decode_step_body(
+                model, mcfg, config, step_params, carry,
+                state["pad_slots"], state["pos_shift"], health=probes,
             )
+            carry, token = stepped[0], stepped[1]
             new_state = dict(
                 state, cache=carry[0], ca_start=carry[1], sa_start=carry[2],
                 token=carry[3], rng=carry[4], done=carry[5],
             )
+            if probes:
+                new_state["probe"] = stepped[2]
             return new_state, token
 
     return jax.jit(prefill), jax.jit(step)
@@ -684,6 +716,7 @@ def make_instrumented_generate_fn(
     registry=None,
     on_token=None,
     snapshot_interval_s: float = 30.0,
+    probes: bool = False,
 ):
     """``fn(params, input_ids, pad_mask, rng) -> (tokens, GenerationStats)``
     — the serving measurement wrapper: host-driven decode
@@ -711,6 +744,15 @@ def make_instrumented_generate_fn(
     ``metrics`` event rows at most every ``snapshot_interval_s``.
     ``on_token(i, token_array)`` observes each decoded token — the seam a
     streaming consumer (or an abort-injection test) hangs off.
+
+    ``probes=True`` compiles the Probeline decode-health gauges into the
+    step (``make_decode_fns(probes=True)``): KV-cache occupancy and logit
+    entropy are published into the registry (``generate_kv_cache_frac``
+    gauge, ``generate_logit_entropy`` histogram — the admission/SLO inputs
+    the ROADMAP-1 scheduler reads) and onto each ``request`` event
+    (``kv_cache_frac``, ``logit_entropy_mean``/``_last``,
+    ``nonfinite_logit_frac``). Health arrays are collected per token but
+    host-fetched ONCE per request, after the decode loop.
     """
     config = config or GenerationConfig()
     if config.max_new_tokens < 1:
@@ -721,7 +763,7 @@ def make_instrumented_generate_fn(
 
     tracker = RecompileTracker(events=events)
     prefill_raw, step_raw = make_decode_fns(
-        model, num_latents, config, cache_dtype, weight_dtype
+        model, num_latents, config, cache_dtype, weight_dtype, probes=probes
     )
     prefill_fn = tracker.wrap(prefill_raw, "generate_prefill")
     step_fn = tracker.wrap(step_raw, "generate_decode_step")
@@ -737,6 +779,8 @@ def make_instrumented_generate_fn(
     # compile included, flagged by `compiled` — consumers exclude it.
     m_ttft = registry.histogram("generate_ttft_s")
     m_tpot = registry.histogram("generate_tpot_s")
+    m_entropy = registry.histogram("generate_logit_entropy") if probes else None
+    m_kv_frac = registry.gauge("generate_kv_cache_frac") if probes else None
     tracer = obs_trace.Tracer(events, flush_every=64) if events is not None else None
 
     def fn(params, input_ids, pad_mask=None, rng=None):
@@ -745,6 +789,7 @@ def make_instrumented_generate_fn(
         request_id = obs_trace.new_span_id()
         hist = Histogram("tpot_s")  # THIS request's decode latencies
         toks = []
+        healths = []  # device-array health dicts; fetched once, after the loop
         outcome, err = "ok", None
         ttft = 0.0
         span_cm = (
@@ -766,6 +811,8 @@ def make_instrumented_generate_fn(
                 if tracker.total_compiles == c0:
                     m_ttft.record(ttft)
                 toks.append(token)
+                if probes:
+                    healths.append(state["probe"])
                 if on_token is not None:
                     on_token(0, token)
                 for i in range(1, config.max_new_tokens):
@@ -778,6 +825,8 @@ def make_instrumented_generate_fn(
                     if tracker.total_compiles == c0:
                         m_tpot.record(dt)
                     toks.append(token)
+                    if probes:
+                        healths.append(state["probe"])
                     if on_token is not None:
                         on_token(i, token)
             except BaseException as e:  # noqa: BLE001 — event out, then reraise
@@ -789,6 +838,32 @@ def make_instrumented_generate_fn(
         decode_s = max(elapsed - ttft, 0.0)
         tokens_out = len(toks)
         compiled = tracker.total_compiles > compiles_before
+        health_row = None
+        if probes and healths:
+            # one host fetch for the whole request's health arrays — the
+            # per-token loop never blocked on them. Guarded: on an aborted
+            # request these arrays came from the computation that FAILED and
+            # the fetch may re-raise — the outcome="error" request event must
+            # still go out (the same guarantee fit_end makes), with health
+            # merely missing, and the ORIGINAL exception must stay the one
+            # surfaced.
+            try:
+                hh = jax.device_get(healths)
+                ents = [float(h["logit_entropy"]) for h in hh]
+                kv_frac = float(hh[-1]["kv_cache_frac"])
+                for e in ents:
+                    m_entropy.record(e)
+                m_kv_frac.set(kv_frac)
+                health_row = {
+                    "kv_cache_frac": round(kv_frac, 6),
+                    "logit_entropy_mean": round(sum(ents) / len(ents), 6),
+                    "logit_entropy_last": round(ents[-1], 6),
+                    "nonfinite_logit_frac": round(
+                        max(float(h["nonfinite_logit_frac"]) for h in hh), 6
+                    ),
+                }
+            except Exception:  # noqa: BLE001 — health is telemetry, never fatal
+                health_row = None
         stats = GenerationStats(
             batch=b,
             prompt_len=prompt_len,
@@ -823,6 +898,8 @@ def make_instrumented_generate_fn(
                 num_latents=num_latents,
                 tpot_hist=dict(sorted((str(k), v) for k, v in hist.counts.items())),
             )
+            if health_row is not None:
+                row.update(health_row)
             if hist.n and hist.n < 5:
                 row["tpot_low_n"] = True
             if err is not None:

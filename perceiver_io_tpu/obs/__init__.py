@@ -2,8 +2,11 @@
 metrics registry, per-request SLO aggregation, MFU/goodput accounting,
 recompile tracking, and labeled device-trace rollups.
 
-One measurement surface for every perf PR (ISSUE 1) plus the request-level
-Spanline layer (ISSUE 8): the trainer emits ``events.jsonl`` +
+One measurement surface for every perf PR (ISSUE 1), the request-level
+Spanline layer (ISSUE 8), and the in-graph Probeline numerics layer
+(ISSUE 9 — ``obs.probes``: per-scope activation/gradient stats as aux
+outputs of the compiled step, blast-radius attribution on sentinel trips,
+decode health gauges): the trainer emits ``events.jsonl`` +
 ``run_manifest.json`` next to ``metrics.csv`` (sharded per process on
 multi-host programs, merged back by ``obs.events.merged_events``); host
 spans (``obs.trace``) attribute every ``fault.*``/``compile``/``resume``
@@ -20,12 +23,21 @@ diff two runs with ``tools/obs_diff.py``.
 
 from perceiver_io_tpu.obs.events import (  # noqa: F401
     EVENT_SCHEMA_VERSION,
+    KNOWN_EVENT_KINDS,
     EventLog,
     config_hash,
     event_shards,
     merged_events,
     validate_events,
     write_run_manifest,
+)
+from perceiver_io_tpu.obs.probes import (  # noqa: F401
+    ProbeConfig,
+    blast_report,
+    decode_health,
+    probe,
+    probes_live_report,
+    snapshot_to_host,
 )
 from perceiver_io_tpu.obs.metrics import (  # noqa: F401
     Counter,
@@ -51,6 +63,13 @@ from perceiver_io_tpu.obs.trace import (  # noqa: F401
 
 __all__ = [
     "EVENT_SCHEMA_VERSION",
+    "KNOWN_EVENT_KINDS",
+    "ProbeConfig",
+    "blast_report",
+    "decode_health",
+    "probe",
+    "probes_live_report",
+    "snapshot_to_host",
     "EventLog",
     "config_hash",
     "event_shards",

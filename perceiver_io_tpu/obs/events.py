@@ -12,8 +12,10 @@ event per fit) / ``resume`` and the ``fault.*`` family (``fault.preempt`` /
 trail, training/faults.py, docs/robustness.md) / ``fit_end`` events through
 one :class:`EventLog`; instrumented generation emits per-request
 ``request`` rows (obs/slo.py aggregates them) and ``metrics`` registry
-snapshots (obs/metrics.py). ``tools/obs_report.py`` renders a run
-directory back into a summary table; ``tools/obs_diff.py`` diffs two runs.
+snapshots (obs/metrics.py); probed runs add ``probe`` numerics snapshots
+and ``probe.blast`` blast-radius reports (obs/probes.py).
+``tools/obs_report.py`` renders a run directory back into a summary
+table; ``tools/obs_diff.py`` diffs two runs.
 
 ``run_manifest.json`` pins what the run actually ran on: mesh shape,
 device kind/count, jax version, and a stable hash of the model/trainer
@@ -325,10 +327,28 @@ _REQUIRED_FIELDS: Dict[str, tuple] = {
     "metrics": ("counters", "gauges", "histograms"),
     "graphlint": (),
     "graphcheck": (),
+    # Probeline (obs/probes.py): per-scope numerics snapshots at log
+    # boundaries, and the blast-radius attribution a sentinel trip dumps
+    "probe": ("step", "scopes"),
+    "probe.blast": ("trigger", "scope", "step", "affected"),
 }
 
+# the full vocabulary THIS version of the library emits. validate_events
+# flags kinds outside it as WARNINGS (never problems): an older tool
+# reading a newer stream must keep working — forward compatibility is a
+# warning list, not a hard failure.
+KNOWN_EVENT_KINDS = frozenset(_REQUIRED_FIELDS) | frozenset(
+    {
+        "fault.preempt", "fault.skip", "fault.spike", "fault.rollback",
+        "fault.halt", "fault.poison_batch", "fault.fetch_retry",
+        "generate",  # pre-`request` legacy rows (obs_report still reads them)
+    }
+)
 
-def validate_events(path: str, strict_spans: bool = True) -> List[str]:
+
+def validate_events(
+    path: str, strict_spans: bool = True, warnings_out: Optional[List[str]] = None
+) -> List[str]:
     """Validate an event stream (a run directory or one shard file);
     returns a list of problems (empty = valid).
 
@@ -337,8 +357,14 @@ def validate_events(path: str, strict_spans: bool = True) -> List[str]:
     per-kind required fields; a torn line is tolerated only as the LAST line
     of its shard. With ``strict_spans`` every ``span_id``/``parent_id``
     reference must resolve to a ``span`` row in the same (merged) stream —
-    the property that makes fault events attributable after the fact."""
+    the property that makes fault events attributable after the fact.
+
+    Event kinds outside :data:`KNOWN_EVENT_KINDS` are NEVER problems —
+    older tooling must survive newer streams. Pass a list as
+    ``warnings_out`` to collect them as forward-compatibility warnings
+    (one per unknown kind, first occurrence)."""
     problems: List[str] = []
+    unknown_seen: set = set()
     shards = event_shards(path) if os.path.isdir(path) else [path]
     if not shards:
         return [f"{path}: no events.jsonl / events-p*.jsonl"]
@@ -363,6 +389,16 @@ def validate_events(path: str, strict_spans: bool = True) -> List[str]:
             if not isinstance(kind, str):
                 problems.append(f"{name}:{i + 1}: missing/invalid 'event'")
                 continue
+            if (
+                warnings_out is not None
+                and kind not in KNOWN_EVENT_KINDS
+                and kind not in unknown_seen
+            ):
+                unknown_seen.add(kind)
+                warnings_out.append(
+                    f"{name}:{i + 1}: unknown event kind {kind!r} "
+                    "(newer stream? tolerated — forward-compatible)"
+                )
             if not isinstance(row.get("ts"), (int, float)):
                 problems.append(f"{name}:{i + 1} [{kind}]: missing/invalid 'ts'")
             if row.get("schema_version") != EVENT_SCHEMA_VERSION:

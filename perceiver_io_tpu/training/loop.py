@@ -25,6 +25,7 @@ def make_train_step(
     microbatch: int = 1,
     overlap=None,
     sentinel: bool = False,
+    probes=None,
 ) -> Callable:
     """``train_step(state, batch) -> (state, metrics)``, jitted.
 
@@ -78,6 +79,21 @@ def make_train_step(
     can walk its policy ladder. Not supported by the overlap-scheduled step
     (the update runs sharded outside the shard_map region); there detection
     stays host-side.
+
+    ``probes=ProbeConfig(...)`` (obs/probes.py, docs/observability.md#probes)
+    compiles the Probeline numerics telemetry into the SAME XLA program:
+    the loss forward runs under a probe collector (per-scope activation
+    rms/absmax/non-finite/zero stats at the model's probe sites), and the
+    grad pytree adds per-layer-bucket gradient norms and update/param
+    ratios — all returned under ``metrics["probes"]`` as auxiliary outputs
+    (no host callback, no extra sync; the trainer fetches them only at log
+    boundaries and on sentinel trips). ``None`` (default) traces ZERO probe
+    ops — bitwise today's graph, pinned by the committed graphcheck
+    contracts. Trace-time static, like the sentinel. With ``microbatch>1``
+    activation stats are chunk-averaged (absmax becomes a mean of per-chunk
+    maxima — documented, not a bug); grad/update stats see the averaged
+    grads and the single real update. Not supported with ``overlap=`` (the
+    update runs sharded outside the shard_map region).
     """
 
     if overlap is not None:
@@ -86,6 +102,13 @@ def make_train_step(
                 "sentinel=True (in-graph skip) is not supported by the overlap-"
                 "scheduled step; use SentinelConfig(in_graph_skip=False) — "
                 "host-side detection with the rollback rung still applies"
+            )
+        if probes is not None:
+            raise ValueError(
+                "probes= is not supported by the overlap-scheduled step (its "
+                "update runs on reduce-scattered shards outside the shard_map "
+                "region, so per-bucket update ratios have no full-tree view); "
+                "use the GSPMD step for probed runs"
             )
         from jax.sharding import Mesh as _Mesh
 
@@ -104,6 +127,22 @@ def make_train_step(
             "tokens and scale count metrics by 1/k — use microbatch=1"
         )
     uniform_declared = getattr(loss_fn, "uniform_weighting", None) is True
+
+    if probes is not None and probes.activations:
+        from perceiver_io_tpu.obs import probes as _probes
+
+        _base_loss_fn = loss_fn
+
+        def loss_fn(params, batch, rng, _base=_base_loss_fn, _cfg=probes):
+            # the collector is opened INSIDE the differentiated fn, so the
+            # stats ride out through value_and_grad's aux pytree — the
+            # probe reductions become outputs of the same compiled program
+            with _probes.collecting(_cfg) as col:
+                loss, metrics = _base(params, batch, rng)
+            if isinstance(metrics, dict):
+                metrics = dict(metrics)
+                metrics["probes"] = col.stats
+            return loss, metrics
 
     def train_step(state: TrainState, batch):
         rng, step_rng = jax.random.split(state.rng)
@@ -135,8 +174,23 @@ def make_train_step(
             grads = jax.tree.map(lambda g: g * inv, grads)
             metrics = jax.tree.map(lambda m: m * inv, metrics)
             loss = metrics.get("loss") if isinstance(metrics, dict) else None
+        def attach_probes(metrics, new_state):
+            # grad-bucket norms + update/param ratios join the activation
+            # stats under metrics["probes"], numbering continued so the
+            # snapshot stays topologically ordered (fwd -> grads -> update)
+            if probes is None or not isinstance(metrics, dict):
+                return metrics
+            from perceiver_io_tpu.obs import probes as _probes
+
+            metrics = dict(metrics)
+            metrics["probes"] = _probes.attach_train_stats(
+                metrics.get("probes", {}), probes, grads, state.params, new_state.params
+            )
+            return metrics
+
         if not sentinel:
-            return state.apply_gradients(grads).replace(rng=rng), metrics
+            new_state = state.apply_gradients(grads).replace(rng=rng)
+            return new_state, attach_probes(metrics, new_state)
         # in-graph divergence sentinel: finiteness reduced inside the same
         # XLA program, the update SELECTED rather than branched (cond would
         # force both sides anyway on TPU) — a non-finite step holds
@@ -148,6 +202,7 @@ def make_train_step(
             if jnp.issubdtype(g.dtype, jnp.inexact):
                 ok = ok & jnp.all(jnp.isfinite(g))
         updated = state.apply_gradients(grads).replace(rng=rng)
+        metrics = attach_probes(metrics, updated)
         held = state.replace(step=state.step + 1, rng=rng)
         state = jax.tree.map(lambda n, o: jnp.where(ok, n, o), updated, held)
         if isinstance(metrics, dict):

@@ -89,6 +89,17 @@ class TrainerConfig:
     # step (poison-batch quarantine), emitting ``fault.poison_batch`` with
     # the offending leaf path
     quarantine_poison_batches: bool = False
+    # Probeline in-graph numerics telemetry (obs/probes.py,
+    # docs/observability.md#probes): True (default ProbeConfig) or a
+    # ProbeConfig compiles per-scope activation stats + per-bucket grad
+    # norms/update ratios into the train step as aux outputs; the trainer
+    # keeps a ring of the last-k snapshots ON DEVICE (ProbeConfig.ring),
+    # emits a `probe` event at each log boundary, and on a sentinel
+    # skip/rollback/halt dumps a `probe.blast` blast-radius event naming
+    # the first scope (topological order) whose stats went non-finite,
+    # span-attributed to the offending step. Off (default) the step's
+    # compiled graph is bitwise unchanged.
+    probes: "bool | object" = False
     # --- telemetry (obs/) -------------------------------------------------
     # structured events.jsonl + run_manifest.json next to metrics.csv
     # (written only when a logger is attached)
@@ -208,8 +219,25 @@ class Trainer:
                     self._sentinel_cfg, in_graph_skip=False
                 )
         in_graph_sentinel = self._sentinel_cfg is not None and self._sentinel_cfg.in_graph_skip
+        # Probeline (obs/probes.py): resolve the probe config once; the
+        # in-graph stats compile into the step below, the ring/blast host
+        # side lives in fit()
+        self._probe_cfg = None
+        if self.config.probes:
+            from perceiver_io_tpu.obs.probes import ProbeConfig
+
+            self._probe_cfg = (
+                self.config.probes
+                if isinstance(self.config.probes, ProbeConfig)
+                else ProbeConfig()
+            )
         self._train_step = self.recompiles.wrap(
-            make_train_step(loss_fn, overlap=overlap_cfg, sentinel=in_graph_sentinel),
+            make_train_step(
+                loss_fn,
+                overlap=overlap_cfg,
+                sentinel=in_graph_sentinel,
+                probes=self._probe_cfg,
+            ),
             "train_step",
         )
         # the raw (unjitted) step for the graphlint trace: linting through
@@ -218,7 +246,8 @@ class Trainer:
         # SAME overlap config so the linted graph is the trained program
         # (the jaxpr walker descends into the shard_map body)
         self._lint_step = make_train_step(
-            loss_fn, jit=False, overlap=overlap_cfg, sentinel=in_graph_sentinel
+            loss_fn, jit=False, overlap=overlap_cfg, sentinel=in_graph_sentinel,
+            probes=self._probe_cfg,
         )
         # the fit-scoped preemption guard, exposed so tests and the chaos
         # harness can trip it deterministically (tools/chaos.py)
@@ -476,6 +505,14 @@ class Trainer:
             from perceiver_io_tpu.training.faults import DivergenceSentinel
 
             sentinel = DivergenceSentinel(self._sentinel_cfg)
+        # Probeline ring (obs/probes.py): the last-k probe snapshots parked
+        # as DEVICE arrays — no host sync on the step path; fetched only at
+        # log boundaries (`probe` event) and on sentinel trips (blast)
+        probe_ring = None
+        if self._probe_cfg is not None:
+            from collections import deque
+
+            probe_ring = deque(maxlen=max(int(self._probe_cfg.ring), 1))
         guard = None
         if cfg.preemption_save:
             from perceiver_io_tpu.training.faults import PreemptionGuard
@@ -610,6 +647,16 @@ class Trainer:
                                 self._graphcheck(events, state, batch, closed)
                     t_dispatch = time.perf_counter()
                     state, metrics = self._train_step(state, batch)
+                    if (
+                        probe_ring is not None
+                        and isinstance(metrics, dict)
+                        and "probes" in metrics
+                    ):
+                        # park the snapshot (device arrays + the post-step
+                        # step counter, unfetched) and keep metrics clean
+                        # for the float()-ing log window
+                        metrics = dict(metrics)
+                        probe_ring.append((state.step, metrics.pop("probes")))
                     if step_span is not None:
                         # host wall of ISSUING the step (trace+compile on a
                         # miss, dispatch otherwise) — device compute is async
@@ -639,16 +686,38 @@ class Trainer:
 
                     if sentinel is not None:
                         decision = self._sentinel_decide(sentinel, events, metrics, step)
-                        if (
+                        skipped_now = (
                             isinstance(metrics, dict)
                             and float(metrics.get("sentinel_skipped", 0.0)) > 0.5
-                            and window
-                        ):
+                        )
+                        if skipped_now and window:
                             # the held step's non-finite metrics must not
                             # poison the log-window mean (the skip itself is
                             # on record as a fault.skip event)
                             window.pop()
                             window_samples -= _leading_dim(batch)
+                        # blast-radius attribution (obs/probes.py): a trip
+                        # with probe snapshots on record names the FIRST
+                        # scope (topological order) of the EARLIEST ring
+                        # entry whose stats went non-finite — emitted inside
+                        # the still-open step span, so the `probe.blast`
+                        # event is attributable to the offending step
+                        trigger = None
+                        if decision is not None and decision.action in ("rollback", "halt"):
+                            trigger = decision.action
+                        elif skipped_now:
+                            trigger = "skip"
+                        if trigger is not None and probe_ring is not None and events is not None:
+                            from perceiver_io_tpu.obs import probes as _probes
+
+                            report = _probes.blast_report(probe_ring)
+                            if report is not None:
+                                events.emit("probe.blast", trigger=trigger, **report)
+                                # an attributed incident is done: drop its
+                                # snapshots so a LATER independent trip
+                                # within ring-length steps attributes to its
+                                # own origin, not this stale one
+                                probe_ring.clear()
                         if decision is not None and decision.action == "rollback":
                             from_step = step
                             # roll back to the last valid checkpoint; the
@@ -685,6 +754,12 @@ class Trainer:
                             window, window_samples, t0 = [], 0, time.perf_counter()
                             input_wait_s = 0.0
                             window_overhead0 = goodput.overhead()
+                            if probe_ring is not None:
+                                # remaining snapshots describe the rolled-back
+                                # trajectory (a spike-triggered rollback emits
+                                # no blast, so the emit-time clear above may
+                                # not have run) — the replay starts fresh
+                                probe_ring.clear()
                             continue
                         if decision is not None and decision.action == "halt":
                             if events is not None:
@@ -736,6 +811,19 @@ class Trainer:
                         self._log(step, avg)
                         if events is not None:
                             events.emit("log", step=step, **avg)
+                            if probe_ring:
+                                # the log boundary is the agreed host-sync
+                                # point: fetch the LATEST snapshot only and
+                                # emit it as a `probe` row (per-scope trend
+                                # input for tools/obs_report.py)
+                                from perceiver_io_tpu.obs import probes as _probes
+
+                                s_dev, snap = probe_ring[-1]
+                                events.emit(
+                                    "probe",
+                                    step=int(s_dev),
+                                    scopes=_probes.snapshot_to_host(snap),
+                                )
                         if tracer is not None:
                             tracer.flush()  # span rows land once per window
                         window, window_samples, t0 = [], 0, time.perf_counter()

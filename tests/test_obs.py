@@ -1003,3 +1003,99 @@ def test_histogram_counts_merge_exactly():
         assert percentile_from_counts(merged, p) == pytest.approx(
             percentile_from_counts(both.counts, p)
         )
+
+
+def test_histogram_empty_percentile_and_to_dict():
+    """ISSUE 9 satellite: an empty histogram reports None percentiles (not
+    a crash, not a fake 0) and a stat-free to_dict."""
+    from perceiver_io_tpu.obs.metrics import Histogram, percentile_from_counts
+
+    h = Histogram("empty_s")
+    for p in (0, 50, 99, 100):
+        assert h.percentile(p) is None
+    assert percentile_from_counts({}, 50) is None
+    d = h.to_dict()
+    assert d["n"] == 0 and d["min"] is None and d["max"] is None
+    assert "p50" not in d and "p99" not in d and "low_n" not in d
+    with pytest.raises(ValueError):
+        h.percentile(101)
+
+
+def test_histogram_merge_exactly_associative_across_three_shards():
+    """ISSUE 9 satellite: merging >= 3 shards' sparse counts is EXACTLY
+    associative and commutative — any merge tree gives the same counts and
+    the same percentiles (the property multi-process SLO aggregation and
+    the obs_report fallback both lean on)."""
+    from perceiver_io_tpu.obs.metrics import Histogram, merge_counts, percentile_from_counts
+
+    rng = np.random.default_rng(7)
+    shards = [Histogram(f"s{i}") for i in range(4)]
+    ref = Histogram("ref")
+    for _ in range(500):
+        v = float(rng.lognormal(-6, 2))
+        shards[int(rng.integers(0, 4))].record(v)
+        ref.record(v)
+    counts = [s.counts for s in shards]
+    left = merge_counts(merge_counts(merge_counts(counts[0], counts[1]), counts[2]), counts[3])
+    right = merge_counts(counts[0], merge_counts(counts[1], merge_counts(counts[2], counts[3])))
+    flat = merge_counts(*counts)
+    rev = merge_counts(*reversed(counts))
+    assert left == right == flat == rev == ref.counts
+    for p in (50, 90, 99):
+        assert percentile_from_counts(flat, p) == percentile_from_counts(ref.counts, p)
+
+
+def test_histogram_to_prometheus_bucket_monotonicity():
+    """ISSUE 9 satellite: the exposition's cumulative buckets must be
+    non-decreasing with strictly increasing le bounds, +Inf == count — on a
+    histogram with GAPS between occupied buckets (the sparse-counts case a
+    naive cumulative walk gets wrong)."""
+    import re
+
+    from perceiver_io_tpu.obs.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    h = reg.histogram("gappy_s")
+    for v in (1e-6, 1e-6, 1e-3, 5.0, 5.0, 5.0):  # three distant clusters
+        h.record(v)
+    text = reg.to_prometheus()
+    pairs = re.findall(r'gappy_s_bucket\{le="([^"}]+)"\} (\d+)', text)
+    les = [le for le, _ in pairs]
+    cums = [int(c) for _, c in pairs]
+    assert les[-1] == "+Inf" and cums[-1] == h.n == 6
+    finite_les = [float(le) for le in les[:-1]]
+    assert finite_les == sorted(finite_les) and len(set(finite_les)) == len(finite_les)
+    assert cums == sorted(cums)  # non-decreasing cumulative counts
+    assert "gappy_s_count 6" in text
+
+
+def test_validate_events_unknown_kinds_warn_forward_compatibly(tmp_path):
+    """ISSUE 9 satellite: kinds outside KNOWN_EVENT_KINDS are NEVER
+    problems (older tooling survives newer streams) but are collected into
+    warnings_out; probe/probe.blast rows get required-field checks."""
+    from perceiver_io_tpu.obs.events import KNOWN_EVENT_KINDS, EventLog, validate_events
+
+    d = str(tmp_path)
+    events = EventLog(d, main_process=True)
+    events.emit("fit_start", start_step=0, max_steps=1)
+    events.emit("probe", step=1, scopes={"000:embed": {"rms": 1.0}})
+    events.emit(
+        "probe.blast", trigger="skip", scope="embed", step=1,
+        affected=["embed"], n_affected=1,
+    )
+    events.emit("shiny.future_kind", payload=123)
+    events.emit("shiny.future_kind", payload=456)  # second occurrence: one warning
+    warnings_out = []
+    problems = validate_events(d, warnings_out=warnings_out)
+    assert problems == [], problems  # unknown kind is NOT a failure
+    assert len(warnings_out) == 1 and "shiny.future_kind" in warnings_out[0]
+    assert validate_events(d) == []  # no warnings_out: same verdict, no crash
+    assert "probe" in KNOWN_EVENT_KINDS and "probe.blast" in KNOWN_EVENT_KINDS
+    assert "fault.rollback" in KNOWN_EVENT_KINDS
+
+    # planted drift in the probe kinds IS a failure
+    events.emit("probe", scopes={})  # missing step
+    events.emit("probe.blast", trigger="skip")  # missing scope/step/affected
+    problems = validate_events(d)
+    assert any("[probe]" in p and "step" in p for p in problems)
+    assert any("[probe.blast]" in p and "scope" in p for p in problems)

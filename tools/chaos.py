@@ -56,8 +56,14 @@ TOL = 1e-6
 def _loss_fn():
     import jax.numpy as jnp
 
+    from perceiver_io_tpu.obs.probes import probe
+
     def loss_fn(params, batch, rng):
-        pred = batch["x"] @ params["w"]
+        # Probeline tap: when the trainer runs probed (the sentinel
+        # scenarios), the prediction's numerics stats ride out of the step —
+        # a NaN input batch makes "chaos.pred" the FIRST non-finite scope,
+        # which the blast-radius report must name
+        pred = probe("chaos.pred", batch["x"] @ params["w"])
         loss = jnp.mean((pred - batch["y"]) ** 2)
         return loss, {"loss": loss}
 
@@ -100,6 +106,9 @@ def _make_trainer(run_dir, max_steps, mesh=None, sentinel=False, **cfg_kw):
         input_double_buffer=False,
         graphlint=False,
         sentinel=sentinel,
+        # sentinel scenarios run PROBED: a trip must produce a span-
+        # attributed blast-radius report naming the planted scope
+        probes=bool(sentinel),
         fsdp_min_weight_size=0,
         **cfg_kw,
     )
@@ -149,7 +158,8 @@ def _assert_span_attributed(run_dir):
     span_ids = {r.get("span_id") for r in rows if r.get("event") == "span"}
     audited = [
         r for r in rows
-        if r.get("event", "").startswith("fault.") or r.get("event") == "resume"
+        if r.get("event", "").startswith("fault.")
+        or r.get("event") in ("resume", "probe.blast")
     ]
     for r in audited:
         assert r.get("span_id") in span_ids, (
@@ -295,9 +305,13 @@ def scenario_nan_skip(tmp):
     w_at = snapshots[poison_fetch - 1][2]
     assert np.array_equal(w_before, w_at), "skip did not hold params"
     assert not np.isnan(losses[poison_fetch:]).any(), "NaN leaked past the skip"
+    blasts = _events(run_dir, "probe.blast")
+    assert blasts and blasts[0]["scope"] == "chaos.pred" and blasts[0]["trigger"] == "skip", (
+        f"skip not blast-attributed to the planted scope: {blasts}"
+    )
     _assert_span_attributed(run_dir)
     print(f"chaos: nan_skip ok — poison batch at step {poison_fetch} skipped in-graph, "
-          f"params held, final loss {losses[-1]:.4f} finite")
+          f"params held, blast named {blasts[0]['scope']!r}, final loss {losses[-1]:.4f} finite")
 
 
 def scenario_nan_rollback(tmp):
@@ -325,8 +339,20 @@ def scenario_nan_rollback(tmp):
     assert rb[0]["from_step"] == 7 and rb[0]["to_step"] == 4, rb
     finite = [l for l in losses if np.isfinite(l)]
     assert np.isfinite(losses[-1]) and len(finite) >= n_steps, "run did not recover"
+    # Probeline blast radius (ISSUE 9): the trip must be ATTRIBUTED — a
+    # probe.blast event naming the planted non-finite scope ("chaos.pred"
+    # is the first probe in topological order; the NaN enters there), tied
+    # to the offending step's span like every other fault event
+    blasts = _events(run_dir, "probe.blast")
+    assert blasts, "no probe.blast event despite a probed sentinel rollback"
+    assert any(b.get("scope") == "chaos.pred" for b in blasts), (
+        f"blast reports name {[b.get('scope') for b in blasts]}, "
+        "expected the planted scope 'chaos.pred'"
+    )
+    assert all(b.get("trigger") in ("skip", "rollback", "halt") for b in blasts), blasts
     _assert_span_attributed(run_dir)
     print(f"chaos: nan_rollback ok — skip_limit tripped at step 7, rolled back to 4, "
+          f"blast named {blasts[0]['scope']!r} (radius {blasts[0]['n_affected']}), "
           f"run completed with final loss {losses[-1]:.4f}")
 
 

@@ -98,6 +98,9 @@ def run_workload(out_dir: str, steps: int, requests: int) -> None:
             prefetch_batches=0,
             tokens_per_sample=tokens_per_sample,
             flops_per_sample=flops_per_sample,
+            # Probeline: the gate certifies a PROBED fit — per-scope stats
+            # ride the step as aux outputs and land as `probe` event rows
+            probes=True,
         ),
     )
     state = trainer.fit(state, iter([batch] * steps), model_config=config)
@@ -108,6 +111,7 @@ def run_workload(out_dir: str, steps: int, requests: int) -> None:
         config=GenerationConfig(max_new_tokens=8),
         events=trainer._ensure_events(),
         snapshot_interval_s=0.0,  # a metrics snapshot per request: gate-visible
+        probes=True,  # decode health gauges on every request row
     )
     for _ in range(requests):
         fn(state.params, prompt)
@@ -120,7 +124,11 @@ def check_stream(out_dir: str, steps: int, requests: int) -> list:
     from perceiver_io_tpu.obs.events import merged_events, validate_events
     from perceiver_io_tpu.obs.slo import write_slo_report
 
-    problems = list(validate_events(out_dir))
+    fwd_warnings: list = []
+    problems = list(validate_events(out_dir, warnings_out=fwd_warnings))
+    for w in fwd_warnings:
+        # unknown kinds are forward-compatibility WARNINGS, never failures
+        print(f"obs_gate: warning: {w}")
     events = merged_events(out_dir)
     kinds = [e.get("event") for e in events]
     step_spans = [
@@ -140,6 +148,22 @@ def check_stream(out_dir: str, steps: int, requests: int) -> list:
         problems.append("no metrics registry snapshot row in the stream")
     if "fit_end" not in kinds:
         problems.append("no fit_end row in the stream")
+    # Probeline rows: the probed fit must land per-scope snapshots, and the
+    # probed decode must stamp health gauges onto every request
+    probe_rows = [e for e in events if e.get("event") == "probe"]
+    if not probe_rows:
+        problems.append("no probe snapshot rows despite TrainerConfig.probes")
+    for e in probe_rows:
+        scopes = e.get("scopes")
+        if not isinstance(scopes, dict) or not scopes:
+            problems.append("probe row has empty/invalid scopes")
+            continue
+        for k, st in scopes.items():
+            if not isinstance(st, dict) or not st:
+                problems.append(f"probe scope {k!r} carries no stats")
+    for r in reqs:
+        if r.get("kv_cache_frac") is None or r.get("logit_entropy_mean") is None:
+            problems.append("request event missing decode health gauges")
     slo = write_slo_report(out_dir)
     if slo is None:
         problems.append("SLO report empty despite request events")

@@ -199,6 +199,52 @@ def render(run_dir: str, max_compile_rows: int = 20) -> str:
             except ImportError:
                 lines.append("  (xplane capture present; install the package for the device join)")
 
+    # Probeline per-scope trends (probe events: one snapshot per log
+    # boundary, scopes keyed "NNN:name" — sorted == topological order) and
+    # blast-radius reports. Non-finite stats arrive as JSON null (the
+    # strict-JSON NaN policy), so None in a stat column means NONFINITE.
+    probe_rows = [e for e in events if e.get("event") == "probe"]
+    if probe_rows:
+        series: Dict[str, List] = {}
+        for e in probe_rows:
+            for k, st in (e.get("scopes") or {}).items():
+                if isinstance(st, dict):
+                    series.setdefault(k, []).append(st)
+        lines.append("")
+        lines.append(
+            f"== probes ({len(probe_rows)} snapshots, {len(series)} scopes) =="
+        )
+
+        def _bare(key):
+            # must track obs.probes.scope_of — inlined because this renderer
+            # stays stdlib-only (same pattern as the GROWTH fallback below)
+            head, sep, tail = key.partition(":")
+            return tail if sep and head.isdigit() else key
+
+        def _spaced(vals, n=5):
+            if len(vals) <= n:
+                return vals
+            idx = [round(i * (len(vals) - 1) / (n - 1)) for i in range(n)]
+            return [vals[i] for i in idx]
+
+        rows = []
+        for k in sorted(series)[:48]:
+            pts = series[k]
+            main_key = "rms" if "rms" in pts[-1] else ("l2" if "l2" in pts[-1] else "ratio")
+            vals = [s.get(main_key) for s in pts]
+            bad = any(v is None for v in vals) or any(
+                (s.get("nonfinite_frac") or 0) > 0 for s in pts
+            )
+            trend = " -> ".join("nan" if v is None else f"{v:.3g}" for v in _spaced(vals))
+            rows.append([_bare(k), f"{main_key}: {trend}", "NONFINITE" if bad else ""])
+        lines.extend("  " + r for r in _table(rows, ["scope", "trend (first -> last)", ""]))
+
+    for b in (e for e in events if e.get("event") == "probe.blast"):
+        lines.append(
+            f"  BLAST [{b.get('trigger')}] step {b.get('step')}: first non-finite scope "
+            f"{b.get('scope')!r} ({b.get('n_affected')}/{b.get('n_scopes')} scopes affected)"
+        )
+
     ends = [e for e in events if e.get("event") == "fit_end"]
     if ends:
         end = ends[-1]
