@@ -1,0 +1,97 @@
+"""Programmatic multivariate time-series forecasting — the
+library-as-toolkit variant of train.sh (reference: the fork-added root
+time-series app, cli.py over model.py/datamodule.py): build the sliding-
+window CSV datamodule, model config and trainer directly instead of going
+through the auto-CLI (``scripts/timeseries.py``).
+
+Defaults run END-TO-END on the synthetic deterministic series (sine
+mixtures + noise, written once under .cache/timeseries) — no downloads,
+CI-fast: the 2-block encoder at init_scale 0.1 drops the forecast MSE well
+under the series variance (~0.5) inside the smoke budget. For a real run
+point ``data_args.train_path`` at an ETT-style CSV and raise
+``max_steps``/window sizes back to the paper geometry (in_len 4096 /
+out_len 5000).
+
+Run from the repo root: ``PYTHONPATH=. python examples/training/timeseries/train.py``
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from perceiver_io_tpu.core.config import PerceiverIOConfig
+from perceiver_io_tpu.models.timeseries import (
+    TimeSeriesDecoderConfig,
+    TimeSeriesEncoderConfig,
+    TimeSeriesPerceiver,
+)
+from perceiver_io_tpu.scripts import cli
+from perceiver_io_tpu.scripts.timeseries import TimeSeriesDataArgs, build_timeseries_datamodule
+from perceiver_io_tpu.training.losses import mse_loss_fn
+
+data_args = TimeSeriesDataArgs(
+    train_path="synthetic",
+    in_len=512,
+    out_len=256,
+    stride=64,
+    batch_size=8,
+)
+
+trainer_args = cli.TrainerArgs(
+    strategy="dp",
+    precision="bf16",
+    gradient_clip_val=1.0,
+    max_steps=300,
+    val_interval=100,
+    name="timeseries",
+)
+
+# the smoke preset's recipe (scripts/timeseries.py): single-head CA at the
+# default init_scale 0.02 predicts the series mean for thousands of steps,
+# so the offline example runs hotter — init_scale 0.1 + lr 3e-3
+opt_args = cli.OptimizerArgs(lr=3e-3, lr_scheduler="cosine_with_warmup", warmup_steps=50)
+
+
+def main():
+    data = build_timeseries_datamodule(data_args)
+    # reference defaults scaled to the CI budget: 64 latents x 64 channels,
+    # 2 single-layer single-head blocks (reference: model.py:48-78 uses
+    # 256x256 over 8 blocks at the paper geometry)
+    config = PerceiverIOConfig(
+        encoder=TimeSeriesEncoderConfig(
+            num_input_channels=data.num_channels,
+            in_len=data_args.in_len,
+            num_cross_attention_heads=1,
+            num_self_attention_heads=1,
+            num_self_attention_blocks=2,
+            num_self_attention_layers_per_block=1,
+            init_scale=0.1,
+        ),
+        decoder=TimeSeriesDecoderConfig(
+            out_len=data_args.out_len,
+            num_output_channels=data.num_channels,
+            num_cross_attention_heads=1,
+            init_scale=0.1,
+        ),
+        num_latents=64,
+        num_latent_channels=64,
+    )
+    model = TimeSeriesPerceiver(config, dtype=cli.activation_dtype(trainer_args))
+
+    init_batch = {
+        "x": np.zeros((1, data_args.in_len, data.num_channels), np.float32)
+    }
+    cli.run_training(
+        model,
+        config,
+        lambda apply_fn: mse_loss_fn(apply_fn),
+        init_batch,
+        cli.cycle(data.train_batches()),
+        data.valid_batches(),
+        trainer_args,
+        opt_args,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -6,9 +6,11 @@ JSON object per line, every line carrying ``ts`` (epoch seconds),
 ``fit_start`` / ``log`` / ``compile`` / ``eval`` / ``span`` (host
 step/fit/checkpoint spans — obs/trace.py) / ``graphlint`` (the
 static-analysis verdict on the train step's traced graph — analysis/, one
-event per fit) / ``resume`` and the ``fault.*`` family (``fault.preempt`` /
-``fault.skip`` / ``fault.spike`` / ``fault.rollback`` / ``fault.halt`` /
-``fault.poison_batch`` / ``fault.fetch_retry`` — the fault-handling audit
+event per fit) / ``resume`` / ``resume.reshard`` (a checkpoint landed on a different mesh —
+elastic resume, docs/robustness.md#elastic-resume) and the ``fault.*``
+family (``fault.preempt`` / ``fault.skip`` / ``fault.spike`` /
+``fault.rollback`` / ``fault.halt`` / ``fault.poison_batch`` /
+``fault.fetch_retry`` / ``fault.ckpt_retry`` — the fault-handling audit
 trail, training/faults.py, docs/robustness.md) / ``fit_end`` events through
 one :class:`EventLog`; instrumented generation emits per-request
 ``request`` rows (obs/slo.py aggregates them) and ``metrics`` registry
@@ -322,6 +324,13 @@ _REQUIRED_FIELDS: Dict[str, tuple] = {
     "eval": ("step",),
     "compile": ("fn", "wall_s", "n_compiles"),
     "resume": ("from_step", "to_step"),
+    # elastic resume (training/checkpoint.py, docs/robustness.md#elastic-
+    # resume): a checkpoint landed on a different mesh than it was saved
+    # under — old/new mesh shapes, leaves/bytes moved, restore wall time
+    "resume.reshard": ("old_mesh", "new_mesh", "step"),
+    # transient checkpoint-I/O retry (save/restore wrapped in RetryPolicy —
+    # same discipline as the loader's fault.fetch_retry)
+    "fault.ckpt_retry": ("attempt", "delay_s"),
     "span": ("name", "span_id", "t_start", "t_end", "dur_ms", "process_index", "attrs"),
     "request": ("request_id", "batch", "prompt_len", "ttft_s", "outcome", "tokens_out"),
     "metrics": ("counters", "gauges", "histograms"),

@@ -13,6 +13,7 @@ from perceiver_io_tpu.training.losses import (
 from perceiver_io_tpu.training.optim import freeze_mask
 from perceiver_io_tpu.training.checkpoint import (
     CheckpointManager,
+    ResumePreflightError,
     config_from_dict,
     config_to_dict,
     load_config,
@@ -20,6 +21,7 @@ from perceiver_io_tpu.training.checkpoint import (
     load_pretrained,
     save_config,
     save_pretrained,
+    sharding_fingerprint,
 )
 from perceiver_io_tpu.training.faults import (
     DivergenceHalt,
@@ -51,6 +53,8 @@ __all__ = [
     "mse_loss_fn",
     "freeze_mask",
     "CheckpointManager",
+    "ResumePreflightError",
+    "sharding_fingerprint",
     "config_from_dict",
     "config_to_dict",
     "load_config",

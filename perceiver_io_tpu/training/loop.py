@@ -239,21 +239,18 @@ def make_eval_step(eval_fn: Callable) -> Callable:
     return jax.jit(eval_step)
 
 
-def shard_train_state(state: TrainState, mesh: Mesh, min_weight_size: int = 2**14) -> TrainState:
-    """Place a train state on the mesh: parameters (and matching optimizer
-    state) sharded along the tensor (head/hidden dims) and fsdp axes,
-    scalars replicated."""
-    shardings = param_shardings(state.params, mesh, min_weight_size=min_weight_size)
-    params = jax.tree.map(jax.device_put, state.params, shardings)
+def train_state_shardings(state: TrainState, mesh: Mesh, min_weight_size: int = 2**14):
+    """The target ``NamedSharding`` for every leaf of ``state`` on ``mesh``,
+    returned as a TrainState-shaped container: parameters along the tensor
+    (head/hidden dims) and fsdp axes, optimizer moments mirroring their
+    parameters, scalars (step/rng/opt counts) replicated.
 
-    if mesh.shape["tensor"] > 1 and not any(
-        "tensor" in str(s.spec) for s in jax.tree.leaves(shardings)
-    ):
-        print(
-            "WARNING: tensor axis size "
-            f"{mesh.shape['tensor']} does not divide any projection dim — "
-            "no parameter is tensor-sharded (fully replicated TP)"
-        )
+    This is the single source of placement truth shared by
+    :func:`shard_train_state` (device placement) and
+    ``CheckpointManager.restore(mesh=...)`` (the abstract pytree whose
+    shardings tell orbax where each restored leaf must land — the
+    mesh-elastic resume path, docs/robustness.md#elastic-resume)."""
+    shardings = param_shardings(state.params, mesh, min_weight_size=min_weight_size)
 
     # Optimizer state: optax moments mirror the param tree, so each leaf path
     # ends with the corresponding parameter's path (e.g. mu/<param path>).
@@ -268,18 +265,51 @@ def shard_train_state(state: TrainState, mesh: Mesh, min_weight_size: int = 2**1
             jax.tree_util.tree_flatten_with_path(state.params)[0], jax.tree.leaves(shardings)
         )
     }
+    replicated = NamedSharding(mesh, P())
 
-    def place(path, x):
+    def spec_for(path, x):
         if not hasattr(x, "shape"):
-            return x
+            return replicated
         names = _names(path)
         for i in range(len(names)):
             s = by_path.get(names[i:])
             if s is not None:
-                return jax.device_put(x, s)
-        return jax.device_put(x, NamedSharding(mesh, P()))
+                return s
+        return replicated
 
-    opt_state = jax.tree_util.tree_map_with_path(place, state.opt_state)
-    rng = jax.device_put(state.rng, NamedSharding(mesh, P()))
-    step = jax.device_put(state.step, NamedSharding(mesh, P()))
-    return state.replace(params=params, opt_state=opt_state, rng=rng, step=step)
+    opt_shardings = jax.tree_util.tree_map_with_path(spec_for, state.opt_state)
+    return state.replace(
+        params=shardings, opt_state=opt_shardings, rng=replicated, step=replicated
+    )
+
+
+def shard_train_state(state: TrainState, mesh: Mesh, min_weight_size: int = 2**14) -> TrainState:
+    """Place a train state on the mesh: parameters (and matching optimizer
+    state) sharded along the tensor (head/hidden dims) and fsdp axes,
+    scalars replicated.
+
+    Idempotent RE-placement: a leaf already carrying its target sharding is
+    returned as-is (placing twice is free), and a state placed on a
+    *different* mesh — the elastic-resume case where the pod came back with
+    another shape — is re-resolved onto the new mesh rather than
+    double-sharded (``device_put`` reshards committed arrays across
+    meshes)."""
+    target = train_state_shardings(state, mesh, min_weight_size=min_weight_size)
+
+    if mesh.shape["tensor"] > 1 and not any(
+        "tensor" in str(s.spec) for s in jax.tree.leaves(target.params)
+    ):
+        print(
+            "WARNING: tensor axis size "
+            f"{mesh.shape['tensor']} does not divide any projection dim — "
+            "no parameter is tensor-sharded (fully replicated TP)"
+        )
+
+    def place(x, s):
+        if not hasattr(x, "shape"):
+            return x
+        if getattr(x, "sharding", None) == s:
+            return x  # already resolved on this mesh — no copy
+        return jax.device_put(x, s)
+
+    return jax.tree.map(place, state, target)

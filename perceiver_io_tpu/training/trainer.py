@@ -291,6 +291,9 @@ class Trainer:
                 # overlap checkpoint IO with continued training; fit() waits
                 # before returning so callers always see committed state
                 enable_async=True,
+                # transient-FS retry on save/restore I/O (fault.ckpt_retry
+                # events once fit wires the sink below)
+                retry=True,
             )
 
     # -- helpers ----------------------------------------------------------
@@ -437,34 +440,26 @@ class Trainer:
         replaces."""
         cfg = self.config
         if self.mesh is not None:
+            # idempotent (re-)placement: a state restored/placed on another
+            # mesh in a previous life is re-resolved onto THIS mesh — the
+            # elastic-resume entry point (docs/robustness.md#elastic-resume)
             state = shard_train_state(state, self.mesh, min_weight_size=cfg.fsdp_min_weight_size)
         auto_resume = resume == "auto"
         fast_forward_n = 0
         resume_info = None
-        if resume:
-            if self.checkpoints is None:
-                raise ValueError("resume requires checkpoint_dir")
-            if auto_resume:
-                self._residual_batches.clear()
-                if self.checkpoints.latest_step() is not None:
-                    pre_step = int(state.step)
-                    state = self.checkpoints.restore(state)
-                    fast_forward_n = max(0, int(state.step) - pre_step)
-                    resume_info = {
-                        "from_step": pre_step,
-                        "to_step": int(state.step),
-                        "fast_forward_batches": fast_forward_n,
-                    }
-                    if self.logger is not None:
-                        self.logger.truncate_after(int(state.step))
-            elif self.checkpoints.latest_step() is not None:
-                state = self.checkpoints.restore(state)
+        if resume and self.checkpoints is None:
+            raise ValueError("resume requires checkpoint_dir")
 
         # --- telemetry: event sink, run manifest, goodput, MFU inputs -----
+        # (set up BEFORE the resume restore, so the restore path's
+        # resume.reshard / fault.ckpt_retry events land in the stream,
+        # inside the resume span)
         events = self._ensure_events()
         goodput = GoodputTracker()
         self.recompiles.events = events
         self.recompiles.goodput = goodput
+        if self.checkpoints is not None:
+            self.checkpoints.event_sink = events
         if events is not None and not self._manifest_written:
             write_run_manifest(
                 self.logger.log_dir,
@@ -482,15 +477,49 @@ class Trainer:
         # fit_start/resume — and, via the ambient fallback, producer-thread
         # fault events — are stamped with its span_id
         tracer = None
+        fit_span = None
         span_stack = contextlib.ExitStack()
         if events is not None and cfg.spans:
             from perceiver_io_tpu.obs.trace import Tracer
 
             tracer = Tracer(events)
-            span_stack.enter_context(
-                tracer.span("fit", ambient=True, start_step=int(state.step))
-            )
+            fit_span = span_stack.enter_context(tracer.span("fit", ambient=True))
         from perceiver_io_tpu.obs.trace import maybe_span
+
+        if resume:
+            # the resume span wraps preflight + restore, so every restore-
+            # path event (resume.reshard, fault.ckpt_retry) is attributable
+            try:
+                with maybe_span(tracer, "resume"):
+                    if auto_resume:
+                        self._residual_batches.clear()
+                        if self.checkpoints.latest_step() is not None:
+                            pre_step = int(state.step)
+                            # preflight: one actionable error on config/shape
+                            # incompatibility instead of a deep orbax ValueError
+                            self.checkpoints.preflight(state, model_config=model_config)
+                            with goodput.measure("checkpoint"):
+                                state = self.checkpoints.restore(state)
+                            fast_forward_n = max(0, int(state.step) - pre_step)
+                            resume_info = {
+                                "from_step": pre_step,
+                                "to_step": int(state.step),
+                                "fast_forward_batches": fast_forward_n,
+                            }
+                            if self.logger is not None:
+                                self.logger.truncate_after(int(state.step))
+                    elif self.checkpoints.latest_step() is not None:
+                        state = self.checkpoints.restore(state)
+            except BaseException:
+                # restore/preflight died BEFORE fit_start: close + flush the
+                # fit span so the stream stays well-formed (no fit_end — no
+                # fit_start was emitted), then propagate the real error
+                span_stack.close()
+                if tracer is not None:
+                    tracer.flush()
+                raise
+        if fit_span is not None:
+            fit_span.set("start_step", int(state.step))
 
         if events is not None:
             events.emit("fit_start", start_step=int(state.step), max_steps=cfg.max_steps)
@@ -901,7 +930,8 @@ class Trainer:
                     # and retention can never evict the best-val step
                     with goodput.measure("checkpoint"), maybe_span(tracer, "checkpoint"):
                         pm = CheckpointManager(
-                            cfg.checkpoint_dir, max_to_keep=None, monitor=None
+                            cfg.checkpoint_dir, max_to_keep=None, monitor=None,
+                            retry=True, event_sink=events,
                         )
                         # the marker metric keeps orbax's metrics item present
                         # (restore paths read it); _monitor_value never lets a
@@ -917,6 +947,8 @@ class Trainer:
                     max_to_keep=self.config.max_checkpoints,
                     monitor=None,
                     save_weights_only=self.config.save_weights_only,
+                    retry=True,
+                    event_sink=events,
                 )
                 with goodput.measure("checkpoint"), maybe_span(tracer, "checkpoint"):
                     final_mngr.save(state, config=model_config)

@@ -27,8 +27,8 @@ class ScalingLaw:
 
     def __str__(self):
         return (
-            f"compute-optimal fit: N_opt(C) = {self.k_n:.4g}·C^{self.a:.3g}, "
-            f"D_opt(C) = {self.k_d:.4g}·C^{self.b:.3g}"
+            f"fitted power laws over compute C: N_opt = {self.k_n:.4g} * C**{self.a:.3g} "
+            f"params, D_opt = {self.k_d:.4g} * C**{self.b:.3g} tokens"
         )
 
 

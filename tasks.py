@@ -13,7 +13,7 @@ equivalent with the same task names:
     python tasks.py perf [...]         # perf CI: graphcheck contracts + graphlint + bench floors + obs gate
     python tasks.py obs [...]          # observability gate (spans/requests/SLO + obs_diff self-check)
     python tasks.py dryrun [...]       # 8-virtual-device multichip certification
-    python tasks.py chaos [...]        # fault-injection gate (preempt/NaN/torn-save)
+    python tasks.py chaos [...]        # fault-injection gate (preempt/NaN/torn-save/elastic resume)
 """
 
 from __future__ import annotations
@@ -138,8 +138,12 @@ def chaos(args):
     """Fault-injection gate (tools/chaos.py; docs/robustness.md): SIGTERM
     preemption + auto-resume equivalence (unsharded AND data x fsdp mesh),
     loader fetch retries, NaN-grad sentinel skip/rollback, torn-save
-    quarantine. Extra args go to tools/chaos.py (e.g. ``--scenarios
-    preempt``)."""
+    quarantine, and the four mesh-ELASTIC resume scenarios (elastic_shrink
+    8->4, elastic_grow 4->8, flat_to_mesh, mesh_to_flat — kill and resume
+    run on different virtual-device topologies, trajectory must match
+    <= 1e-6 with a span-attributed resume.reshard event and a clean
+    graphlint pass on the new mesh). Extra args go to tools/chaos.py
+    (e.g. ``--scenarios preempt``)."""
     run(sys.executable, "tools/chaos.py", *args.rest)
 
 

@@ -87,6 +87,48 @@ def test_ledger_validates_and_cites_existing_artifacts():
         )
 
 
+def test_elastic_resume_event_kinds_pinned(tmp_path):
+    """The elastic-resume vocabulary (ISSUE 10): ``resume.reshard`` and
+    ``fault.ckpt_retry`` are KNOWN kinds with required-field enforcement —
+    a reshard event missing its old/new mesh (or a retry event missing its
+    attempt/delay) fails validation instead of silently confusing
+    obs_report/obs_diff."""
+    from perceiver_io_tpu.obs.events import (
+        _REQUIRED_FIELDS,
+        EVENT_SCHEMA_VERSION,
+        KNOWN_EVENT_KINDS,
+        validate_events,
+    )
+
+    assert "resume.reshard" in KNOWN_EVENT_KINDS
+    assert "fault.ckpt_retry" in KNOWN_EVENT_KINDS
+    assert set(_REQUIRED_FIELDS["resume.reshard"]) == {"old_mesh", "new_mesh", "step"}
+    assert set(_REQUIRED_FIELDS["fault.ckpt_retry"]) == {"attempt", "delay_s"}
+
+    def write_stream(rows):
+        path = tmp_path / "events.jsonl"
+        with open(path, "w") as f:
+            for row in rows:
+                f.write(json.dumps({"ts": 1.0, "schema_version": EVENT_SCHEMA_VERSION, **row}) + "\n")
+        return str(path)
+
+    good = write_stream(
+        [
+            {"event": "resume.reshard", "step": 5, "old_mesh": {"data": 2, "fsdp": 4},
+             "new_mesh": {"data": 2, "fsdp": 2}, "leaves_resharded": 6, "bytes_moved": 400},
+            {"event": "fault.ckpt_retry", "attempt": 0, "delay_s": 0.2, "op": "save"},
+        ]
+    )
+    assert validate_events(good, strict_spans=False) == []
+    # missing required fields fail loudly, and neither kind warns as unknown
+    bad = write_stream([{"event": "resume.reshard", "step": 5}, {"event": "fault.ckpt_retry"}])
+    warnings_out = []
+    problems = validate_events(bad, strict_spans=False, warnings_out=warnings_out)
+    assert any("old_mesh" in p for p in problems) and any("new_mesh" in p for p in problems)
+    assert any("attempt" in p for p in problems) and any("delay_s" in p for p in problems)
+    assert warnings_out == []
+
+
 def test_smoke_fit_event_stream_validates(tmp_path):
     """The event stream a real (tiny) fit writes must pass validate_events —
     the runtime analog of the BENCH_* pins above: silent schema drift in

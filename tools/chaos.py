@@ -27,6 +27,25 @@ the gate. Scenarios:
                       ``restore`` falls back to the previous good step and
                       never selects the torn one.
 
+Elastic-resume scenarios (docs/robustness.md#elastic-resume) — the pod
+comes back with a DIFFERENT shape. Each runs its kill and resume halves in
+separate subprocesses with different virtual-device counts (the only honest
+way to change topology), sharing the checkpoint dir; the combined loss
+trajectory must match the uninterrupted reference <= 1e-6, the restore must
+emit a span-attributed ``resume.reshard`` event with the right old/new
+meshes, and the resumed train step must lint clean on the new mesh:
+
+- ``elastic_shrink`` — kill under {data:2, fsdp:4} on 8 devices, resume
+                       under {data:2, fsdp:2} on 4 (preempted pod-slice
+                       downsize).
+- ``elastic_grow``   — kill under {data:2, fsdp:2} on 4, resume under
+                       {data:2, fsdp:4} on 8 (mid-run scale-up).
+- ``flat_to_mesh``   — kill unsharded on 1 device, resume under
+                       {data:2, fsdp:2} on 4 (single-host prototype moved
+                       onto a pod).
+- ``mesh_to_flat``   — kill under {data:2, fsdp:2} on 4, resume unsharded
+                       on 1 (pod gone; limp home on one chip).
+
 Every injection is count-/step-deterministic (no wall-clock, no randomness
 outside seeded generators), so failures reproduce exactly.
 """
@@ -98,13 +117,13 @@ def _batches(seed=0, batch_size=8, poison_at=()):
 def _make_trainer(run_dir, max_steps, mesh=None, sentinel=False, **cfg_kw):
     from perceiver_io_tpu.training import MetricsLogger, Trainer, TrainerConfig
 
+    cfg_kw.setdefault("graphlint", False)
     config = TrainerConfig(
         max_steps=max_steps,
         log_interval=1,
         checkpoint_dir=os.path.join(run_dir, "ckpt"),
         prefetch_batches=0,
         input_double_buffer=False,
-        graphlint=False,
         sentinel=sentinel,
         # sentinel scenarios run PROBED: a trip must produce a span-
         # attributed blast-radius report naming the planted scope
@@ -159,7 +178,7 @@ def _assert_span_attributed(run_dir):
     audited = [
         r for r in rows
         if r.get("event", "").startswith("fault.")
-        or r.get("event") in ("resume", "probe.blast")
+        or r.get("event") in ("resume", "resume.reshard", "probe.blast")
     ]
     for r in audited:
         assert r.get("span_id") in span_ids, (
@@ -383,6 +402,157 @@ def scenario_torn_save(tmp):
     print("chaos: torn_save ok — mutilated step 2 quarantined, restore fell back to step 1")
 
 
+# ---------------------------------------------------------------------------
+# elastic resume: kill under one mesh/device-count, resume under another
+# ---------------------------------------------------------------------------
+
+# tag -> (kill mesh shape or None=flat, kill devices, resume shape, resume devices)
+ELASTIC_SCENARIOS = {
+    "elastic_shrink": (dict(data=2, fsdp=4), 8, dict(data=2, fsdp=2), 4),
+    "elastic_grow": (dict(data=2, fsdp=2), 4, dict(data=2, fsdp=4), 8),
+    "flat_to_mesh": (None, 1, dict(data=2, fsdp=2), 4),
+    "mesh_to_flat": (dict(data=2, fsdp=2), 4, None, 1),
+}
+
+
+def _mesh_or_none(shape):
+    if shape is None:
+        return None
+    import jax
+
+    from perceiver_io_tpu.parallel import make_mesh
+
+    need = 1
+    for v in shape.values():
+        need *= v
+    assert len(jax.devices()) >= need, (
+        f"mesh {shape} needs {need} devices, have {len(jax.devices())} (respawn failed?)"
+    )
+    return make_mesh(devices=jax.devices()[:need], **shape)
+
+
+def _mesh_desc(mesh_axes):
+    """Non-trivial axes of a fingerprint mesh dict ({} for flat/None)."""
+    return {k: v for k, v in (mesh_axes or {}).items() if int(v) > 1}
+
+
+def _elastic(tmp, tag, phase):
+    """One mesh-elastic kill/resume cycle. ``phase=None`` orchestrates: the
+    kill half (reference run + SIGTERM-at-step-5 run, both under the OLD
+    mesh) and the resume half (``resume="auto"`` under the NEW mesh) each
+    run in their own subprocess with that mesh's device count — a real
+    topology change, not a same-process mesh swap. The resume phase does
+    the asserting: combined trajectory == reference <= 1e-6, a
+    span-attributed ``resume.reshard`` with the right old/new meshes, and
+    a clean graphlint/graphcheck verdict on the resumed step."""
+    kill_shape, kill_devices, resume_shape, resume_devices = ELASTIC_SCENARIOS[tag]
+    n_steps, kill_at = 12, 5
+    base = os.path.join(tmp, tag)
+
+    if phase == "kill":
+        mesh = _mesh_or_none(kill_shape)
+        # uninterrupted reference under the ORIGINAL mesh — the trajectory
+        # the kill+resume cycle must reproduce
+        tr = _make_trainer(os.path.join(base, "ref"), n_steps, mesh=mesh)
+        ref = _record_losses(tr)
+        tr.fit(_fresh_state(), _batches())
+        tr.close()
+
+        t1 = _make_trainer(os.path.join(base, "run"), n_steps, mesh=mesh)
+
+        def kill(trainer, state, metrics):
+            if int(state.step) == kill_at:
+                os.kill(os.getpid(), signal.SIGTERM)
+
+        part1 = _record_losses(t1, hook=kill)
+        out1 = t1.fit(_fresh_state(), _batches())
+        t1.close()
+        assert int(out1.step) == kill_at, f"{tag}: stopped at {int(out1.step)}, not {kill_at}"
+        assert _events(os.path.join(base, "run"), "fault.preempt"), "no fault.preempt event"
+        with open(os.path.join(base, "phase1.json"), "w") as f:
+            json.dump({"ref": ref, "part1": part1}, f)
+        return
+
+    if phase == "resume":
+        mesh = _mesh_or_none(resume_shape)
+        with open(os.path.join(base, "phase1.json")) as f:
+            d = json.load(f)
+        run_dir = os.path.join(base, "run")
+        # graphlint ON: the resumed step must lint clean ON THE NEW MESH
+        t2 = _make_trainer(run_dir, n_steps, mesh=mesh, graphlint=True)
+        part2 = _record_losses(t2)
+        out2 = t2.fit(_fresh_state(), _batches(), resume="auto")
+        t2.close()
+        assert int(out2.step) == n_steps
+        worst = _assert_trajectories_match(d["ref"], d["part1"] + part2, tag)
+
+        ev = _events(run_dir, "resume")
+        assert ev and ev[-1]["to_step"] == kill_at, ev
+        assert ev[-1]["fast_forward_batches"] == kill_at, ev
+        rr = _events(run_dir, "resume.reshard")
+        assert rr, f"{tag}: no resume.reshard event despite a mesh change"
+        r = rr[-1]
+        assert r["step"] == kill_at, r
+        assert _mesh_desc(r["old_mesh"]) == (kill_shape or {}), (
+            f"{tag}: reshard old_mesh {r['old_mesh']} != killed mesh {kill_shape}"
+        )
+        assert _mesh_desc(r["new_mesh"]) == (resume_shape or {}), (
+            f"{tag}: reshard new_mesh {r['new_mesh']} != resume mesh {resume_shape}"
+        )
+        assert r.get("leaves_resharded", 0) > 0 and r.get("bytes_moved", 0) > 0, r
+        gl = _events(run_dir, "graphlint")
+        assert gl and gl[-1].get("ok") is True and "error" not in gl[-1], (
+            f"{tag}: resumed step failed graphlint on the new mesh: {gl}"
+        )
+        gc = _events(run_dir, "graphcheck")
+        assert gc and "error" not in gc[-1], (
+            f"{tag}: resumed step failed graphcheck fingerprinting: {gc}"
+        )
+        n_attr = _assert_span_attributed(run_dir)
+        with open(os.path.join(base, "result.json"), "w") as f:
+            json.dump(
+                {"worst": worst, "reshard": r, "span_attributed": n_attr}, f
+            )
+        print(
+            f"chaos: {tag} resume phase ok — mesh {_mesh_desc(r['old_mesh']) or 'flat'}"
+            f" -> {_mesh_desc(r['new_mesh']) or 'flat'}, "
+            f"{r['leaves_resharded']} leaves / {r['bytes_moved']}B resharded in "
+            f"{r['wall_s']:.3f}s, trajectory worst {worst:.1e}, "
+            f"{n_attr} events span-attributed, graphlint clean"
+        )
+        return
+
+    # orchestrator: two subprocesses, two topologies, one checkpoint dir
+    os.makedirs(base, exist_ok=True)
+    rc = _respawn([tag], n_devices=kill_devices, phase="kill", tmp=tmp)
+    assert rc == 0, f"{tag}: kill phase failed (rc={rc})"
+    rc = _respawn([tag], n_devices=resume_devices, phase="resume", tmp=tmp)
+    assert rc == 0, f"{tag}: resume phase failed (rc={rc})"
+    with open(os.path.join(base, "result.json")) as f:
+        result = json.load(f)
+    print(
+        f"chaos: {tag} ok — killed at step {kill_at} on {kill_devices} device(s), "
+        f"resumed on {resume_devices}, {len(result['reshard'])}-field reshard event, "
+        f"12 losses match <= {TOL:g} (worst {result['worst']:.1e})"
+    )
+
+
+def scenario_elastic_shrink(tmp, phase=None):
+    _elastic(tmp, "elastic_shrink", phase)
+
+
+def scenario_elastic_grow(tmp, phase=None):
+    _elastic(tmp, "elastic_grow", phase)
+
+
+def scenario_flat_to_mesh(tmp, phase=None):
+    _elastic(tmp, "flat_to_mesh", phase)
+
+
+def scenario_mesh_to_flat(tmp, phase=None):
+    _elastic(tmp, "mesh_to_flat", phase)
+
+
 SCENARIOS = {
     "preempt": scenario_preempt,
     "preempt_mesh": scenario_preempt_mesh,
@@ -390,30 +560,41 @@ SCENARIOS = {
     "nan_skip": scenario_nan_skip,
     "nan_rollback": scenario_nan_rollback,
     "torn_save": scenario_torn_save,
+    "elastic_shrink": scenario_elastic_shrink,
+    "elastic_grow": scenario_elastic_grow,
+    "flat_to_mesh": scenario_flat_to_mesh,
+    "mesh_to_flat": scenario_mesh_to_flat,
 }
 
 
-def _respawn_for_mesh(scenarios) -> int:
-    """Re-exec the mesh scenarios in a subprocess with 8 virtual CPU devices
-    (same bootstrap contract as __graft_entry__._respawn_with_virtual_devices:
-    set XLA_FLAGS before any device query, force the platform via
-    jax.config)."""
+def _respawn(scenarios, n_devices=8, phase=None, tmp=None) -> int:
+    """Re-exec scenarios in a subprocess with ``n_devices`` virtual CPU
+    devices (same bootstrap contract as
+    __graft_entry__._respawn_with_virtual_devices: set XLA_FLAGS before any
+    device query, force the platform via jax.config). ``phase``/``tmp``
+    pass through to the child's argv — the elastic scenarios use this to
+    run their kill and resume halves on DIFFERENT topologies over one
+    shared scratch dir."""
     import subprocess
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    argv = ["chaos.py", "--scenarios", ",".join(scenarios)]
+    if phase:
+        argv += ["--phase", phase]
+    if tmp:
+        argv += ["--tmp", tmp]
     bootstrap = (
         "import jax\n"
         "jax.config.update('jax_platforms', 'cpu')\n"
         f"import sys; sys.path.insert(0, {repo!r})\n"
-        "import runpy; sys.argv = ['chaos.py', '--scenarios', "
-        f"{','.join(scenarios)!r}]\n"
+        f"import runpy; sys.argv = {argv!r}\n"
         f"runpy.run_path({os.path.abspath(__file__)!r}, run_name='__main__')\n"
     )
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)
     env["_CHAOS_RESPAWNED"] = "1"
     flags = re.sub(r"--xla_force_host_platform_device_count=\S+", "", env.get("XLA_FLAGS", ""))
-    env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    env["XLA_FLAGS"] = (flags + f" --xla_force_host_platform_device_count={n_devices}").strip()
     proc = subprocess.run([sys.executable, "-c", bootstrap], cwd=repo, env=env, timeout=540)
     return proc.returncode
 
@@ -426,11 +607,20 @@ def main(argv=None) -> int:
         help=f"comma-separated subset of: {', '.join(SCENARIOS)}",
     )
     parser.add_argument("--tmp", default=None, help="scratch dir (default: mkdtemp)")
+    parser.add_argument(
+        "--phase",
+        default=None,
+        choices=("kill", "resume"),
+        help="internal: run one half of an elastic scenario (the orchestrator "
+        "respawns each half with its own virtual-device count)",
+    )
     args = parser.parse_args(argv)
     wanted = [s for s in args.scenarios.split(",") if s]
     unknown = [s for s in wanted if s not in SCENARIOS]
     if unknown:
         parser.error(f"unknown scenarios: {unknown}")
+    if args.phase and any(s not in ELASTIC_SCENARIOS for s in wanted):
+        parser.error("--phase applies only to the elastic scenarios")
 
     import jax
 
@@ -442,9 +632,10 @@ def main(argv=None) -> int:
         and not os.environ.get("_CHAOS_RESPAWNED")
     ):
         # mesh case needs 8 devices: run it in a virtual-device subprocess,
-        # everything else in this process
+        # everything else in this process (the elastic scenarios manage
+        # their OWN per-phase subprocesses and never need a parent respawn)
         run_local.remove("preempt_mesh")
-        rc = _respawn_for_mesh(["preempt_mesh"])
+        rc = _respawn(["preempt_mesh"])
         if rc != 0:
             print("chaos: preempt_mesh FAILED (respawned subprocess)", file=sys.stderr)
 
@@ -452,8 +643,11 @@ def main(argv=None) -> int:
 
     tmp = args.tmp or tempfile.mkdtemp(prefix="chaos_")
     for name in run_local:
-        SCENARIOS[name](tmp)
-    if rc == 0:
+        if name in ELASTIC_SCENARIOS:
+            SCENARIOS[name](tmp, phase=args.phase)
+        else:
+            SCENARIOS[name](tmp)
+    if rc == 0 and not args.phase:
         print(f"chaos: all {len(wanted)} scenario(s) passed")
     return rc
 
