@@ -704,6 +704,11 @@ class GenerationStats:
     tpot_p50_s: Optional[float] = None  # histogram-derived decode percentiles
     tpot_p90_s: Optional[float] = None
     tpot_p99_s: Optional[float] = None
+    # --- Loadline (PR 11) admission telemetry ---------------------------
+    # time the request sat queued before the worker picked it up (measured
+    # by the caller — obs/loadgen.py — and handed in per call); None when
+    # the caller did no admission accounting
+    queue_wait_s: Optional[float] = None
 
 
 def make_instrumented_generate_fn(
@@ -738,6 +743,13 @@ def make_instrumented_generate_fn(
     ``compile`` events, attributed to the request's span): a call that
     compiled reports wall times including the compile and says so in
     ``stats.compiled``.
+
+    Admission telemetry (the Loadline seam, obs/loadgen.py): callers that
+    do their own queueing pass ``fn(..., queue_wait_s=..., arrival_ts=...)``
+    per request — queue wait lands on the ``request`` event, the request
+    span and the ``generate_queue_wait_s`` registry histogram, so the
+    per-request tail breakdown (``obs.slo.request_breakdowns``) can
+    attribute a slow request to queueing vs prefill vs decode vs compile.
 
     ``registry`` (an ``obs.metrics.MetricsRegistry``; fresh one per fn when
     None) accumulates cross-request counters/histograms and snapshots into
@@ -779,11 +791,15 @@ def make_instrumented_generate_fn(
     # compile included, flagged by `compiled` — consumers exclude it.
     m_ttft = registry.histogram("generate_ttft_s")
     m_tpot = registry.histogram("generate_tpot_s")
+    # queue wait is admission telemetry, not compute latency: recorded for
+    # every request that carries one (a compile stall upstream genuinely
+    # grows the queue — excluding cold requests would hide real backlog)
+    m_queue = registry.histogram("generate_queue_wait_s")
     m_entropy = registry.histogram("generate_logit_entropy") if probes else None
     m_kv_frac = registry.gauge("generate_kv_cache_frac") if probes else None
     tracer = obs_trace.Tracer(events, flush_every=64) if events is not None else None
 
-    def fn(params, input_ids, pad_mask=None, rng=None):
+    def fn(params, input_ids, pad_mask=None, rng=None, queue_wait_s=None, arrival_ts=None):
         b, prompt_len = input_ids.shape
         compiles_before = tracker.total_compiles
         request_id = obs_trace.new_span_id()
@@ -792,6 +808,9 @@ def make_instrumented_generate_fn(
         healths = []  # device-array health dicts; fetched once, after the loop
         outcome, err = "ok", None
         ttft = 0.0
+        if queue_wait_s is not None:
+            queue_wait_s = float(queue_wait_s)
+            m_queue.record(queue_wait_s)
         span_cm = (
             tracer.span("request", request_id=request_id)
             if tracer is not None
@@ -834,6 +853,8 @@ def make_instrumented_generate_fn(
             if sp is not None:
                 sp.set("outcome", outcome)
                 sp.set("tokens_out", len(toks))
+                if queue_wait_s is not None:
+                    sp.set("queue_wait_s", round(queue_wait_s, 6))
         elapsed = time.perf_counter() - t_all0
         decode_s = max(elapsed - ttft, 0.0)
         tokens_out = len(toks)
@@ -879,6 +900,7 @@ def make_instrumented_generate_fn(
             tpot_p50_s=hist.percentile(50),
             tpot_p90_s=hist.percentile(90),
             tpot_p99_s=hist.percentile(99),
+            queue_wait_s=None if queue_wait_s is None else round(queue_wait_s, 6),
         )
         m_requests.inc()
         m_tokens.inc(tokens_out * b)
@@ -900,16 +922,23 @@ def make_instrumented_generate_fn(
             )
             if health_row is not None:
                 row.update(health_row)
+            if queue_wait_s is None:
+                row.pop("queue_wait_s", None)  # no admission accounting upstream
+            elif arrival_ts is not None:
+                row["arrival_ts"] = round(float(arrival_ts), 6)
             if hist.n and hist.n < 5:
                 row["tpot_low_n"] = True
             if err is not None:
                 row["error"] = repr(err)
             if row.get("span_id") is None:
                 row.pop("span_id", None)  # let the ambient span stamp it
-            events.emit("request", **row)
-            registry.maybe_emit(events, min_interval_s=snapshot_interval_s)
+            # spans BEFORE the request row: a flight recorder triggering on
+            # this request dumps its ring synchronously, and the ring must
+            # already hold THIS request's span — the one the dump names
             if tracer is not None:
                 tracer.flush()
+            events.emit("request", **row)
+            registry.maybe_emit(events, min_interval_s=snapshot_interval_s)
         if err is not None:
             raise err
         out = jnp.concatenate([input_ids] + [t[:, None] for t in toks], axis=1)

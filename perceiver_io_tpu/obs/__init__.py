@@ -17,8 +17,14 @@ exporters; the benches report analytic MFU against a per-device peak-FLOPs
 table; traces captured with ``utils.profiling.trace`` aggregate by
 ``jax.named_scope`` module instead of raw HLO op names (``obs.xplane``);
 and silent shape-driven recompiles surface as ``compile`` events
-(``obs.recompile``). Render a run directory with ``tools/obs_report.py``;
-diff two runs with ``tools/obs_diff.py``.
+(``obs.recompile``). The serving-observability layer (ISSUE 11) rides on
+top: ``obs.loadgen`` drives seeded closed/open-loop synthetic load through
+the instrumented path (queue-wait accounted per request), ``obs.flightrec``
+keeps a bounded ring of recent telemetry and dumps it atomically on SLO
+breach / error / sentinel trip / SIGUSR1, and ``obs.server`` exposes
+``/metrics`` + ``/healthz`` + ``/slo`` from a stdlib HTTP thread. Render a
+run directory with ``tools/obs_report.py``; diff two runs with
+``tools/obs_diff.py``; drive and gate load with ``tools/loadgen.py``.
 """
 
 from perceiver_io_tpu.obs.events import (  # noqa: F401
@@ -51,8 +57,23 @@ from perceiver_io_tpu.obs.mfu import (  # noqa: F401
     clm_train_telemetry,
     device_peak_flops,
 )
+from perceiver_io_tpu.obs.flightrec import FlightRecorder, SLOBounds  # noqa: F401
+from perceiver_io_tpu.obs.loadgen import (  # noqa: F401
+    LoadReport,
+    WorkloadSpec,
+    arrival_schedule,
+    build_load_doc,
+    diff_load,
+    run_load,
+    summarize_load,
+)
 from perceiver_io_tpu.obs.recompile import RecompileTracker, shape_signature  # noqa: F401
-from perceiver_io_tpu.obs.slo import build_slo_report, write_slo_report  # noqa: F401
+from perceiver_io_tpu.obs.server import ObsServer  # noqa: F401
+from perceiver_io_tpu.obs.slo import (  # noqa: F401
+    build_slo_report,
+    request_breakdowns,
+    write_slo_report,
+)
 from perceiver_io_tpu.obs.trace import (  # noqa: F401
     Span,
     Tracer,
@@ -87,7 +108,18 @@ __all__ = [
     "RecompileTracker",
     "shape_signature",
     "build_slo_report",
+    "request_breakdowns",
     "write_slo_report",
+    "FlightRecorder",
+    "SLOBounds",
+    "LoadReport",
+    "WorkloadSpec",
+    "arrival_schedule",
+    "build_load_doc",
+    "diff_load",
+    "run_load",
+    "summarize_load",
+    "ObsServer",
     "Span",
     "Tracer",
     "current_span",

@@ -13,9 +13,13 @@ family (``fault.preempt`` / ``fault.skip`` / ``fault.spike`` /
 ``fault.fetch_retry`` / ``fault.ckpt_retry`` — the fault-handling audit
 trail, training/faults.py, docs/robustness.md) / ``fit_end`` events through
 one :class:`EventLog`; instrumented generation emits per-request
-``request`` rows (obs/slo.py aggregates them) and ``metrics`` registry
-snapshots (obs/metrics.py); probed runs add ``probe`` numerics snapshots
-and ``probe.blast`` blast-radius reports (obs/probes.py).
+``request`` rows (obs/slo.py aggregates them; under a load generator each
+row also carries ``queue_wait_s``/``arrival_ts`` admission telemetry) and
+``metrics`` registry snapshots (obs/metrics.py); probed runs add ``probe``
+numerics snapshots and ``probe.blast`` blast-radius reports
+(obs/probes.py); load-generated runs close with a ``load.summary`` row
+(obs/loadgen.py) and flight-recorder dumps announce themselves as
+``flight.dump`` rows naming the triggering span (obs/flightrec.py).
 ``tools/obs_report.py`` renders a run directory back into a summary
 table; ``tools/obs_diff.py`` diffs two runs.
 
@@ -340,6 +344,14 @@ _REQUIRED_FIELDS: Dict[str, tuple] = {
     # boundaries, and the blast-radius attribution a sentinel trip dumps
     "probe": ("step", "scopes"),
     "probe.blast": ("trigger", "scope", "step", "affected"),
+    # Loadline (obs/loadgen.py): one summary row per load-generator run —
+    # the artifact body's load-bearing fields; queue_wait_s/arrival_ts ride
+    # the per-request `request` rows (optional — only loadgen-issued
+    # requests carry admission telemetry)
+    "load.summary": ("mode", "n_requests", "achieved_rps"),
+    # flight recorder (obs/flightrec.py): a dump fired — the post-mortem
+    # entry point must name what tripped it and which span to start from
+    "flight.dump": ("trigger", "path", "n_events", "trigger_span_id"),
 }
 
 # the full vocabulary THIS version of the library emits. validate_events

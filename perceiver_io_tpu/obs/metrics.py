@@ -148,29 +148,42 @@ class Histogram:
             self.min = v if self.min is None else min(self.min, v)
             self.max = v if self.max is None else max(self.max, v)
 
+    def state(self) -> tuple:
+        """Consistent ``(counts copy, n, sum, min, max)`` under the lock —
+        the read side for exporters living on OTHER threads (a scrape
+        server iterating ``counts`` while the serving thread records would
+        see a dict mutating under it)."""
+        with self._lock:
+            return dict(self.counts), self.n, self.sum, self.min, self.max
+
     def percentile(self, p: float) -> Optional[float]:
         """Bucket-midpoint percentile, clamped into the observed [min, max]
         (a one-sample histogram reports the sample, not its bucket's
         midpoint)."""
-        out = percentile_from_counts(self.counts, p)
+        counts, _, _, mn, mx = self.state()
+        out = percentile_from_counts(counts, p)
         if out is None:
             return None
-        if self.min is not None:
-            out = min(max(out, self.min), self.max)
+        if mn is not None:
+            out = min(max(out, mn), mx)
         return out
 
     def to_dict(self) -> Dict:
+        counts, n, total, mn, mx = self.state()
         d = {
-            "n": self.n,
-            "sum": round(self.sum, 9),
-            "min": self.min,
-            "max": self.max,
-            "counts": {str(k): v for k, v in sorted(self.counts.items())},
+            "n": n,
+            "sum": round(total, 9),
+            "min": mn,
+            "max": mx,
+            "counts": {str(k): v for k, v in sorted(counts.items())},
         }
-        if self.n:
+        if n:
             for p in (50, 90, 99):
-                d[f"p{p}"] = self.percentile(p)
-            if self.n < 5:
+                out = percentile_from_counts(counts, p)
+                if mn is not None:
+                    out = min(max(out, mn), mx)
+                d[f"p{p}"] = out
+            if n < 5:
                 # the low-sample convention shared with StepTimer.summary:
                 # a 3-sample p99 is an order statistic, not a tail estimate
                 d["low_n"] = True
@@ -263,14 +276,18 @@ class MetricsRegistry:
                 lines.append(f"{pname} {m.value:g}")
             elif isinstance(m, Histogram):
                 lines.append(f"# TYPE {pname} histogram")
+                # consistent locked snapshot: a scrape thread must never
+                # iterate counts while the serving thread inserts a bucket
+                # (dict-changed-size), nor expose cumulative > _count
+                counts, n, total, _, _ = m.state()
                 cum = 0
-                for idx in sorted(m.counts):
-                    cum += m.counts[idx]
+                for idx in sorted(counts):
+                    cum += counts[idx]
                     le = bucket_bounds(idx)[1]
                     lines.append(f'{pname}_bucket{{le="{le:g}"}} {cum}')
-                lines.append(f'{pname}_bucket{{le="+Inf"}} {m.n}')
-                lines.append(f"{pname}_sum {m.sum:g}")
-                lines.append(f"{pname}_count {m.n}")
+                lines.append(f'{pname}_bucket{{le="+Inf"}} {n}')
+                lines.append(f"{pname}_sum {total:g}")
+                lines.append(f"{pname}_count {n}")
         return "\n".join(lines) + ("\n" if lines else "")
 
 

@@ -83,7 +83,95 @@ def build_slo_report(events: List[Dict]) -> Optional[Dict]:
         tps = [float(r["tokens_per_sec"]) for r in latency_pool if r.get("tokens_per_sec")]
         if tps:
             report["tokens_per_sec_mean"] = round(sum(tps) / len(tps), 3)
+        # admission telemetry (loadgen-issued requests only): queue-wait
+        # percentiles are exact order statistics like TTFT
+        qws = [
+            float(r["queue_wait_s"]) for r in latency_pool
+            if r.get("queue_wait_s") is not None
+        ]
+        if qws:
+            report["queue_wait_s"] = {
+                k: round(v, 6) if isinstance(v, float) else v
+                for k, v in summarize_latencies(qws).items()
+            }
     return report
+
+
+def _median(vals: List[float]) -> Optional[float]:
+    if not vals:
+        return None
+    s = sorted(vals)
+    n = len(s)
+    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
+def request_breakdowns(events: List[Dict]) -> Optional[Dict]:
+    """Per-request **tail attribution**: queue-wait → prefill → decode →
+    compile-if-cold, joined from the event stream (``request`` rows carry
+    queue-wait/TTFT/decode; ``compile`` events are stamped with the span of
+    the request that paid them, so the join is exact, not positional;
+    ``span`` rows supply the end-to-end wall). The shape a p99 post-mortem
+    needs: *which stage* ate the slow request, not just that it was slow.
+
+    Returns ``{n, requests: [per-request rows], medians}`` (None when the
+    stream has no requests); medians are over warm ok requests
+    (``warm_only`` flags the all-cold fallback), the convention every other
+    SLO surface uses. Canonical for ``tools/obs_report.py``'s breakdown
+    section and ``tools/loadgen.py``'s artifact."""
+    requests = iter_requests(events)
+    if not requests:
+        return None
+    spans = {
+        e.get("span_id"): e for e in events if e.get("event") == "span"
+    }
+    compile_s: Dict[str, float] = {}
+    for e in events:
+        if e.get("event") == "compile" and e.get("span_id") is not None:
+            compile_s[e["span_id"]] = compile_s.get(e["span_id"], 0.0) + float(
+                e.get("wall_s", 0.0)
+            )
+    rows: List[Dict] = []
+    for r in requests:
+        sid = r.get("span_id")
+        span = spans.get(sid)
+        ttft = r.get("ttft_s")
+        decode = r.get("decode_s")
+        qw = r.get("queue_wait_s")
+        # service = in-worker wall (the request span: prefill + decode +
+        # compile-if-cold); total = queue wait + service — the latency the
+        # CALLER saw, which is what a p99 breach is measured against
+        service_ms = (
+            float(span["dur_ms"])
+            if span is not None and span.get("dur_ms") is not None
+            else 1e3 * (float(ttft or 0.0) + float(decode or 0.0))
+        )
+        row = {
+            "request_id": r.get("request_id"),
+            "span_id": sid,
+            "outcome": r.get("outcome", "ok"),
+            "compiled": bool(r.get("compiled")),
+            "queue_wait_ms": None if qw is None else round(1e3 * float(qw), 3),
+            "prefill_ms": None if ttft is None else round(1e3 * float(ttft), 3),
+            "decode_ms": None if decode is None else round(1e3 * float(decode), 3),
+            "compile_ms": round(1e3 * compile_s.get(sid, 0.0), 3),
+            "service_ms": round(service_ms, 3),
+            "total_ms": round(1e3 * float(qw or 0.0) + service_ms, 3),
+        }
+        rows.append(row)
+    ok = [r for r in rows if r["outcome"] == "ok"]
+    warm = [r for r in ok if not r["compiled"]]
+    pool, warm_only = (warm, True) if warm else (ok, False)
+    medians = {}
+    for key in ("queue_wait_ms", "prefill_ms", "decode_ms", "service_ms", "total_ms"):
+        med = _median([float(r[key]) for r in pool if r.get(key) is not None])
+        if med is not None:
+            medians[key] = round(med, 3)
+    cold_compile = _median(
+        [float(r["compile_ms"]) for r in ok if r["compiled"] and r["compile_ms"]]
+    )
+    if cold_compile is not None:
+        medians["compile_ms_cold"] = round(cold_compile, 3)
+    return {"n": len(rows), "requests": rows, "medians": medians, "warm_only": warm_only}
 
 
 def write_slo_report(run_dir: str, filename: str = "slo_report.json") -> Optional[Dict]:

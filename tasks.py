@@ -12,6 +12,7 @@ equivalent with the same task names:
     python tasks.py graphlint [...]    # static-analysis gate (compiled graphs)
     python tasks.py perf [...]         # perf CI: graphcheck contracts + graphlint + bench floors + obs gate
     python tasks.py obs [...]          # observability gate (spans/requests/SLO + obs_diff self-check)
+    python tasks.py load [...]         # serving load gate (closed-loop loadgen + flight recorder + /metrics)
     python tasks.py dryrun [...]       # 8-virtual-device multichip certification
     python tasks.py chaos [...]        # fault-injection gate (preempt/NaN/torn-save/elastic resume)
 """
@@ -165,6 +166,19 @@ def obs(args):
 
 
 @task
+def load(args):
+    """Serving-observability gate (tools/loadgen.py; docs/observability.md#
+    serving-observability-loadline): a 200-request closed-loop load run
+    through the instrumented decode path with the flight recorder and the
+    /metrics///slo scrape server live — validates the event stream, asserts
+    a planted SLO breach produces exactly one flight dump naming the
+    breaching span, run-vs-itself comparability diff must be clean, and the
+    ledger's LOAD_r*.json floors must hold. Extra args pass through (e.g.
+    ``--smoke``, ``--write-artifact``, ``--mode open --rate 20``)."""
+    run(sys.executable, "tools/loadgen.py", *args.rest)
+
+
+@task
 def perf(args):
     """The standing perf-CI gate (docs/static-analysis.md): graphcheck —
     compiled-graph contracts vs contracts/, graduation-ledger validation,
@@ -174,8 +188,10 @@ def perf(args):
     observability gate — the RUNTIME leg: with ``OBS_BASELINE_RUN`` set to
     a recorded baseline run directory (``tasks.py obs --out DIR --keep``),
     obs_diff classifies MFU/goodput/step-p99/SLO drift against it under
-    declared tolerances (stale = not comparable ≠ regression). Extra args
-    go to tools/graphcheck.py (e.g. ``--programs train_flat,decode``)."""
+    declared tolerances (stale = not comparable ≠ regression) — and
+    finally the serving-load smoke gate (``tools/loadgen.py --smoke``:
+    closed-loop load telemetry + flight recorder + LOAD floors). Extra
+    args go to tools/graphcheck.py (e.g. ``--programs train_flat,decode``)."""
     run(sys.executable, "tools/graphcheck.py", *args.rest)
     run(sys.executable, "tools/graphlint.py", "--fail-on", "error")
     # trace-only on purpose: graphcheck just compiled the same five
@@ -187,6 +203,10 @@ def perf(args):
     if baseline:
         obs_cmd += ["--baseline", baseline]
     run(*obs_cmd)
+    # serving-load leg (CI-fast): a small closed-loop run through the
+    # instrumented path — events validate, planted breach -> one flight
+    # dump, run-vs-itself diff clean, LOAD_r* ledger floors hold
+    run(sys.executable, "tools/loadgen.py", "--smoke")
 
 
 def main(argv=None):
@@ -195,7 +215,10 @@ def main(argv=None):
     parser.add_argument("--cov", action="store_true", help="coverage (test)")
     parser.add_argument("--tag", help="docker image tag")
     parser.add_argument("rest", nargs="*", help="extra args passed through")
-    args = parser.parse_args(argv)
+    # unknown flags flow through to the task's tool (`tasks.py load --smoke`,
+    # `tasks.py chaos --scenarios preempt`) instead of dying in argparse
+    args, unknown = parser.parse_known_args(argv)
+    args.rest = args.rest + unknown
     TASKS[args.task](args)
 
 

@@ -129,6 +129,90 @@ def test_elastic_resume_event_kinds_pinned(tmp_path):
     assert warnings_out == []
 
 
+def test_serving_observability_event_kinds_pinned(tmp_path):
+    """The Loadline vocabulary (ISSUE 11): ``load.summary`` and
+    ``flight.dump`` are KNOWN kinds with required-field enforcement — a
+    summary missing its achieved rate, or a dump event that doesn't name
+    the triggering span, fails validation instead of silently confusing
+    obs_report/obs_diff/the post-mortem reader. Queue-wait fields ride the
+    (already-required) ``request`` rows as optional admission telemetry."""
+    from perceiver_io_tpu.obs.events import (
+        _REQUIRED_FIELDS,
+        EVENT_SCHEMA_VERSION,
+        KNOWN_EVENT_KINDS,
+        validate_events,
+    )
+
+    assert "load.summary" in KNOWN_EVENT_KINDS
+    assert "flight.dump" in KNOWN_EVENT_KINDS
+    assert set(_REQUIRED_FIELDS["load.summary"]) == {"mode", "n_requests", "achieved_rps"}
+    assert set(_REQUIRED_FIELDS["flight.dump"]) == {
+        "trigger", "path", "n_events", "trigger_span_id",
+    }
+    # queue-wait is NOT required on request rows: only loadgen-issued
+    # requests carry admission telemetry
+    assert "queue_wait_s" not in _REQUIRED_FIELDS["request"]
+
+    def write_stream(rows):
+        path = tmp_path / "events.jsonl"
+        with open(path, "w") as f:
+            for row in rows:
+                f.write(json.dumps({"ts": 1.0, "schema_version": EVENT_SCHEMA_VERSION, **row}) + "\n")
+        return str(path)
+
+    good = write_stream(
+        [
+            {"event": "load.summary", "mode": "closed", "n_requests": 200,
+             "achieved_rps": 34.8, "throughput_tok_s": 280.9, "error_rate": 0.0},
+            {"event": "flight.dump", "trigger": "slo_ttft", "path": "flight-slo_ttft-1.json",
+             "n_events": 12, "trigger_span_id": "abc123", "seq": 1},
+        ]
+    )
+    warnings_out = []
+    assert validate_events(good, strict_spans=False, warnings_out=warnings_out) == []
+    assert warnings_out == []  # neither kind warns as unknown
+    bad = write_stream([{"event": "load.summary", "mode": "closed"},
+                        {"event": "flight.dump", "trigger": "error"}])
+    problems = validate_events(bad, strict_spans=False)
+    assert any("achieved_rps" in p for p in problems)
+    assert any("trigger_span_id" in p for p in problems)
+
+
+def test_load_rounds_monotone_and_well_formed():
+    """LOAD_r*.json — the committed serving-load artifacts (ISSUE 11):
+    contiguous round numbering and the machine-read surface the load gate's
+    floors and diff_load parse (keys, types, percentile blocks)."""
+    rounds = _rounds("LOAD_r*.json")
+    assert rounds, "no LOAD_r*.json artifacts committed"
+    assert sorted(rounds) == list(range(1, max(rounds) + 1)), sorted(rounds)
+    for n, path in rounds.items():
+        base = os.path.basename(path)
+        doc = json.load(open(path))
+        assert doc.get("n") == n, f"{base}: field n={doc.get('n')} != filename round {n}"
+        assert isinstance(doc.get("schema_version"), int), base
+        assert doc.get("mode") in ("closed", "open"), base
+        workload = doc.get("workload")
+        assert isinstance(workload, dict) and isinstance(workload.get("spec"), dict), base
+        assert isinstance(doc.get("manifest"), dict), base
+        summary = doc.get("summary")
+        assert isinstance(summary, dict), base
+        for key, typ in (
+            ("n_requests", int), ("achieved_rps", (int, float)),
+            ("throughput_tok_s", (int, float)), ("error_rate", (int, float)),
+            ("ok_rate", (int, float)), ("duration_s", (int, float)),
+        ):
+            assert isinstance(summary.get(key), typ), f"{base}: summary.{key}"
+        for fam in ("ttft_s", "queue_wait_s"):
+            block = summary.get(fam)
+            assert isinstance(block, dict), f"{base}: summary.{fam}"
+            for p in ("p50", "p99"):
+                assert isinstance(block.get(p), (int, float)), f"{base}: summary.{fam}.{p}"
+        assert isinstance(summary.get("breakdown_ms"), dict), base
+        # warm-only percentiles are the committed contract — a cold-only
+        # artifact has no steady state worth diffing
+        assert summary.get("warm_only") is True, base
+
+
 def test_smoke_fit_event_stream_validates(tmp_path):
     """The event stream a real (tiny) fit writes must pass validate_events —
     the runtime analog of the BENCH_* pins above: silent schema drift in

@@ -1,0 +1,519 @@
+"""Serving-observability layer (ISSUE 11, Loadline): the deterministic load
+generator over the instrumented decode path, the flight recorder's
+trigger→dump→``flight.dump``-event contract, the stdlib scrape server, the
+LOAD-artifact diff's comparability-first classification, and the
+per-request queue→prefill→decode→compile tail attribution."""
+
+import json
+import os
+import signal
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from perceiver_io_tpu.obs import EventLog
+from perceiver_io_tpu.obs.events import merged_events, validate_events
+from perceiver_io_tpu.obs.flightrec import FlightRecorder, SLOBounds
+from perceiver_io_tpu.obs.loadgen import (
+    WorkloadSpec,
+    arrival_schedule,
+    build_load_doc,
+    diff_load,
+    format_load_diff,
+    run_load,
+    summarize_load,
+)
+from perceiver_io_tpu.obs.metrics import MetricsRegistry
+from perceiver_io_tpu.obs.slo import build_slo_report, request_breakdowns
+
+
+def tiny_model():
+    from perceiver_io_tpu.models.text import (
+        CausalLanguageModel,
+        CausalLanguageModelConfig,
+    )
+
+    config = CausalLanguageModelConfig(
+        vocab_size=50, max_seq_len=24, max_latents=8, num_channels=32,
+        num_heads=4, num_self_attention_layers=2, cross_attention_dropout=0.5,
+    )
+    model = CausalLanguageModel(config)
+    ids = np.random.default_rng(0).integers(0, 50, size=(1, 12))
+    import jax.numpy as jnp
+
+    params = model.init(jax.random.PRNGKey(0), jnp.asarray(ids), prefix_len=8)
+    return model, params
+
+
+# one compiled geometry for the whole module: prompt_len 10, 4 new tokens
+SPEC = WorkloadSpec(seed=5, prompt_lens=(10,), max_new_tokens=(4,))
+
+
+# ------------------------------------------------------------ workload spec
+
+
+def test_workload_spec_deterministic_and_validated():
+    spec = WorkloadSpec(seed=3, prompt_lens=(8, 12), max_new_tokens=(4, 6), batch=2)
+    a, b = spec.draw(6, 64), spec.draw(6, 64)
+    assert [(r.prompt_len, r.max_new_tokens, r.rng_seed) for r in a] == [
+        (r.prompt_len, r.max_new_tokens, r.rng_seed) for r in b
+    ]
+    assert all((x.input_ids == y.input_ids).all() for x, y in zip(a, b))
+    assert all(r.input_ids.shape == (2, r.prompt_len) for r in a)
+    # prefix-stable: the first n requests do not depend on how many you draw
+    assert [r.rng_seed for r in spec.draw(3, 64)] == [r.rng_seed for r in a[:3]]
+    # a different seed is a different stream
+    assert [r.rng_seed for r in WorkloadSpec(seed=4).draw(3, 64)] != [
+        r.rng_seed for r in WorkloadSpec(seed=3).draw(3, 64)
+    ]
+    with pytest.raises(ValueError):
+        WorkloadSpec(prompt_lens=())
+    with pytest.raises(ValueError):
+        WorkloadSpec(batch=0)
+    round_trip = WorkloadSpec(**{**spec.to_dict(),
+                                 "prompt_lens": tuple(spec.prompt_lens),
+                                 "max_new_tokens": tuple(spec.max_new_tokens)})
+    assert round_trip.to_dict() == spec.to_dict()
+
+
+def test_arrival_schedule_seeded_monotone():
+    a = arrival_schedule(200, rate_rps=50.0, seed=7)
+    assert a == arrival_schedule(200, rate_rps=50.0, seed=7)
+    assert a != arrival_schedule(200, rate_rps=50.0, seed=8)
+    assert all(x < y for x, y in zip(a, a[1:]))  # strictly increasing
+    # mean inter-arrival ~ 1/rate (law of large numbers, loose bound)
+    assert a[-1] / 200 == pytest.approx(1 / 50.0, rel=0.5)
+    with pytest.raises(ValueError):
+        arrival_schedule(5, rate_rps=0.0)
+
+
+# ------------------------------------------------------------- end to end
+
+
+def test_closed_loop_end_to_end(tmp_path):
+    """The acceptance path in miniature: a closed-loop run over the
+    instrumented fns lands queue-wait-stamped request events, a
+    load.summary row, registry histograms, SLO queue-wait percentiles and
+    a renderable per-request breakdown — and the stream validates."""
+    model, params = tiny_model()
+    events = EventLog(str(tmp_path), main_process=True)
+    registry = MetricsRegistry()
+    report = run_load(
+        model, params, SPEC, mode="closed", n_requests=6, concurrency=2,
+        num_latents=4, events=events, registry=registry, snapshot_interval_s=0.0,
+    )
+    assert len(report.records) == 6
+    assert all(r.outcome == "ok" for r in report.records)
+    assert all(r.tokens_out == 4 for r in report.records)
+    # concurrency 2: every request after the first queued behind another
+    assert max(r.queue_wait_s for r in report.records) > 0
+
+    s = report.summary
+    assert s["mode"] == "closed" and s["n_requests"] == 6 and s["error_rate"] == 0.0
+    assert s["achieved_rps"] > 0 and s["throughput_tok_s"] > 0
+    assert {"p50", "p99"} <= set(s["ttft_s"]) and {"p50", "p99"} <= set(s["queue_wait_s"])
+    # 3 decode-step samples per request, minus the one step that compiled
+    # (warm-only by construction — the registry histogram skips it)
+    assert s["tpot_s"]["n"] == 6 * 3 - 1
+    assert {"queue_wait", "prefill", "decode"} <= set(s["breakdown_ms"])
+
+    # the stream: schema-valid, no unknown kinds, queue-wait on every row
+    warnings_out = []
+    assert validate_events(str(tmp_path), warnings_out=warnings_out) == []
+    assert warnings_out == []
+    stream = merged_events(str(tmp_path))
+    reqs = [e for e in stream if e.get("event") == "request"]
+    assert len(reqs) == 6
+    assert all(e.get("queue_wait_s") is not None for e in reqs)
+    summaries = [e for e in stream if e.get("event") == "load.summary"]
+    assert len(summaries) == 1 and summaries[0]["n_requests"] == 6
+    assert registry.histogram("generate_queue_wait_s").n == 6
+
+    # SLO report picks up the queue-wait family
+    slo = build_slo_report(stream)
+    assert "queue_wait_s" in slo and slo["queue_wait_s"]["n"] >= 1
+
+    # tail attribution: compile joined onto the cold request's span
+    bd = request_breakdowns(stream)
+    assert bd["n"] == 6
+    cold = [r for r in bd["requests"] if r["compiled"]]
+    assert cold and all(r["compile_ms"] > 0 for r in cold)
+    warm = [r for r in bd["requests"] if not r["compiled"]]
+    assert all(r["compile_ms"] == 0 for r in warm)
+    assert all(r["total_ms"] >= r["service_ms"] for r in bd["requests"])
+    for key in ("queue_wait_ms", "prefill_ms", "decode_ms", "service_ms", "total_ms"):
+        assert key in bd["medians"]
+
+    # obs_report renders the breakdown section
+    import importlib.util
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec_ = importlib.util.spec_from_file_location(
+        "obs_report", os.path.join(repo, "tools", "obs_report.py")
+    )
+    obs_report = importlib.util.module_from_spec(spec_)
+    spec_.loader.exec_module(obs_report)
+    text = obs_report.render(str(tmp_path))
+    assert "request breakdown" in text and "queue_wait" in text
+    assert "queue_wait_s:" in text  # SLO queue-wait line in the requests section
+
+
+def test_open_loop_measures_queue_growth(tmp_path):
+    """Open loop at an unsustainable rate: arrivals outpace the worker, so
+    queue-wait grows monotonically with arrival index — the overload signal
+    closed-loop self-throttling hides."""
+    model, params = tiny_model()
+    events = EventLog(str(tmp_path), main_process=True)
+    report = run_load(
+        model, params, SPEC, mode="open", n_requests=5, rate_rps=1e5,
+        num_latents=4, events=events,
+    )
+    qws = [r.queue_wait_s for r in report.records]
+    assert all(b >= a for a, b in zip(qws[1:], qws[2:]))  # monotone past warmup
+    assert qws[-1] > qws[1]
+    assert report.summary["target_rps"] == 1e5
+    with pytest.raises(ValueError):
+        run_load(model, params, SPEC, mode="open", n_requests=1)  # no rate
+    with pytest.raises(ValueError):
+        run_load(model, params, SPEC, mode="nope", n_requests=1)
+
+
+# -------------------------------------------------------- flight recorder
+
+
+def _request_row(span_id, ttft=0.01, tpot99=0.001, outcome="ok", request_id="req1"):
+    return dict(
+        request_id=request_id, span_id=span_id, batch=1, prompt_len=8,
+        ttft_s=ttft, tpot_p99_s=tpot99, outcome=outcome, tokens_out=4,
+    )
+
+
+def test_flight_recorder_triggers_dump_and_event(tmp_path):
+    events = EventLog(str(tmp_path), main_process=True)
+    rec = FlightRecorder(events, slo=SLOBounds(ttft_s=0.1, tpot_p99_s=0.05))
+    assert rec.out_dir == str(tmp_path)  # defaults to the sink's log_dir
+    rec.emit_rows("span", [
+        {"name": "request", "span_id": "aaa", "t_start": 1.0, "t_end": 2.0,
+         "dur_ms": 1000.0, "process_index": 0, "attrs": {}},
+        {"name": "request", "span_id": "bbb", "t_start": 2.0, "t_end": 3.0,
+         "dur_ms": 1000.0, "process_index": 0, "attrs": {}},
+    ])
+    rec.emit("request", **_request_row("aaa"))  # within bounds: no dump
+    assert rec.dumps == []
+    rec.emit("request", **_request_row("bbb", ttft=0.5, request_id="req2"))  # breach
+    assert len(rec.dumps) == 1
+    path = rec.dumps[0]
+    assert os.path.basename(path) == "flight-slo_ttft-1.json"
+    dump = json.load(open(path))
+    assert dump["trigger"] == "slo_ttft"
+    assert dump["trigger_span_id"] == "bbb"  # names the breaching span
+    assert dump["trigger_request_id"] == "req2"
+    assert dump["n_events"] == len(dump["events"]) >= 3  # spans + both requests
+    assert not os.path.exists(path + ".tmp")  # atomic: no torn tmp left
+
+    # the stream carries the flight.dump row, and it validates
+    stream = merged_events(str(tmp_path))
+    dumps = [e for e in stream if e.get("event") == "flight.dump"]
+    assert len(dumps) == 1 and dumps[0]["trigger_span_id"] == "bbb"
+    assert validate_events(str(tmp_path)) == []
+
+    # error outcome and tpot-p99 breach are independent triggers
+    rec.emit("request", **_request_row("aaa", outcome="error"))
+    rec.emit("request", **_request_row("aaa", tpot99=0.2))
+    names = [os.path.basename(p) for p in rec.dumps]
+    assert names[1:] == ["flight-error-2.json", "flight-slo_tpot-3.json"]
+
+
+def test_flight_recorder_blast_sentinel_sigusr1_and_cap(tmp_path):
+    events = EventLog(str(tmp_path), main_process=True)
+    rec = FlightRecorder(events, max_dumps=3)
+    rec.emit("probe", step=1, scopes={"000:layer": {"rms": 1.0}})
+    rec.emit("probe.blast", trigger="nonfinite_loss", scope="layer", step=1, affected=["layer"])
+    assert [os.path.basename(p) for p in rec.dumps] == ["flight-blast-1.json"]
+    dump = json.load(open(rec.dumps[0]))
+    assert dump["probe_snapshot"]["scopes"] == {"000:layer": {"rms": 1.0}}
+
+    rec.emit("fault.spike", step=2, loss=9.9)
+    assert os.path.basename(rec.dumps[1]) == "flight-sentinel-2.json"
+
+    prev = rec.install_signal_handler()
+    try:
+        signal.raise_signal(signal.SIGUSR1)
+    finally:
+        signal.signal(signal.SIGUSR1, prev)
+    assert os.path.basename(rec.dumps[2]) == "flight-sigusr1-3.json"
+
+    # capped: the 4th trigger records the event but writes no dump
+    rec.emit("fault.halt", step=3)
+    assert len(rec.dumps) == 3
+    kinds = [e["event"] for e in merged_events(str(tmp_path))]
+    assert kinds.count("flight.dump") == 3 and "fault.halt" in kinds
+
+
+def test_flight_recorder_ring_bounded_and_passthrough(tmp_path):
+    events = EventLog(str(tmp_path), main_process=True)
+    rec = FlightRecorder(events, capacity=4)
+    for i in range(10):
+        rec.emit("log", step=i)
+    ring = rec.ring()
+    assert [r["step"] for r in ring] == [6, 7, 8, 9]  # bounded, oldest dropped
+    # everything still reached the wrapped sink
+    assert len([e for e in merged_events(str(tmp_path)) if e["event"] == "log"]) == 10
+
+
+# ----------------------------------------------------------------- server
+
+
+def test_obs_server_endpoints(tmp_path):
+    from perceiver_io_tpu.obs.server import ObsServer
+
+    events = EventLog(str(tmp_path), main_process=True)
+    events.emit(
+        "request", request_id="r1", batch=1, prompt_len=8, ttft_s=0.01,
+        outcome="ok", tokens_out=4, tokens_per_sec=400.0,
+        tpot_hist={"0": 3}, queue_wait_s=0.002,
+    )
+    registry = MetricsRegistry()
+    registry.counter("gen_requests").inc(1)
+    registry.histogram("lat_s").record(0.01)
+
+    def get(path):
+        with urllib.request.urlopen(server.url + path, timeout=10) as r:
+            return r.status, r.read().decode(), r.headers.get("Content-Type", "")
+
+    with ObsServer(registry=registry, run_dir=str(tmp_path)) as server:
+        assert server.port != 0  # ephemeral port bound
+        status, body, ctype = get("/metrics")
+        assert status == 200 and ctype.startswith("text/plain")
+        assert "gen_requests 1" in body and 'lat_s_bucket{le="+Inf"} 1' in body
+        status, body, _ = get("/healthz")
+        health = json.loads(body)
+        assert status == 200 and health["status"] == "ok" and health["n_metrics"] == 2
+        status, body, ctype = get("/slo")
+        slo = json.loads(body)
+        assert status == 200 and ctype.startswith("application/json")
+        assert slo["n_requests"] == 1 and "queue_wait_s" in slo
+        # incremental ingestion: a row appended AFTER the first scrape is
+        # picked up by the next one (only the tail is parsed, not the file)
+        events.emit(
+            "request", request_id="r2", batch=1, prompt_len=8, ttft_s=0.02,
+            outcome="ok", tokens_out=4, tokens_per_sec=200.0, tpot_hist={"0": 3},
+        )
+        assert json.loads(get("/slo")[1])["n_requests"] == 2
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get("/nope")
+        assert e.value.code == 404
+
+    # /slo without a run_dir is a 404, not a crash
+    with ObsServer(registry=registry) as server:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get("/slo")
+        assert e.value.code == 404
+
+
+def test_prometheus_scrape_concurrent_with_recording():
+    """The wiring this PR introduces — a scrape thread exporting while the
+    serving thread records — must never see the counts dict mutate under
+    iteration, and every scrape must satisfy the histogram invariants
+    (cumulative buckets <= +Inf == _count)."""
+    import re
+    import threading
+
+    reg = MetricsRegistry()
+    h = reg.histogram("busy_s")
+    stop = threading.Event()
+    errors = []
+
+    def record_loop():
+        i = 0
+        while not stop.is_set():
+            h.record(10.0 ** ((i % 1200) / 100.0 - 6))  # a new bucket often
+            i += 1
+
+    t = threading.Thread(target=record_loop, daemon=True)
+    t.start()
+    try:
+        for _ in range(300):
+            try:
+                text = reg.to_prometheus()
+            except RuntimeError as e:  # dict changed size during iteration
+                errors.append(repr(e))
+                break
+            pairs = re.findall(r'busy_s_bucket\{le="([^"}]+)"\} (\d+)', text)
+            cums = [int(c) for _, c in pairs]
+            count = int(re.search(r"busy_s_count (\d+)", text).group(1))
+            if cums != sorted(cums) or (cums and cums[-1] != count):
+                errors.append(f"invariant broken: cums={cums[-3:]} count={count}")
+                break
+            reg.snapshot()  # the event-row exporter shares the same contract
+    finally:
+        stop.set()
+        t.join(timeout=5)
+    assert errors == []
+    assert h.n > 0
+
+
+# ----------------------------------------------------------- LOAD diffing
+
+
+def _doc(**overrides):
+    summary = {
+        "mode": "closed", "n_requests": 200, "concurrency": 4, "target_rps": None,
+        "duration_s": 10.0, "achieved_rps": 20.0, "throughput_tok_s": 500.0,
+        "tokens_out": 5000, "errors": 0, "error_rate": 0.0, "ok_rate": 1.0,
+        "n_cold": 4, "warm_only": True, "n_latency_requests": 196,
+        "ttft_s": {"p50": 0.01, "p90": 0.02, "p99": 0.05, "n": 196.0, "mean": 0.012},
+        "tpot_s": {"p50": 0.001, "p90": 0.002, "p99": 0.004, "n": 900},
+        "queue_wait_s": {"p50": 0.1, "p90": 0.2, "p99": 0.5, "n": 196.0, "mean": 0.12},
+        "breakdown_ms": {"queue_wait": 100.0, "prefill": 10.0, "decode": 40.0},
+    }
+    summary.update(overrides.pop("summary", {}))
+    doc = build_load_doc(
+        1, summary, WorkloadSpec(seed=0),
+        manifest={"backend": "cpu", "device_kind": "cpu", "device_count": 1,
+                  "process_count": 1, "jax_version": "0.4.37", "mesh": None,
+                  "config_hash": "abc"},
+    )
+    doc.update(overrides)
+    return doc
+
+
+def test_diff_load_self_clean_and_classification():
+    doc = _doc()
+    self_diff = diff_load(doc, doc)
+    assert self_diff["comparable"] and self_diff["ok"]
+    assert all(d["kind"] == "neutral" for d in self_diff["deltas"])
+
+    # a 2x tpot p99 under a 25% tolerance is a regression; 2x throughput an
+    # improvement; error_rate is zero-tolerance
+    worse = _doc(summary={
+        "tpot_s": {"p50": 0.001, "p90": 0.002, "p99": 0.008, "n": 900},
+        "throughput_tok_s": 1000.0,
+        "error_rate": 0.01, "ok_rate": 0.99, "errors": 2,
+    })
+    diff = diff_load(doc, worse)
+    kinds = {d["metric"]: d["kind"] for d in diff["deltas"]}
+    assert kinds["tpot_s_p99"] == "regression"
+    assert kinds["throughput_tok_s"] == "improvement"
+    assert kinds["error_rate"] == "regression"
+    assert not diff["ok"]
+    assert "regression" in format_load_diff(diff)
+
+    # low_n families classify neutral, never regression
+    low = _doc(summary={"tpot_s": {"p50": 0.01, "p99": 0.08, "n": 3, "low_n": True}})
+    kinds = {d["metric"]: d["kind"] for d in diff_load(low, low)["deltas"]}
+    assert kinds["tpot_s_p99"] == "neutral"
+
+
+def test_diff_load_refuses_incomparable():
+    doc = _doc()
+    other_mode = _doc()
+    other_mode["mode"] = "open"
+    other_mode["summary"]["mode"] = "open"
+    d = diff_load(doc, other_mode)
+    assert not d["comparable"] and "mode" in d["reason"]
+    assert "NOT COMPARABLE" in format_load_diff(d)
+
+    other_dev = _doc()
+    other_dev["manifest"]["device_kind"] = "TPU v5e"
+    assert not diff_load(doc, other_dev)["comparable"]
+
+    other_n = _doc()
+    other_n["workload"]["n_requests"] = 100
+    assert not diff_load(doc, other_n)["comparable"]
+
+
+def test_summarize_load_warm_only_fallback():
+    from perceiver_io_tpu.obs.loadgen import RequestRecord
+
+    cold = [
+        RequestRecord(index=i, prompt_len=8, max_new_tokens=4, batch=1,
+                      queue_wait_s=0.1, compiled=True, ttft_s=1.0, decode_s=0.5,
+                      tokens_out=4)
+        for i in range(3)
+    ]
+    s = summarize_load(cold, duration_s=2.0)
+    assert s["warm_only"] is False and s["n_cold"] == 3
+    assert s["ttft_s"]["low_n"] is True
+    err = RequestRecord(index=3, prompt_len=8, max_new_tokens=4, batch=1,
+                        queue_wait_s=0.0, outcome="error", error="boom")
+    s = summarize_load(cold + [err], duration_s=2.0)
+    assert s["errors"] == 1 and s["error_rate"] == 0.25 and s["ok_rate"] == 0.75
+    with pytest.raises(ValueError):
+        summarize_load([], 1.0)
+
+
+# ----------------------------------------------------------- the CLI gate
+
+
+def _load_cli():
+    import importlib.util
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "loadgen_cli", os.path.join(repo, "tools", "loadgen.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_loadgen_cli_gate_and_diff(tmp_path):
+    """`tasks.py load --smoke` in miniature: the gate runs clean end to end
+    (stream validates, planted breach -> exactly one flight dump naming the
+    breaching span, /metrics+/slo answer, self-diff clean, LOAD floors
+    hold), and the --diff mode round-trips a committed artifact."""
+    cli = _load_cli()
+    out = tmp_path / "run"
+    rc = cli.main(["--smoke", "--requests", "6", "--out", str(out)])
+    assert rc == 0
+    dumps = [p for p in os.listdir(out) if p.startswith("flight-")]
+    assert dumps == ["flight-slo_ttft-1.json"]
+    dump = json.load(open(out / dumps[0]))
+    stream = merged_events(str(out))
+    breach = [e for e in stream if e.get("event") == "request"][-1]
+    assert dump["trigger_span_id"] == breach["span_id"]
+    assert os.path.exists(out / "slo_report.json")
+
+    # --diff: the committed artifact vs itself is clean (exit 0); a
+    # different-workload doc refuses comparison (exit 2)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    committed = os.path.join(repo, "LOAD_r01.json")
+    assert cli.main(["--diff", committed, committed]) == 0
+    other = json.load(open(committed))
+    other["workload"]["n_requests"] = 7
+    other_path = tmp_path / "other.json"
+    other_path.write_text(json.dumps(other))
+    assert cli.main(["--diff", committed, str(other_path)]) == 2
+
+
+# ------------------------------------------------- breakdown join (no jax)
+
+
+def test_request_breakdowns_joins_compile_by_span():
+    events = [
+        {"event": "span", "span_id": "s1", "name": "request", "dur_ms": 1200.0},
+        {"event": "span", "span_id": "s2", "name": "request", "dur_ms": 50.0},
+        {"event": "compile", "fn": "generate_prefill", "wall_s": 1.0,
+         "n_compiles": 1, "span_id": "s1"},
+        {"event": "request", "request_id": "r1", "span_id": "s1", "batch": 1,
+         "prompt_len": 8, "ttft_s": 1.05, "decode_s": 0.1, "outcome": "ok",
+         "tokens_out": 4, "compiled": True, "queue_wait_s": 0.0},
+        {"event": "request", "request_id": "r2", "span_id": "s2", "batch": 1,
+         "prompt_len": 8, "ttft_s": 0.01, "decode_s": 0.03, "outcome": "ok",
+         "tokens_out": 4, "compiled": False, "queue_wait_s": 0.2},
+    ]
+    bd = request_breakdowns(events)
+    assert bd["n"] == 2 and bd["warm_only"] is True
+    r1, r2 = bd["requests"]
+    assert r1["compile_ms"] == 1000.0 and r1["service_ms"] == 1200.0
+    assert r2["compile_ms"] == 0.0 and r2["total_ms"] == pytest.approx(250.0)
+    # medians are warm-only: r2 alone defines them
+    assert bd["medians"]["queue_wait_ms"] == 200.0
+    assert bd["medians"]["prefill_ms"] == 10.0
+    # the cold compile median is reported separately
+    assert bd["medians"]["compile_ms_cold"] == 1000.0
+    assert request_breakdowns([{"event": "log"}]) is None

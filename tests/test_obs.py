@@ -1069,6 +1069,50 @@ def test_histogram_to_prometheus_bucket_monotonicity():
     assert "gappy_s_count 6" in text
 
 
+def test_prometheus_exposition_golden():
+    """ISSUE 11 satellite: the exposition FORMAT is the contract a real
+    Prometheus scraper parses — pin it byte-for-byte. Per histogram: the
+    cumulative sparse buckets, the ``+Inf`` bucket equal to ``_count``, and
+    the ``_sum``/``_count`` series ``histogram_quantile``/``rate`` need;
+    metrics name-sorted; HELP only where help text exists; names
+    sanitized."""
+    from perceiver_io_tpu.obs.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    reg.counter("reqs").inc(3)
+    reg.gauge("depth").set(2)
+    h = reg.histogram("lat_s", help="request latency")
+    h.record(1.0)  # bucket 0: le = 2**0.25
+    h.record(2.0)  # bucket 4: le = 2**1.25
+    assert reg.to_prometheus() == (
+        "# TYPE depth gauge\n"
+        "depth 2\n"
+        "# HELP lat_s request latency\n"
+        "# TYPE lat_s histogram\n"
+        'lat_s_bucket{le="1.18921"} 1\n'
+        'lat_s_bucket{le="2.37841"} 2\n'
+        'lat_s_bucket{le="+Inf"} 2\n'
+        "lat_s_sum 3\n"
+        "lat_s_count 2\n"
+        "# TYPE reqs counter\n"
+        "reqs 3\n"
+    )
+    # dotted names sanitize to the Prometheus charset; empty registry is ""
+    reg2 = MetricsRegistry()
+    reg2.counter("a.b/c").inc()
+    assert "a_b_c 1" in reg2.to_prometheus()
+    assert MetricsRegistry().to_prometheus() == ""
+    # an empty histogram still exposes a complete (+Inf/_sum/_count) family
+    reg3 = MetricsRegistry()
+    reg3.histogram("never_s")
+    assert reg3.to_prometheus() == (
+        "# TYPE never_s histogram\n"
+        'never_s_bucket{le="+Inf"} 0\n'
+        "never_s_sum 0\n"
+        "never_s_count 0\n"
+    )
+
+
 def test_validate_events_unknown_kinds_warn_forward_compatibly(tmp_path):
     """ISSUE 9 satellite: kinds outside KNOWN_EVENT_KINDS are NEVER
     problems (older tooling survives newer streams) but are collected into

@@ -1,0 +1,385 @@
+"""Loadline CLI — drive synthetic serving load, certify it, round-trip it.
+
+The standing serving-observability gate (``tasks.py load``; ``--smoke`` is
+wired into ``tasks.py perf``): run a seeded closed-loop (or open-loop) load
+against the tiny flagship-family CLM through the fully instrumented path —
+flight recorder wrapping the event log, ``/metrics``+``/slo`` scrape server
+up for the duration — then assert the whole surface end to end:
+
+1. the event stream validates (``load.summary``, ``flight.dump``,
+   queue-wait-stamped ``request`` rows all schema-checked);
+2. a **planted SLO breach** (the recorder's TTFT bound tightened to ~0 for
+   one extra request riding the already-compiled fns) produces EXACTLY one
+   flight dump whose ``flight.dump`` event names the breaching request's
+   span — the post-mortem path demonstrably works;
+3. the live scrape surface answers: ``/metrics`` exposes
+   ``histogram_quantile``-ready series, ``/slo`` serves the live report;
+4. the run summarizes into a LOAD artifact body whose run-vs-itself
+   :func:`obs.loadgen.diff_load` is clean (comparability rules hold);
+5. the ledger's ``LOAD_r*.json`` floors hold against the latest committed
+   artifact (``contracts/ledger.json`` — the same floor machinery the
+   bench gate uses).
+
+    python tools/loadgen.py                      # the full gate (200 reqs)
+    python tools/loadgen.py --smoke              # CI-fast subset (24 reqs)
+    python tools/loadgen.py --write-artifact     # refresh LOAD_r<next>.json
+    python tools/loadgen.py --diff OLD.json NEW.json [--tolerance k=v]
+    python tools/loadgen.py --mode open --rate 20 --requests 100
+
+Exit codes (mirrors tools/obs_gate.py): 0 clean, 1 gate failure /
+regression, 2 not comparable (diff mode), 3 internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import shutil
+import sys
+import tempfile
+import urllib.request
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+_ROUND_RE = re.compile(r"_r(\d+)\.json$")
+
+
+def build_workload():
+    """The gate's model: same tiny flagship-family geometry as
+    tools/obs_gate.py (the gate certifies serving telemetry, not perf)."""
+    import jax
+    import numpy as np
+
+    from perceiver_io_tpu.models.text import CausalLanguageModel, CausalLanguageModelConfig
+
+    config = CausalLanguageModelConfig(
+        vocab_size=64, max_seq_len=24, max_latents=8, num_channels=32,
+        num_heads=4, num_self_attention_layers=2, cross_attention_dropout=0.5,
+    )
+    model = CausalLanguageModel(config)
+    ids = np.random.default_rng(0).integers(0, config.vocab_size, size=(1, 12))
+    import jax.numpy as jnp
+
+    params = model.init(jax.random.PRNGKey(0), jnp.asarray(ids), prefix_len=8)
+    return model, params, config
+
+
+def _fetch(url: str) -> str:
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.read().decode()
+
+
+def run_gate(args) -> int:
+    from perceiver_io_tpu.obs.events import EventLog, validate_events, write_run_manifest
+    from perceiver_io_tpu.obs.flightrec import FlightRecorder, SLOBounds
+    from perceiver_io_tpu.obs.loadgen import (
+        WorkloadSpec,
+        build_load_doc,
+        diff_load,
+        format_load_diff,
+        run_load,
+    )
+    from perceiver_io_tpu.obs.slo import request_breakdowns, write_slo_report
+
+    out_dir = args.out or tempfile.mkdtemp(prefix="loadgen_")
+    keep = args.keep or args.out is not None
+    problems: list = []
+    try:
+        n_requests = args.requests
+        spec = WorkloadSpec(seed=args.seed)
+        print(
+            f"loadgen: {args.mode}-loop, {n_requests} requests "
+            f"({'concurrency ' + str(args.concurrency) if args.mode == 'closed' else f'rate {args.rate}/s'}) "
+            f"-> {out_dir}"
+        )
+        model, params, config = build_workload()
+        events = EventLog(out_dir, main_process=True)
+        manifest = write_run_manifest(
+            out_dir, model_config=config, extra={"workload_spec": spec.to_dict()},
+            main_process=True,
+        )
+        # generous standing bounds: the planted breach below, not normal CPU
+        # jitter, is what should trip the recorder in this gate
+        recorder = FlightRecorder(
+            events, out_dir=out_dir,
+            slo=SLOBounds(ttft_s=args.ttft_slo, tpot_p99_s=args.tpot_slo),
+        )
+
+        from perceiver_io_tpu.obs.metrics import MetricsRegistry
+        from perceiver_io_tpu.obs.server import ObsServer
+
+        registry = MetricsRegistry()
+        with ObsServer(registry=registry, run_dir=out_dir) as server:
+            report = run_load(
+                model, params, spec,
+                mode=args.mode, n_requests=n_requests,
+                concurrency=args.concurrency, rate_rps=args.rate,
+                num_latents=4, events=recorder, registry=registry,
+                snapshot_interval_s=0.0,
+            )
+            summary = report.summary
+            print(
+                f"loadgen: {summary['n_requests']} requests in {summary['duration_s']:.2f}s "
+                f"({summary['achieved_rps']:.1f} req/s, {summary['throughput_tok_s']:.0f} tok/s, "
+                f"{summary['errors']} errors, {summary['n_cold']} cold)"
+            )
+
+            # span-joined tail attribution over the MAIN run (before the
+            # plant adds its request): enriches the artifact's breakdown
+            # with the compile-if-cold / service / total legs only the
+            # event-stream join can see
+            from perceiver_io_tpu.obs.events import merged_events
+
+            bd = request_breakdowns(merged_events(out_dir))
+            if not bd or "prefill_ms" not in bd.get("medians", {}):
+                problems.append("request_breakdowns produced no prefill median")
+            else:
+                summary["breakdown_ms"] = {
+                    key.replace("_ms", ""): val
+                    for key, val in bd["medians"].items()
+                }
+
+            # --- planted SLO breach: exactly one dump, naming the span ---
+            dumps_before = len(recorder.dumps)
+            prev_ttft = recorder.slo.ttft_s
+            recorder.slo.ttft_s = 1e-9
+            plant = run_load(
+                model, params, WorkloadSpec(seed=args.seed + 999),
+                mode="closed", n_requests=1, concurrency=1,
+                num_latents=4, events=recorder, registry=report.registry,
+                generate_fns=report.generate_fns, snapshot_interval_s=1e9,
+            )
+            recorder.slo.ttft_s = prev_ttft
+            if plant.records[0].outcome != "ok":
+                problems.append(f"planted request errored: {plant.records[0].error}")
+            new_dumps = recorder.dumps[dumps_before:]
+            if len(new_dumps) != 1:
+                problems.append(
+                    f"planted SLO breach produced {len(new_dumps)} flight dumps, want exactly 1"
+                )
+            else:
+                with open(new_dumps[0]) as f:
+                    dump = json.load(f)
+                if dump.get("trigger") != "slo_ttft":
+                    problems.append(f"dump trigger {dump.get('trigger')!r} != 'slo_ttft'")
+                if not dump.get("trigger_span_id"):
+                    problems.append("flight dump does not name the breaching span")
+                if not dump.get("events"):
+                    problems.append("flight dump carries no ring events")
+                elif not any(
+                    e.get("event") == "span"
+                    and e.get("span_id") == dump.get("trigger_span_id")
+                    for e in dump["events"]
+                ):
+                    # the post-mortem contract: the ring frozen into the
+                    # dump must hold the very span the dump names
+                    problems.append("flight dump ring lacks the named trigger span")
+
+            # --- scrape surface answers while the run is live ---
+            metrics_text = _fetch(server.url + "/metrics")
+            if 'generate_ttft_s_bucket{le="+Inf"}' not in metrics_text:
+                problems.append("/metrics lacks the +Inf TTFT bucket (histogram_quantile would fail)")
+            if "generate_queue_wait_s_count" not in metrics_text:
+                problems.append("/metrics lacks the queue-wait histogram")
+            health = json.loads(_fetch(server.url + "/healthz"))
+            if health.get("status") != "ok":
+                problems.append(f"/healthz status {health.get('status')!r}")
+            slo_live = json.loads(_fetch(server.url + "/slo"))
+            if slo_live.get("n_requests") != n_requests + 1:
+                problems.append(
+                    f"/slo n_requests {slo_live.get('n_requests')} != {n_requests + 1}"
+                )
+
+        # --- event stream validates, dump event in stream ---
+        warnings_out: list = []
+        problems += validate_events(out_dir, warnings_out=warnings_out)
+        for w in warnings_out:
+            print(f"loadgen: warning: {w}")
+        stream = merged_events(out_dir)
+        kinds = [e.get("event") for e in stream]
+        if "load.summary" not in kinds:
+            problems.append("no load.summary event in the stream")
+        dump_rows = [e for e in stream if e.get("event") == "flight.dump"]
+        if len(dump_rows) != 1:
+            problems.append(f"{len(dump_rows)} flight.dump events in stream, want 1")
+        else:
+            breach = [e for e in stream if e.get("event") == "request"][-1]
+            if dump_rows[0].get("trigger_span_id") != breach.get("span_id"):
+                problems.append("flight.dump trigger_span_id != breaching request's span_id")
+        loadgen_reqs = [
+            e for e in stream
+            if e.get("event") == "request" and e.get("queue_wait_s") is not None
+        ]
+        if len(loadgen_reqs) != n_requests + 1:
+            problems.append(
+                f"{len(loadgen_reqs)} queue-wait-stamped request rows, want {n_requests + 1}"
+            )
+        for key in ("achieved_rps", "throughput_tok_s", "error_rate", "ttft_s",
+                    "queue_wait_s", "breakdown_ms"):
+            if key not in summary:
+                problems.append(f"summary missing {key!r}")
+        write_slo_report(out_dir)
+
+        # --- artifact body + run-vs-itself comparability diff ---
+        doc = build_load_doc(
+            args.round or _next_round(), summary, spec, manifest=manifest
+        )
+        self_diff = diff_load(doc, doc)
+        if not (self_diff["comparable"] and self_diff["ok"]):
+            problems.append("run-vs-itself load diff NOT clean (differ broken): "
+                            + format_load_diff(self_diff))
+        else:
+            print("loadgen: run-vs-itself comparability diff clean")
+
+        if args.write_artifact:
+            # pre-validate THIS doc against the LOAD floors before it hits
+            # disk: a sub-floor artifact (e.g. a --smoke-size run) would
+            # become the latest round and fail every future gate run
+            floor_fails = check_doc_floors(doc)
+            if floor_fails:
+                problems += [f"refusing to write artifact: {f}" for f in floor_fails]
+            else:
+                path = os.path.join(_REPO, f"LOAD_r{doc['n']:02d}.json")
+                with open(path, "w") as f:
+                    json.dump(doc, f, indent=1, sort_keys=True)
+                    f.write("\n")
+                print(f"loadgen: wrote {path}")
+
+        # --- ledger floors over the committed LOAD artifacts ---
+        problems += check_load_floors()
+
+        if problems:
+            print("loadgen: gate FAILED:")
+            for p in problems:
+                print(f"  - {p}")
+            return 1
+        print(
+            "loadgen: OK — "
+            f"ttft_p99={summary['ttft_s']['p99']}s "
+            f"queue_p99={summary['queue_wait_s']['p99']}s "
+            f"(1 planted breach -> 1 flight dump)"
+        )
+        return 0
+    except Exception as e:  # noqa: BLE001 — CI must see crash != verdict
+        print(f"loadgen: internal error: {e}", file=sys.stderr)
+        import traceback
+
+        traceback.print_exc()
+        return 3
+    finally:
+        if not keep:
+            shutil.rmtree(out_dir, ignore_errors=True)
+
+
+def _next_round() -> int:
+    rounds = [
+        int(m.group(1))
+        for p in glob.glob(os.path.join(_REPO, "LOAD_r*.json"))
+        if (m := _ROUND_RE.search(p))
+    ]
+    return max(rounds) + 1 if rounds else 1
+
+
+def _load_floors() -> dict:
+    from perceiver_io_tpu.analysis.ledger import load_ledger
+
+    ledger = load_ledger(os.path.join(_REPO, "contracts")) or {}
+    return {
+        name: floor
+        for name, floor in ledger.get("floors", {}).items()
+        if str(floor.get("artifact", "")).startswith("LOAD_")
+    }
+
+
+def check_doc_floors(doc: dict) -> list:
+    """LOAD-floor failures of ONE candidate doc (before it is committed) —
+    the write-side guard; :func:`check_load_floors` is the read-side gate
+    over whatever is already on disk."""
+    from perceiver_io_tpu.analysis.ledger import _dig
+
+    failures = []
+    for name, floor in _load_floors().items():
+        value = _dig(doc, floor["key"])
+        if not isinstance(value, (int, float)) or value < floor["min"]:
+            failures.append(f"{name}: {floor['key']} = {value!r} below floor {floor['min']}")
+    return failures
+
+
+def check_load_floors() -> list:
+    """The ledger-floor hook: enforce every ``contracts/ledger.json`` floor
+    whose artifact pattern targets LOAD_r*.json (latest round wins — the
+    same machinery as the committed-bench floors). No LOAD floors, no
+    committed artifact yet -> nothing to enforce."""
+    from perceiver_io_tpu.analysis.ledger import check_bench_floors
+
+    load_floors = _load_floors()
+    if not load_floors:
+        return []
+    return check_bench_floors({"floors": load_floors}, _REPO)
+
+
+def run_diff(args) -> int:
+    from perceiver_io_tpu.obs.loadgen import LOAD_METRICS, diff_load, format_load_diff
+
+    tolerances = {}
+    for spec in args.tolerance:
+        if "=" not in spec:
+            print(f"--tolerance wants METRIC=TOL, got {spec!r}", file=sys.stderr)
+            return 3
+        k, v = spec.split("=", 1)
+        if k not in LOAD_METRICS:
+            print(f"unknown metric {k!r} (known: {', '.join(sorted(LOAD_METRICS))})",
+                  file=sys.stderr)
+            return 3
+        tolerances[k] = float(v)
+    with open(args.diff[0]) as f:
+        old = json.load(f)
+    with open(args.diff[1]) as f:
+        new = json.load(f)
+    diff = diff_load(old, new, tolerances)
+    print(format_load_diff(diff))
+    if not diff["comparable"]:
+        return 2
+    return 0 if diff["ok"] else 1
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--mode", choices=("closed", "open"), default="closed")
+    p.add_argument("--requests", type=int, default=None,
+                   help="request count (default: 200, or 24 with --smoke)")
+    p.add_argument("--concurrency", type=int, default=4, help="closed-loop inflight")
+    p.add_argument("--rate", type=float, default=None, help="open-loop arrival rate (req/s)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--smoke", action="store_true",
+                   help="CI-fast gate: 24 requests, same assertions")
+    p.add_argument("--out", default=None, help="run dir (default: a temp dir)")
+    p.add_argument("--keep", action="store_true", help="keep the run dir (implied by --out)")
+    p.add_argument("--write-artifact", action="store_true",
+                   help="write/refresh LOAD_r<round>.json at the repo root")
+    p.add_argument("--round", type=int, default=None,
+                   help="artifact round number (default: next free)")
+    p.add_argument("--ttft-slo", type=float, default=30.0,
+                   help="standing flight-recorder TTFT bound (s)")
+    p.add_argument("--tpot-slo", type=float, default=30.0,
+                   help="standing flight-recorder TPOT-p99 bound (s)")
+    p.add_argument("--diff", nargs=2, metavar=("OLD", "NEW"),
+                   help="diff two LOAD_r*.json artifacts instead of running")
+    p.add_argument("--tolerance", action="append", default=[], metavar="METRIC=TOL")
+    args = p.parse_args(argv)
+    if args.diff:
+        return run_diff(args)
+    if args.requests is None:
+        args.requests = 24 if args.smoke else 200
+    if args.mode == "open" and not args.rate:
+        p.error("--mode open needs --rate")
+    return run_gate(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

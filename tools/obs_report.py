@@ -316,6 +316,115 @@ def render(run_dir: str, max_compile_rows: int = 20) -> str:
                 f"  tpot_s ({n_tok} tokens): p50 {hist_pct(50):.4g}  "
                 f"p90 {hist_pct(90):.4g}  p99 {hist_pct(99):.4g}{low}" + note
             )
+        # queue-wait (loadgen-issued requests carry admission telemetry)
+        qws = [float(g["queue_wait_s"]) for g in warm if g.get("queue_wait_s") is not None]
+        if qws:
+            lines.append(
+                f"  queue_wait_s: p50 {_pct(qws, 50):.4g}  p99 {_pct(qws, 99):.4g}  "
+                f"mean {sum(qws)/len(qws):.4g}" + note
+            )
+
+    # per-request tail attribution: queue-wait -> prefill -> decode ->
+    # compile-if-cold, the compile leg joined from span-stamped compile
+    # events. Canonical join lives in obs.slo.request_breakdowns; the
+    # inline copy is the no-package fallback (same pattern as load_events).
+    bd = None
+    if reqs:
+        try:
+            from perceiver_io_tpu.obs.slo import request_breakdowns
+
+            bd = request_breakdowns(events)
+        except ImportError:
+            compile_s: Dict[str, float] = {}
+            for e in events:
+                if e.get("event") == "compile" and e.get("span_id") is not None:
+                    compile_s[e["span_id"]] = compile_s.get(e["span_id"], 0.0) + float(
+                        e.get("wall_s", 0.0)
+                    )
+            brows = []
+            for r in reqs:
+                brows.append(
+                    {
+                        "request_id": r.get("request_id"),
+                        "outcome": r.get("outcome", "ok"),
+                        "compiled": bool(r.get("compiled")),
+                        "queue_wait_ms": None
+                        if r.get("queue_wait_s") is None
+                        else 1e3 * float(r["queue_wait_s"]),
+                        "prefill_ms": None
+                        if r.get("ttft_s") is None
+                        else 1e3 * float(r["ttft_s"]),
+                        "decode_ms": None
+                        if r.get("decode_s") is None
+                        else 1e3 * float(r["decode_s"]),
+                        "compile_ms": 1e3 * compile_s.get(r.get("span_id"), 0.0),
+                        "service_ms": 1e3
+                        * sum(float(r.get(k) or 0.0) for k in ("ttft_s", "decode_s")),
+                        "total_ms": 1e3
+                        * sum(
+                            float(r.get(k) or 0.0)
+                            for k in ("queue_wait_s", "ttft_s", "decode_s")
+                        ),
+                    }
+                )
+            ok_rows = [b for b in brows if b["outcome"] == "ok"]
+            warm_rows = [b for b in ok_rows if not b["compiled"]]
+            pool = warm_rows or ok_rows
+            med = {}
+            for key in ("queue_wait_ms", "prefill_ms", "decode_ms", "service_ms", "total_ms"):
+                vals = sorted(float(b[key]) for b in pool if b.get(key) is not None)
+                if vals:
+                    n = len(vals)
+                    med[key] = vals[n // 2] if n % 2 else 0.5 * (vals[n // 2 - 1] + vals[n // 2])
+            bd = {"n": len(brows), "requests": brows, "medians": med,
+                  "warm_only": bool(warm_rows)}
+    if bd and bd["n"]:
+        lines.append("")
+        lines.append(
+            f"== request breakdown (queue -> prefill -> decode, {bd['n']} requests"
+            + ("" if bd.get("warm_only", True) else "; ALL cold")
+            + ") =="
+        )
+        med = bd.get("medians", {})
+        if med:
+            lines.append(
+                "  medians: "
+                + "  ".join(
+                    f"{k.replace('_ms', '')} {med[k]:.4g} ms"
+                    for k in (
+                        "queue_wait_ms", "prefill_ms", "decode_ms",
+                        "compile_ms_cold", "service_ms", "total_ms",
+                    )
+                    if k in med
+                )
+            )
+        slowest = sorted(
+            (b for b in bd["requests"] if b.get("total_ms") is not None),
+            key=lambda b: -float(b["total_ms"]),
+        )[:5]
+        if slowest:
+            rows = [
+                [
+                    str(b.get("request_id") or "?")[:10],
+                    *(
+                        "-" if b.get(k) is None else f"{float(b[k]):.4g}"
+                        for k in (
+                            "queue_wait_ms", "prefill_ms", "decode_ms",
+                            "compile_ms", "total_ms",
+                        )
+                    ),
+                    b.get("outcome", "ok") + (" (cold)" if b.get("compiled") else ""),
+                ]
+                for b in slowest
+            ]
+            lines.extend(
+                "  " + r
+                for r in _table(
+                    rows,
+                    ["request", "queue_ms", "prefill_ms", "decode_ms",
+                     "compile_ms", "total_ms", "outcome"],
+                )
+            )
     return "\n".join(lines)
 
 
