@@ -19,6 +19,10 @@ Layer map (mirrors the reference's four stacked layers, re-drawn for JAX):
   hf            perceiver_io_tpu.hf           conversion, auto-models, pipelines
   utils         perceiver_io_tpu.utils        FLOPs estimator, scaling laws, profiling
   generation    perceiver_io_tpu.generation   compiled decode: sampling + beam search
+  serving       perceiver_io_tpu.serving      hardened front end: admission, deadlines,
+                                              shedding, circuit breaking, clean books
+  obs           perceiver_io_tpu.obs          events, spans, metrics, SLO, flight recorder
+  analysis      perceiver_io_tpu.analysis     graph lint/contracts over jaxprs + HLO
 """
 
 __version__ = "0.1.0"

@@ -51,6 +51,27 @@ class GenerationConfig:
     pad_token_id: int = 0
 
 
+class GenerationAborted(RuntimeError):
+    """Raise from an ``on_token`` callback to stop a request mid-decode.
+
+    The cancellation seam of :func:`make_instrumented_generate_fn`: the
+    wrapper classifies the abort by :attr:`outcome` instead of ``"error"``,
+    so the ``request`` event (and ``GenerationStats``) carries the honest
+    terminal outcome with the partial TTFT/TPOT already measured. The
+    serving front end (``perceiver_io_tpu.serving``) raises the
+    :class:`GenerationDeadlineExceeded` subclass when a request's deadline
+    expires mid-decode and this base class for explicit cancellation.
+    """
+
+    outcome = "cancelled"
+
+
+class GenerationDeadlineExceeded(GenerationAborted):
+    """Mid-decode deadline expiry — stamped as a ``timeout`` outcome."""
+
+    outcome = "timeout"
+
+
 def _maybe_quantize_weights(model, params, weight_dtype):
     """``(decode_params, compute_dtype)`` — int8-quantized kernels and the
     dtype to dequantize to inside the decode loop, or ``(params, None)``
@@ -699,8 +720,11 @@ class GenerationStats:
     compiled: bool  # True when THIS call paid a compile (timings include it)
     # --- Spanline (PR 8) per-request SLO fields -------------------------
     ttft_s: float = 0.0  # == prefill_s (serving-literature name)
-    tokens_out: int = 0  # tokens actually produced (== new_tokens unless error)
-    outcome: str = "ok"  # "ok" | "error"
+    tokens_out: int = 0  # tokens actually produced (== new_tokens unless aborted)
+    # terminal outcome of THIS call: "ok" | "error" | "timeout" | "cancelled"
+    # ("shed" never reaches this wrapper — a shed request is rejected at
+    # admission by the serving front end and never decodes)
+    outcome: str = "ok"
     tpot_p50_s: Optional[float] = None  # histogram-derived decode percentiles
     tpot_p90_s: Optional[float] = None
     tpot_p99_s: Optional[float] = None
@@ -709,6 +733,10 @@ class GenerationStats:
     # by the caller — obs/loadgen.py — and handed in per call); None when
     # the caller did no admission accounting
     queue_wait_s: Optional[float] = None
+    # --- Shedline (PR 12) serving-hardening fields ----------------------
+    # worst per-token non-finite-logit fraction (probes=True only): the
+    # sentinel signal the front end's circuit breaker feeds on
+    nonfinite_logit_frac: Optional[float] = None
 
 
 def make_instrumented_generate_fn(
@@ -735,7 +763,13 @@ def make_instrumented_generate_fn(
     counts (``obs.slo`` merges them into run-level percentiles) and the
     outcome. A request that dies mid-decode still emits its event with
     ``outcome="error"`` and the partial TPOT data before the exception
-    re-raises (the same except-and-reraise guarantee ``fit_end`` makes).
+    re-raises (the same except-and-reraise guarantee ``fit_end`` makes);
+    an ``on_token`` callback raising :class:`GenerationAborted` /
+    :class:`GenerationDeadlineExceeded` instead classifies the event as
+    ``cancelled`` / ``timeout`` — the mid-decode cancellation seam the
+    serving front end (``perceiver_io_tpu.serving``) enforces deadlines
+    through. Either way the exception re-raises with the partial
+    ``GenerationStats`` attached as ``e.generation_stats``.
 
     The per-token host dispatch costs more than :func:`make_generate_fn`'s
     fused scan — this is the measurement wrapper for serving telemetry and
@@ -783,6 +817,8 @@ def make_instrumented_generate_fn(
     m_requests = registry.counter("generate_requests_total")
     m_cold = registry.counter("generate_cold_requests_total")
     m_errors = registry.counter("generate_request_errors_total")
+    m_timeouts = registry.counter("generate_request_timeouts_total")
+    m_cancelled = registry.counter("generate_request_cancelled_total")
     m_tokens = registry.counter("generate_tokens_out_total")
     # WARM samples only: the cross-request histograms feed dashboards
     # (Prometheus export / metrics snapshots) that never reset, so one
@@ -849,7 +885,11 @@ def make_instrumented_generate_fn(
                     if on_token is not None:
                         on_token(i, token)
             except BaseException as e:  # noqa: BLE001 — event out, then reraise
-                outcome, err = "error", e
+                # the cancellation seam: an on_token callback raising
+                # GenerationAborted (deadline expiry, explicit cancel)
+                # classifies by its declared outcome, not as an error
+                outcome = e.outcome if isinstance(e, GenerationAborted) else "error"
+                err = e
             if sp is not None:
                 sp.set("outcome", outcome)
                 sp.set("tokens_out", len(toks))
@@ -901,6 +941,9 @@ def make_instrumented_generate_fn(
             tpot_p90_s=hist.percentile(90),
             tpot_p99_s=hist.percentile(99),
             queue_wait_s=None if queue_wait_s is None else round(queue_wait_s, 6),
+            nonfinite_logit_frac=(
+                None if health_row is None else health_row["nonfinite_logit_frac"]
+            ),
         )
         m_requests.inc()
         m_tokens.inc(tokens_out * b)
@@ -908,6 +951,10 @@ def make_instrumented_generate_fn(
             m_cold.inc()
         if outcome == "error":
             m_errors.inc()
+        elif outcome == "timeout":
+            m_timeouts.inc()
+        elif outcome == "cancelled":
+            m_cancelled.inc()
         if events is not None:
             row = asdict(stats)
             row.update(
@@ -922,6 +969,8 @@ def make_instrumented_generate_fn(
             )
             if health_row is not None:
                 row.update(health_row)
+            if health_row is None:
+                row.pop("nonfinite_logit_frac", None)  # probes off / fetch failed
             if queue_wait_s is None:
                 row.pop("queue_wait_s", None)  # no admission accounting upstream
             elif arrival_ts is not None:
@@ -940,6 +989,13 @@ def make_instrumented_generate_fn(
             events.emit("request", **row)
             registry.maybe_emit(events, min_interval_s=snapshot_interval_s)
         if err is not None:
+            # the caller sees the exception, not the return value — carry the
+            # partial stats along so a serving front end can keep honest
+            # books (tokens produced, partial TTFT/TPOT) for the dead request
+            try:
+                err.generation_stats = stats
+            except Exception:  # noqa: BLE001 — slotted/frozen exception types
+                pass
             raise err
         out = jnp.concatenate([input_ids] + [t[:, None] for t in toks], axis=1)
         return out, stats

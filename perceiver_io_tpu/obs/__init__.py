@@ -30,6 +30,7 @@ run directory with ``tools/obs_report.py``; diff two runs with
 from perceiver_io_tpu.obs.events import (  # noqa: F401
     EVENT_SCHEMA_VERSION,
     KNOWN_EVENT_KINDS,
+    REQUEST_OUTCOMES,
     EventLog,
     config_hash,
     event_shards,
@@ -85,6 +86,7 @@ from perceiver_io_tpu.obs.trace import (  # noqa: F401
 __all__ = [
     "EVENT_SCHEMA_VERSION",
     "KNOWN_EVENT_KINDS",
+    "REQUEST_OUTCOMES",
     "ProbeConfig",
     "blast_report",
     "decode_health",

@@ -352,7 +352,22 @@ _REQUIRED_FIELDS: Dict[str, tuple] = {
     # flight recorder (obs/flightrec.py): a dump fired — the post-mortem
     # entry point must name what tripped it and which span to start from
     "flight.dump": ("trigger", "path", "n_events", "trigger_span_id"),
+    # Shedline (perceiver_io_tpu/serving, docs/robustness.md#serving-
+    # hardening): circuit-breaker state transitions, pre-decode retry
+    # attempts, and the drain summary carrying the final books
+    "serve.breaker": ("state", "prev", "reason"),
+    "serve.retry": ("attempt", "delay_s"),
+    "serve.drain": ("books",),
 }
+
+# the closed terminal-outcome vocabulary of `request` rows (the serving
+# front end's clean-books invariant rides on it): "shed" is stamped at
+# admission by perceiver_io_tpu.serving, "timeout"/"cancelled" by the
+# generation cancellation seam, "ok"/"error" by the instrumented wrapper.
+# validate_events warns on outcomes outside it (forward compatibility —
+# a newer stream must not fail an older gate) and FAILS on a missing or
+# non-string outcome.
+REQUEST_OUTCOMES = frozenset({"ok", "error", "timeout", "shed", "cancelled"})
 
 # the full vocabulary THIS version of the library emits. validate_events
 # flags kinds outside it as WARNINGS (never problems): an older tool
@@ -362,6 +377,7 @@ KNOWN_EVENT_KINDS = frozenset(_REQUIRED_FIELDS) | frozenset(
     {
         "fault.preempt", "fault.skip", "fault.spike", "fault.rollback",
         "fault.halt", "fault.poison_batch", "fault.fetch_retry",
+        "serve.preempt",  # SIGTERM noticed by the serving front end (drain begins)
         "generate",  # pre-`request` legacy rows (obs_report still reads them)
     }
 )
@@ -430,6 +446,27 @@ def validate_events(
             for field in _REQUIRED_FIELDS.get(kind, ()):
                 if field not in row:
                     problems.append(f"{name}:{i + 1} [{kind}]: missing field {field!r}")
+            if kind == "request" and "outcome" in row:
+                # outcome is validated against the CLOSED vocabulary: a
+                # missing outcome is a hard failure (required field above),
+                # an unknown one only a forward-compat warning — an older
+                # gate must survive a newer library's taxonomy
+                outcome = row["outcome"]
+                if not isinstance(outcome, str):
+                    problems.append(
+                        f"{name}:{i + 1} [request]: outcome {outcome!r} is not a string"
+                    )
+                elif (
+                    warnings_out is not None
+                    and outcome not in REQUEST_OUTCOMES
+                    and ("outcome", outcome) not in unknown_seen
+                ):
+                    unknown_seen.add(("outcome", outcome))
+                    warnings_out.append(
+                        f"{name}:{i + 1} [request]: unknown outcome {outcome!r} "
+                        f"(known: {', '.join(sorted(REQUEST_OUTCOMES))}; "
+                        "newer stream? tolerated — forward-compatible)"
+                    )
     if strict_spans:
         span_ids = {r.get("span_id") for r in rows if r.get("event") == "span"}
         for r in rows:

@@ -13,6 +13,11 @@ checked on the way through:
 - ``slo_ttft`` / ``slo_tpot`` — a ``request`` row breaching the declared
   :class:`SLOBounds` (per-request TTFT, histogram-derived TPOT p99);
 - ``error`` — a ``request`` row with ``outcome="error"``;
+- ``timeout`` — a ``request`` row with ``outcome="timeout"`` (a deadline
+  died mid-decode or expired in the queue — Shedline,
+  docs/robustness.md#serving-hardening);
+- ``breaker`` — a ``serve.breaker`` transition to ``open`` (the serving
+  front end's circuit breaker tripped on error rate or a sentinel);
 - ``blast`` — a ``probe.blast`` blast-radius report (Probeline sentinel
   attribution, obs/probes.py);
 - ``sentinel`` — a ``fault.spike`` / ``fault.halt`` sentinel trip;
@@ -143,6 +148,11 @@ class FlightRecorder:
         if event == "request":
             if row.get("outcome") == "error":
                 return "error"
+            if row.get("outcome") == "timeout":
+                # a deadline-expired request (Shedline, mid-decode or
+                # queue-expired) is an incident worth a frozen ring; a
+                # "shed" or "cancelled" outcome is a policy decision, not one
+                return "timeout"
             ttft = row.get("ttft_s")
             if (
                 self.slo.ttft_s is not None
@@ -161,6 +171,10 @@ class FlightRecorder:
             return "blast"
         elif event in ("fault.spike", "fault.halt"):
             return "sentinel"
+        elif event == "serve.breaker" and row.get("state") == "open":
+            # the circuit breaker tripping IS the post-mortem moment: the
+            # ring holds the error/sentinel rows that opened it
+            return "breaker"
         return None
 
     def ring(self) -> List[Dict]:

@@ -291,6 +291,7 @@ def run_load(
     snapshot_interval_s: float = 30.0,
     generate_fns: Optional[Dict[int, Callable]] = None,
     sleep: Callable[[float], None] = time.sleep,
+    clock: Optional[Callable[[], float]] = None,
 ) -> LoadReport:
     """Drive ``n_requests`` of ``spec``'s stream through the instrumented
     generate path and return a :class:`LoadReport`.
@@ -303,7 +304,14 @@ def run_load(
     ``generate_fns`` reuses a previous report's compiled per-budget fns.
     Every request emits its ``request`` event / span through ``events`` and
     publishes into ``registry`` (fresh one when None); the run closes with
-    one ``load.summary`` event."""
+    one ``load.summary`` event.
+
+    The open-loop worker's pacing is fully injectable: ``sleep`` (like
+    ``call_with_retry``) plus ``clock`` (default ``time.perf_counter``) —
+    pass a ``serving.faultinject.ManualClock`` as ``clock=`` with its
+    ``.sleep`` as ``sleep=`` and the run is wall-clock-free: the schedule,
+    queue waits and duration all come off the manual timeline, so overload
+    chaos scenarios reproduce bit-identically in CI."""
     import jax
     import jax.numpy as jnp
 
@@ -367,7 +375,8 @@ def run_load(
             rec.outcome, rec.error = "error", repr(e)
         return rec
 
-    t0 = time.perf_counter()
+    clock = clock if clock is not None else time.perf_counter
+    t0 = clock()
     epoch0 = time.time()
     if mode == "closed":
         queue: deque = deque()
@@ -377,21 +386,21 @@ def run_load(
             next_i += 1
         while queue:
             rs, enq = queue.popleft()
-            now = time.perf_counter()
+            now = clock()
             records.append(execute(rs, max(now - enq, 0.0), epoch0 + (enq - t0)))
             if next_i < len(specs):
-                queue.append((specs[next_i], time.perf_counter()))
+                queue.append((specs[next_i], clock()))
                 next_i += 1
     else:
         offsets = arrival_schedule(len(specs), rate_rps, seed=spec.seed + 1)
         for rs, off in zip(specs, offsets):
             arrival = t0 + off
-            now = time.perf_counter()
+            now = clock()
             if now < arrival:
                 sleep(arrival - now)
-                now = time.perf_counter()
+                now = clock()
             records.append(execute(rs, max(now - arrival, 0.0), epoch0 + off))
-    duration_s = time.perf_counter() - t0
+    duration_s = clock() - t0
 
     summary = summarize_load(
         records, duration_s, registry=registry, mode=mode,

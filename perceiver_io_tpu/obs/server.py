@@ -9,7 +9,9 @@ zero new dependencies — the same constraint as every obs consumer):
 - ``GET /metrics`` — ``MetricsRegistry.to_prometheus()`` text exposition
   (cumulative ``_bucket{le=...}`` + ``+Inf`` + ``_sum``/``_count`` per
   histogram, so standard ``histogram_quantile`` PromQL works against it);
-- ``GET /healthz`` — liveness JSON (status, uptime, metric count);
+- ``GET /healthz`` — liveness JSON (status, uptime, metric count), merged
+  with an optional ``health=`` provider's dict — the serving front end
+  publishes circuit-breaker state / queue depth / drain status here;
 - ``GET /slo`` — ``obs.slo.build_slo_report`` over the run directory's
   live event stream: the per-request TTFT/TPOT/queue-wait aggregate as of
   *now*, which is what an SLO dashboard or the multi-tenant road's
@@ -42,6 +44,13 @@ class ObsServer:
         (None: the default process-wide registry).
     :param run_dir: the run directory whose event stream backs ``/slo``
         (None: ``/slo`` answers 404).
+    :param health: optional zero-arg callable whose dict is merged into the
+        ``/healthz`` body AFTER the defaults — a serving front end passes
+        ``RequestFrontEnd.health`` so the endpoint reports circuit-breaker
+        state, queue depth and drain status (and may override ``status``:
+        a load balancer stops routing to a draining or breaker-open
+        process). A raising provider degrades to ``health_error`` in the
+        body — the liveness answer itself must never fail.
     """
 
     def __init__(
@@ -50,6 +59,7 @@ class ObsServer:
         run_dir: Optional[str] = None,
         host: str = "127.0.0.1",
         port: int = 0,
+        health=None,
     ):
         if registry is None:
             from perceiver_io_tpu.obs.metrics import default_registry
@@ -57,6 +67,7 @@ class ObsServer:
             registry = default_registry()
         self.registry = registry
         self.run_dir = run_dir
+        self.health = health
         self.host = host
         self.port = int(port)  # rebound to the real port by start()
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -121,12 +132,18 @@ class ObsServer:
                     req, 200, body, "text/plain; version=0.0.4; charset=utf-8"
                 )
             elif path == "/healthz":
-                self._json(req, 200, {
+                body = {
                     "status": "ok",
                     "uptime_s": round(time.time() - self._t0, 3),
                     "n_metrics": len(self.registry),
                     "run_dir": self.run_dir,
-                })
+                }
+                if self.health is not None:
+                    try:
+                        body.update(dict(self.health()))
+                    except Exception as e:  # noqa: BLE001 — liveness must answer
+                        body["health_error"] = repr(e)
+                self._json(req, 200, body)
             elif path == "/slo":
                 self._json(req, *self._slo())
             else:

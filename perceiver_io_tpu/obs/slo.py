@@ -53,16 +53,30 @@ def build_slo_report(events: List[Dict]) -> Optional[Dict]:
     warm = [r for r in ok if not r.get("compiled")]
     latency_pool, warm_only = (warm, True) if warm else (ok, False)
 
+    # admitted = everything the serving path actually owned; shed requests
+    # were rejected at admission (Shedline) and must not dilute the
+    # served-path accounting: error/timeout/cancelled rates are over
+    # ADMITTED requests (10 admitted all failing + 90 shed is a 100% error
+    # rate, not 10%), shed_rate is over ALL traffic (it is a share-of-
+    # traffic fact). Without shedding upstream, n_admitted == n_requests
+    # and every rate means what it always did.
+    n_admitted = len(requests) - outcomes.get("shed", 0)
     report: Dict = {
         "schema_version": SLO_REPORT_SCHEMA_VERSION,
         "n_requests": len(requests),
+        "n_admitted": n_admitted,
         "outcomes": outcomes,
-        "error_rate": round(outcomes.get("error", 0) / len(requests), 6),
+        "error_rate": round(outcomes.get("error", 0) / max(n_admitted, 1), 6),
         "tokens_in": sum(int(r.get("prompt_len", 0)) * int(r.get("batch", 1)) for r in requests),
         "tokens_out": sum(int(r.get("tokens_out", 0)) * int(r.get("batch", 1)) for r in requests),
         "warm_only": warm_only,
         "n_latency_requests": len(latency_pool),
     }
+    if outcomes.get("shed"):
+        report["shed_rate"] = round(outcomes["shed"] / len(requests), 6)
+    for o in ("timeout", "cancelled"):
+        if outcomes.get(o):
+            report[f"{o}_rate"] = round(outcomes[o] / max(n_admitted, 1), 6)
     if latency_pool:
         ttfts = [float(r["ttft_s"]) for r in latency_pool if r.get("ttft_s") is not None]
         if ttfts:

@@ -1,0 +1,53 @@
+"""Shedline — the hardened serving front end (ISSUE 12).
+
+Host-side request admission over ``generation.make_instrumented_generate_fn``:
+a bounded, deadline-aware admission queue with first-class load shedding
+(``serving.frontend.RequestFrontEnd``), mid-decode deadline enforcement and
+cancellation through the ``on_token`` streaming seam, an error-rate/
+sentinel-fed circuit breaker with RetryPolicy-spaced half-open probes
+(``serving.breaker``), bounded retry for transient pre-decode failures,
+graceful SIGTERM drain, and the clean-books invariant — every submitted
+request reaches exactly one terminal outcome
+(``ok | error | timeout | shed | cancelled``), auditable via
+``RequestFrontEnd.books()``. ``serving.faultinject`` provides the
+deterministic fault injector and manual clock ``tools/chaos.py``'s
+``serve_*`` scenarios certify the whole shell with.
+
+See docs/robustness.md#serving-hardening.
+"""
+
+from perceiver_io_tpu.serving.breaker import (  # noqa: F401
+    STATE_VALUES,
+    BreakerConfig,
+    CircuitBreaker,
+)
+from perceiver_io_tpu.serving.faultinject import (  # noqa: F401
+    FaultInjector,
+    InjectedFault,
+    ManualClock,
+    poison_params,
+)
+from perceiver_io_tpu.serving.frontend import (  # noqa: F401
+    SHED_REASONS,
+    TERMINAL_OUTCOMES,
+    FrontEndConfig,
+    FrontEndRecord,
+    DecodePathFailure,
+    RequestFrontEnd,
+)
+
+__all__ = [
+    "STATE_VALUES",
+    "BreakerConfig",
+    "CircuitBreaker",
+    "FaultInjector",
+    "InjectedFault",
+    "ManualClock",
+    "poison_params",
+    "SHED_REASONS",
+    "TERMINAL_OUTCOMES",
+    "FrontEndConfig",
+    "FrontEndRecord",
+    "DecodePathFailure",
+    "RequestFrontEnd",
+]

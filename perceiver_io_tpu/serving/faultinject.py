@@ -1,0 +1,214 @@
+"""Deterministic serving-path fault injection — the chaos half of Shedline.
+
+The training chaos harness injects faults by poisoning *batches* at known
+fetch indices; the serving equivalent injects at known **(request index,
+token index)** coordinates through the host-side seams the front end
+already owns, so no failure needs wall-clock, randomness at run time, or a
+cooperating model:
+
+- :meth:`FaultInjector.kill_at` — raise an :class:`InjectedFault` from the
+  ``on_token`` seam mid-decode (the "worker died between tokens" class);
+  the request books as ``error``, its slot must come back.
+- :meth:`FaultInjector.stall_at` — advance the injected :class:`ManualClock`
+  by N seconds at a token boundary (a latency stall the deadline enforcer
+  sees without anyone actually sleeping); under a real clock it degrades to
+  a real ``sleep``.
+- :meth:`FaultInjector.fail_prefill` — raise a transient (``OSError``-class
+  by default) exception BEFORE the decode starts, n times — the class the
+  front end's bounded pre-decode retry must absorb.
+- :meth:`FaultInjector.poison_at` — hand the front end a params tree with a
+  planted NaN for that request: the logits genuinely go non-finite through
+  the real compiled decode, the Probeline health gauges report
+  ``nonfinite_logit_frac > 0``, and the front end's sentinel feed opens the
+  circuit breaker — the injection exercises the whole in-graph detection
+  path, not a mock.
+
+Explicit coordinates make scenarios exactly replayable;
+:meth:`seeded_kills` draws coordinates from a seeded generator for
+soak-style runs (deterministic for a given seed, same discipline as
+``WorkloadSpec``). Every injection that fires is appended to
+:attr:`injected` so a scenario can assert the plan actually executed.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+
+class InjectedFault(RuntimeError):
+    """A deliberately injected serving failure (never retried as transient
+    unless the scenario injects a transient type on purpose)."""
+
+
+class ManualClock:
+    """A monotonic clock that only moves when told to — the wall-clock-free
+    substrate of the serving chaos scenarios.
+
+    Callable (``clock()`` -> seconds) so it drops into every ``clock=``
+    seam (front end, breaker, ``run_load``); ``advance``/``advance_to``
+    move it forward (never backward); ``sleep`` is the matching injectable
+    sleep — sleeping *advances* the clock, so backoff schedules and
+    open-loop pacing run instantly but remain visible in the timeline.
+    """
+
+    def __init__(self, start: float = 0.0):
+        self.now = float(start)
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, dt: float) -> float:
+        if dt < 0:
+            raise ValueError(f"ManualClock only moves forward, got dt={dt}")
+        self.now += float(dt)
+        return self.now
+
+    def advance_to(self, t: float) -> float:
+        self.now = max(self.now, float(t))
+        return self.now
+
+    def sleep(self, dt: float) -> None:
+        self.advance(max(float(dt), 0.0))
+
+
+def poison_params(params, path_filter: Optional[str] = None):
+    """A copy of ``params`` with one NaN planted in the first float leaf
+    (optionally the first whose path contains ``path_filter``) — the
+    smallest real perturbation that makes the compiled decode's logits
+    non-finite."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(params)
+    poisoned = False
+    out = []
+    for path, leaf in leaves:
+        key = jax.tree_util.keystr(path)
+        if (
+            not poisoned
+            and hasattr(leaf, "dtype")
+            and jnp.issubdtype(leaf.dtype, jnp.floating)
+            and (path_filter is None or path_filter in key)
+        ):
+            arr = np.asarray(leaf).copy()
+            arr.reshape(-1)[0] = np.nan
+            leaf = jnp.asarray(arr, dtype=leaf.dtype)
+            poisoned = True
+        out.append(leaf)
+    if not poisoned:
+        raise ValueError(f"no float leaf to poison (path_filter={path_filter!r})")
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+class FaultInjector:
+    """Deterministic (request, token)-coordinate fault schedule.
+
+    The front end calls the three hooks; an injector with an empty plan is
+    a no-op on every path. ``clock`` (a :class:`ManualClock` or None) is
+    what stalls advance; without one they fall back to ``sleep``
+    (default ``time.sleep`` — real stalls on a real clock).
+    """
+
+    def __init__(self, clock: Optional[ManualClock] = None,
+                 sleep: Callable[[float], None] = time.sleep):
+        self._clock = clock
+        self._sleep = sleep
+        self._kills: Dict[Tuple[int, int], Callable[[], BaseException]] = {}
+        self._stalls: Dict[Tuple[int, Optional[int]], float] = {}
+        self._prefill_fails: Dict[int, List[BaseException]] = {}
+        self._poisoned: set = set()
+        self.injected: List[dict] = []  # audit: what actually fired
+
+    # -- planning -----------------------------------------------------------
+
+    def kill_at(self, request_index: int, token_index: int,
+                exc: Optional[Callable[[], BaseException]] = None) -> "FaultInjector":
+        """Raise mid-decode after token ``token_index`` of request
+        ``request_index`` streams. ``exc`` is a zero-arg exception factory
+        (default: :class:`InjectedFault`)."""
+        self._kills[(int(request_index), int(token_index))] = exc or (
+            lambda: InjectedFault(
+                f"injected kill at request {request_index} token {token_index}"
+            )
+        )
+        return self
+
+    def stall_at(self, request_index: Optional[int], token_index: int,
+                 seconds: float) -> "FaultInjector":
+        """Stall ``seconds`` at token ``token_index``; ``request_index``
+        None applies to EVERY request (the overload scenario's uniform
+        service-time lever)."""
+        self._stalls[(None if request_index is None else int(request_index),
+                      int(token_index))] = float(seconds)
+        return self
+
+    def fail_prefill(self, request_index: int, times: int = 1,
+                     exc_type: type = OSError) -> "FaultInjector":
+        """Fail the next ``times`` pre-decode attempts of the request with
+        ``exc_type`` (default ``OSError`` — a transient the retry policy
+        covers)."""
+        self._prefill_fails[int(request_index)] = [
+            exc_type(f"injected prefill failure {i + 1}/{times} "
+                     f"(request {request_index})")
+            for i in range(int(times))
+        ]
+        return self
+
+    def poison_at(self, request_index: int) -> "FaultInjector":
+        """NaN-poison the params served to this request (see
+        :func:`poison_params`)."""
+        self._poisoned.add(int(request_index))
+        return self
+
+    def seeded_kills(self, n_requests: int, rate: float, max_token: int = 4,
+                     seed: int = 0) -> "FaultInjector":
+        """Draw kill coordinates from a seeded generator: each request is
+        killed with probability ``rate`` at a uniform token index in
+        ``[1, max_token]`` — deterministic for a given seed."""
+        import numpy as np
+
+        rng = np.random.default_rng(seed)
+        for i in range(int(n_requests)):
+            if rng.random() < rate:
+                self.kill_at(i, int(rng.integers(1, max_token + 1)))
+        return self
+
+    # -- the front end's hooks ----------------------------------------------
+
+    def on_token(self, request_index: int, token_index: int) -> None:
+        """Called from the decode ``on_token`` seam; stalls first (the
+        deadline enforcer downstream must see the advanced clock), then
+        kills."""
+        for key in ((request_index, token_index), (None, token_index)):
+            if key in self._stalls:
+                dt = self._stalls[key]
+                self.injected.append({"kind": "stall", "request": request_index,
+                                      "token": token_index, "seconds": dt})
+                if self._clock is not None:
+                    self._clock.advance(dt)
+                else:
+                    self._sleep(dt)
+        exc = self._kills.pop((request_index, token_index), None)
+        if exc is not None:
+            self.injected.append({"kind": "kill", "request": request_index,
+                                  "token": token_index})
+            raise exc()
+
+    def before_attempt(self, request_index: int) -> None:
+        """Called before each pre-decode attempt; raises the next planted
+        transient failure if any remain."""
+        queue = self._prefill_fails.get(request_index)
+        if queue:
+            e = queue.pop(0)
+            self.injected.append({"kind": "prefill_fail", "request": request_index,
+                                  "error": repr(e)})
+            raise e
+
+    def params_for(self, request_index: int, params):
+        """Params the request should be served with (poisoned or not)."""
+        if request_index in self._poisoned:
+            self.injected.append({"kind": "poison", "request": request_index})
+            return poison_params(params)
+        return params

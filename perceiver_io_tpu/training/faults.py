@@ -314,11 +314,19 @@ def call_with_retry(
     policy: RetryPolicy,
     on_retry: Optional[Callable[[int, BaseException, float], None]] = None,
     sleep: Callable[[float], None] = time.sleep,
+    reraise: bool = False,
 ):
     """``fn()`` with ``policy``-bounded retries on its transient exception
     types. ``on_retry(attempt, exc, delay)`` observes each retry (the loader
     surfaces these as ``fault.fetch_retry`` events); ``sleep`` is injectable
-    so tests assert the backoff schedule without waiting it out."""
+    so tests assert the backoff schedule without waiting it out.
+
+    Exhaustion raises :class:`FetchRetriesExhausted` chained to the last
+    error (the loader contract — ``Batches`` callers catch one stable
+    type). ``reraise=True`` instead re-raises the ORIGINAL exception —
+    the serving-path contract (``perceiver_io_tpu.serving``, the same seam
+    the circuit breaker's half-open probes ride): the front end classifies
+    terminal outcomes by the real exception type, not a retry wrapper."""
     last: Optional[BaseException] = None
     for attempt in range(policy.max_retries + 1):
         try:
@@ -331,6 +339,8 @@ def call_with_retry(
             if on_retry is not None:
                 on_retry(attempt, e, d)
             sleep(d)
+    if reraise:
+        raise last
     raise FetchRetriesExhausted(
         f"fetch failed after {policy.max_retries + 1} attempts: {last!r}"
     ) from last

@@ -14,7 +14,7 @@ equivalent with the same task names:
     python tasks.py obs [...]          # observability gate (spans/requests/SLO + obs_diff self-check)
     python tasks.py load [...]         # serving load gate (closed-loop loadgen + flight recorder + /metrics)
     python tasks.py dryrun [...]       # 8-virtual-device multichip certification
-    python tasks.py chaos [...]        # fault-injection gate (preempt/NaN/torn-save/elastic resume)
+    python tasks.py chaos [...]        # fault-injection gate (preempt/NaN/torn-save/elastic resume/serving)
 """
 
 from __future__ import annotations
@@ -139,12 +139,16 @@ def chaos(args):
     """Fault-injection gate (tools/chaos.py; docs/robustness.md): SIGTERM
     preemption + auto-resume equivalence (unsharded AND data x fsdp mesh),
     loader fetch retries, NaN-grad sentinel skip/rollback, torn-save
-    quarantine, and the four mesh-ELASTIC resume scenarios (elastic_shrink
+    quarantine, the four mesh-ELASTIC resume scenarios (elastic_shrink
     8->4, elastic_grow 4->8, flat_to_mesh, mesh_to_flat — kill and resume
     run on different virtual-device topologies, trajectory must match
     <= 1e-6 with a span-attributed resume.reshard event and a clean
-    graphlint pass on the new mesh). Extra args go to tools/chaos.py
-    (e.g. ``--scenarios preempt``)."""
+    graphlint pass on the new mesh), and the five SERVING scenarios
+    (serve_overload / serve_kill_mid_decode / serve_deadline / serve_drain
+    / serve_breaker — the Shedline front end under injected failures, clean
+    books certified, docs/robustness.md#serving-hardening). Extra args go
+    to tools/chaos.py; ``--scenarios`` takes names or fnmatch globs
+    (e.g. ``--scenarios 'serve_*'``)."""
     run(sys.executable, "tools/chaos.py", *args.rest)
 
 
@@ -189,9 +193,12 @@ def perf(args):
     a recorded baseline run directory (``tasks.py obs --out DIR --keep``),
     obs_diff classifies MFU/goodput/step-p99/SLO drift against it under
     declared tolerances (stale = not comparable ≠ regression) — and
-    finally the serving-load smoke gate (``tools/loadgen.py --smoke``:
-    closed-loop load telemetry + flight recorder + LOAD floors). Extra
-    args go to tools/graphcheck.py (e.g. ``--programs train_flat,decode``)."""
+    then the serving-load smoke gate (``tools/loadgen.py --smoke``:
+    closed-loop load telemetry + flight recorder + LOAD floors), and
+    finally the serve-chaos smoke (``tools/chaos.py --scenarios
+    serve_kill_mid_decode``: a mid-decode kill through the hardened front
+    end with the clean-books audit). Extra args go to tools/graphcheck.py
+    (e.g. ``--programs train_flat,decode``)."""
     run(sys.executable, "tools/graphcheck.py", *args.rest)
     run(sys.executable, "tools/graphlint.py", "--fail-on", "error")
     # trace-only on purpose: graphcheck just compiled the same five
@@ -207,6 +214,10 @@ def perf(args):
     # instrumented path — events validate, planted breach -> one flight
     # dump, run-vs-itself diff clean, LOAD_r* ledger floors hold
     run(sys.executable, "tools/loadgen.py", "--smoke")
+    # serve-chaos smoke leg: kill a request mid-decode through the hardened
+    # front end and audit the books (the full serve_* family runs under
+    # `tasks.py chaos`; this pins the books invariant in perf CI)
+    run(sys.executable, "tools/chaos.py", "--scenarios", "serve_kill_mid_decode")
 
 
 def main(argv=None):

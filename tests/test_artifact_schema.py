@@ -178,6 +178,71 @@ def test_serving_observability_event_kinds_pinned(tmp_path):
     assert any("trigger_span_id" in p for p in problems)
 
 
+def test_serving_hardening_event_kinds_and_outcomes_pinned(tmp_path):
+    """The Shedline vocabulary (ISSUE 12): ``serve.breaker`` /
+    ``serve.retry`` / ``serve.drain`` are KNOWN kinds with required-field
+    enforcement, and the ``request`` outcome field is validated against the
+    CLOSED taxonomy — a missing outcome fails, an unknown one only warns
+    (forward compatibility), so shed/timeout accounting can never silently
+    drift under older tooling."""
+    from perceiver_io_tpu.obs.events import (
+        _REQUIRED_FIELDS,
+        EVENT_SCHEMA_VERSION,
+        KNOWN_EVENT_KINDS,
+        REQUEST_OUTCOMES,
+        validate_events,
+    )
+
+    assert REQUEST_OUTCOMES == {"ok", "error", "timeout", "shed", "cancelled"}
+    for kind in ("serve.breaker", "serve.retry", "serve.drain", "serve.preempt"):
+        assert kind in KNOWN_EVENT_KINDS, kind
+    assert set(_REQUIRED_FIELDS["serve.breaker"]) == {"state", "prev", "reason"}
+    assert set(_REQUIRED_FIELDS["serve.retry"]) == {"attempt", "delay_s"}
+    assert set(_REQUIRED_FIELDS["serve.drain"]) == {"books"}
+    assert "outcome" in _REQUIRED_FIELDS["request"]  # missing outcome FAILS
+
+    def write_stream(rows):
+        path = tmp_path / "events.jsonl"
+        with open(path, "w") as f:
+            for row in rows:
+                f.write(json.dumps({"ts": 1.0, "schema_version": EVENT_SCHEMA_VERSION, **row}) + "\n")
+        return str(path)
+
+    req = {"event": "request", "request_id": "r", "batch": 1, "prompt_len": 8,
+           "ttft_s": 0.0, "tokens_out": 0}
+    good = write_stream(
+        [
+            {"event": "serve.breaker", "state": "open", "prev": "closed",
+             "reason": "error-rate", "error_rate": 0.5},
+            {"event": "serve.retry", "attempt": 0, "delay_s": 0.01, "error": "x"},
+            {"event": "serve.drain", "finished": 3, "books": {"balanced": True}},
+            *({**req, "outcome": o} for o in sorted(REQUEST_OUTCOMES)),
+        ]
+    )
+    warnings_out = []
+    assert validate_events(good, strict_spans=False, warnings_out=warnings_out) == []
+    assert warnings_out == []  # every closed-vocabulary outcome passes silently
+
+    # unknown outcome: warning, never a problem (a newer taxonomy must not
+    # fail an older gate); non-string outcome: a problem
+    odd = write_stream([{**req, "outcome": "evicted"}, {**req, "outcome": 3}])
+    warnings_out = []
+    problems = validate_events(odd, strict_spans=False, warnings_out=warnings_out)
+    assert any("not a string" in p for p in problems) and len(problems) == 1
+    assert len(warnings_out) == 1 and "evicted" in warnings_out[0]
+
+    # missing outcome / missing required serve.* fields: hard failures
+    bad = write_stream([
+        {k: v for k, v in {**req, "outcome": "ok"}.items() if k != "outcome"},
+        {"event": "serve.breaker", "state": "open"},
+        {"event": "serve.drain", "finished": 1},
+    ])
+    problems = validate_events(bad, strict_spans=False)
+    assert any("[request]: missing field 'outcome'" in p for p in problems)
+    assert any("[serve.breaker]: missing field 'prev'" in p for p in problems)
+    assert any("[serve.drain]: missing field 'books'" in p for p in problems)
+
+
 def test_load_rounds_monotone_and_well_formed():
     """LOAD_r*.json — the committed serving-load artifacts (ISSUE 11):
     contiguous round numbering and the machine-read surface the load gate's

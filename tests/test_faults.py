@@ -232,6 +232,52 @@ def test_retry_succeeds_midway_and_reports():
     assert seen == [0, 1]
 
 
+def test_retry_policy_delay_jitter_bounds():
+    """The probe-spacing contract the serving breaker reuses (ISSUE 12):
+    delay(k) = min(base * 2^k, max_delay) scaled by exactly [1-j, 1+j),
+    deterministic per (seed, attempt), and the max_delay cap applies BEFORE
+    the jitter scale (a capped delay still decorrelates)."""
+    policy = RetryPolicy(max_retries=9, base_delay=0.1, max_delay=2.0, jitter=0.25)
+    for k in range(10):
+        nominal = min(0.1 * 2**k, 2.0)
+        d = policy.delay(k)
+        assert (1 - 0.25) * nominal <= d <= (1 + 0.25) * nominal, (k, d)
+        assert d == policy.delay(k)  # deterministic per attempt
+    # deep attempts: capped nominal, jitter still spreads them
+    deep = {policy.delay(k) for k in range(6, 10)}
+    assert len(deep) > 1 and all(1.5 <= d <= 2.5 for d in deep)
+    # jitter=0: the exact uncapped/capped schedule, no randomness
+    exact = RetryPolicy(base_delay=0.1, max_delay=2.0, jitter=0.0)
+    assert [exact.delay(k) for k in range(6)] == [0.1, 0.2, 0.4, 0.8, 1.6, 2.0]
+    # different seeds draw different scales at the same attempt
+    assert RetryPolicy(jitter=0.25, seed=1).delay(0) != RetryPolicy(jitter=0.25, seed=2).delay(0)
+
+
+def test_call_with_retry_reraise_original_for_serving_path():
+    """The serving-path mode (ISSUE 12): ``reraise=True`` re-raises the
+    ORIGINAL exception instance on exhaustion — the front end (and the
+    breaker's half-open probes riding it) classify terminal outcomes by the
+    real exception type, never a retry wrapper. The loader default is
+    unchanged: one stable ``FetchRetriesExhausted`` with the cause chained."""
+    boom = OSError("persistent store outage")
+    calls, seen = [], []
+
+    def always_fails():
+        calls.append(1)
+        raise boom
+
+    policy = RetryPolicy(max_retries=2, base_delay=0.01)
+    with pytest.raises(OSError) as ei:
+        call_with_retry(always_fails, policy, on_retry=lambda a, e, d: seen.append(a),
+                        sleep=lambda _: None, reraise=True)
+    assert ei.value is boom  # the exact instance, not a wrapper
+    assert len(calls) == 3 and seen == [0, 1]
+    # default mode still wraps (the Batches/loader contract is untouched)
+    with pytest.raises(FetchRetriesExhausted) as ei:
+        call_with_retry(always_fails, policy, sleep=lambda _: None)
+    assert ei.value.__cause__ is boom
+
+
 def test_retry_non_transient_propagates_immediately():
     calls = []
 

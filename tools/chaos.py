@@ -46,6 +46,37 @@ meshes, and the resumed train step must lint clean on the new mesh:
 - ``mesh_to_flat``   — kill under {data:2, fsdp:2} on 4, resume unsharded
                        on 1 (pod gone; limp home on one chip).
 
+Serving scenarios (Shedline, perceiver_io_tpu/serving,
+docs/robustness.md#serving-hardening) — the hardened front end under
+injected serving failures, all wall-clock-free on a ``ManualClock``; every
+scenario closes with a clean-books audit (every submitted request at
+exactly one terminal outcome, zero leaked worker slots):
+
+- ``serve_overload``        — open-loop arrivals outpace an injected 100 ms
+                              service time: admission sheds (first-class
+                              ``shed`` events, never silent), queue depth
+                              stays bounded, warm TTFT p99 of ADMITTED
+                              requests holds the declared SLO, and
+                              ``/healthz``+``/slo`` report it all live.
+- ``serve_kill_mid_decode`` — a request dies between tokens: books close
+                              (``error``), the slot comes back, exactly one
+                              flight dump names the dead request's span.
+- ``serve_deadline``        — an injected stall blows a deadline
+                              mid-decode: the ``on_token`` seam cancels,
+                              the ``timeout`` event carries the partial
+                              TTFT/TPOT, one ``timeout`` dump names it.
+- ``serve_drain``           — a REAL SIGTERM mid-run: admission stops
+                              (late submissions shed ``draining``), queued
+                              work finishes, ``serve.drain`` carries the
+                              balanced final books.
+- ``serve_breaker``         — consecutive injected errors open the circuit
+                              breaker (shed ``breaker_open``, one
+                              ``breaker`` dump); the RetryPolicy-spaced
+                              half-open probe closes it on the manual clock.
+
+``--scenarios`` accepts fnmatch globs: ``--scenarios 'serve_*'`` runs the
+serving family standalone, ``--scenarios 'elastic_*,preempt'`` composes.
+
 Every injection is count-/step-deterministic (no wall-clock, no randomness
 outside seeded generators), so failures reproduce exactly.
 """
@@ -553,6 +584,319 @@ def scenario_mesh_to_flat(tmp, phase=None):
     _elastic(tmp, "mesh_to_flat", phase)
 
 
+# ---------------------------------------------------------------------------
+# serving scenarios (Shedline): the hardened front end under injected
+# serving failures — deterministic on a ManualClock, clean books certified
+# ---------------------------------------------------------------------------
+
+_SERVE_MODEL = {}
+
+
+def _serving_model():
+    """The serve_* scenarios run THE SAME tiny gate model as `tasks.py
+    load` (tools/loadgen.py ``build_workload`` — one definition, so a
+    geometry tweak there cannot desynchronize the two gates); cached per
+    process."""
+    if not _SERVE_MODEL:
+        import importlib.util
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "loadgen_cli", os.path.join(repo, "tools", "loadgen.py")
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        model, params, _config = mod.build_workload()
+        _SERVE_MODEL.update(model=model, params=params)
+    return _SERVE_MODEL["model"], _SERVE_MODEL["params"]
+
+
+def _serve_env(tmp, tag, slo_ttft=None):
+    """``(recorder, clock, run_dir)`` for one scenario — the recorder IS
+    the event sink (it wraps a fresh EventLog over ``run_dir``)."""
+    from perceiver_io_tpu.obs.events import EventLog
+    from perceiver_io_tpu.obs.flightrec import FlightRecorder, SLOBounds
+    from perceiver_io_tpu.serving import ManualClock
+
+    run_dir = os.path.join(tmp, tag)
+    events = EventLog(run_dir, main_process=True)
+    recorder = FlightRecorder(events, out_dir=run_dir, slo=SLOBounds(ttft_s=slo_ttft))
+    return recorder, ManualClock(), run_dir
+
+
+def _serve_spec():
+    from perceiver_io_tpu.obs.loadgen import WorkloadSpec
+
+    # one compiled geometry (prompt 10, 4 new tokens): the scenarios certify
+    # accounting, not the compile cache
+    return WorkloadSpec(seed=7, prompt_lens=(10,), max_new_tokens=(4,))
+
+
+def _audit_serving(frontend, run_dir, tag):
+    """The clean-books + stream-integrity audit every serve_* scenario ends
+    with: books balance exactly, zero leaked slots, the event stream
+    validates with NO problems and NO forward-compat warnings."""
+    from perceiver_io_tpu.obs.events import validate_events
+
+    problems = frontend.audit()
+    assert not problems, f"{tag}: books audit failed: {problems}"
+    warnings_out = []
+    stream_problems = validate_events(run_dir, warnings_out=warnings_out)
+    assert not stream_problems, f"{tag}: event stream invalid: {stream_problems}"
+    assert not warnings_out, f"{tag}: unexpected schema warnings: {warnings_out}"
+    return frontend.books()
+
+
+def _stream(run_dir):
+    from perceiver_io_tpu.obs.events import merged_events
+
+    return merged_events(run_dir)
+
+
+def scenario_serve_overload(tmp):
+    """Open-loop overload: arrivals at 50 req/s against an injected 100 ms
+    service time. Admission must shed (honestly stamped), queue depth must
+    stay bounded by the deadline, and warm TTFT p99 for ADMITTED requests
+    must hold the declared SLO — all live on /healthz and /slo."""
+    import json as _json
+    import urllib.request
+
+    from perceiver_io_tpu.obs.server import ObsServer
+    from perceiver_io_tpu.obs.slo import build_slo_report
+    from perceiver_io_tpu.serving import FaultInjector, FrontEndConfig, RequestFrontEnd
+
+    ttft_slo, deadline, service = 1.0, 0.5, 0.1
+    model, params = _serving_model()
+    events, clock, run_dir = _serve_env(tmp, "serve_overload", slo_ttft=ttft_slo)
+    injector = FaultInjector(clock=clock).stall_at(None, 1, service)
+    fe = RequestFrontEnd(
+        model, params, num_latents=4,
+        config=FrontEndConfig(max_queue=32, est_service_s=service),
+        events=events, clock=clock, sleep=clock.sleep, injector=injector,
+    )
+    with ObsServer(registry=fe.registry, run_dir=run_dir, health=fe.health) as server:
+        recs = fe.run_open(_serve_spec().draw(40, 64), rate_rps=50.0,
+                           deadline_s=deadline, seed=11)
+        assert len(recs) == 40  # every arrival got a record, shed or served
+        with urllib.request.urlopen(server.url + "/healthz", timeout=10) as r:
+            health = _json.loads(r.read())
+        with urllib.request.urlopen(server.url + "/slo", timeout=10) as r:
+            slo_live = _json.loads(r.read())
+    books = _audit_serving(fe, run_dir, "serve_overload")
+    assert books["shed"] > 0 and books["ok"] > 0, books
+    # borderline admits (projected wait ~= deadline) die mid-decode as
+    # timeouts — also terminal, also accounted: nothing vanishes
+    assert books["ok"] + books["timeout"] == books["admitted"], books
+    # bounded queue: the deadline projection admits at most ~deadline/service
+    # requests' worth of work ahead — far below the 32-deep queue cap
+    bound = int(deadline / service) + 2
+    assert books["max_queue_depth"] <= bound, (
+        f"queue depth {books['max_queue_depth']} > deadline-implied bound {bound}"
+    )
+    report = build_slo_report(_stream(run_dir))
+    assert report["n_requests"] == 40 and report["outcomes"]["shed"] == books["shed"]
+    assert report.get("shed_rate", 0) > 0, "shed traffic not accounted in the SLO report"
+    # the NON-vacuous admission guarantee, on the injected clock: admitted
+    # requests waited at most ~their deadline (disable shedding and queue
+    # waits grow to multiple seconds here — this is the assertion that
+    # fails when admission control breaks; TTFT is real wall time on a
+    # tiny CPU model, so its SLO check below guards the serving path, not
+    # the queue)
+    queue_p99 = report["queue_wait_s"]["p99"]
+    assert queue_p99 <= deadline, (
+        f"admitted-request queue-wait p99 {queue_p99}s exceeds the "
+        f"{deadline}s deadline — admission projection is not bounding the queue"
+    )
+    ttft_p99 = report["ttft_s"]["p99"]
+    assert ttft_p99 <= ttft_slo, (
+        f"warm TTFT p99 {ttft_p99}s breaches the declared {ttft_slo}s SLO"
+    )
+    # every shed left a first-class request row — never a silent drop
+    shed_rows = [e for e in _stream(run_dir)
+                 if e.get("event") == "request" and e.get("outcome") == "shed"]
+    assert len(shed_rows) == books["shed"]
+    assert all(e.get("shed_reason") for e in shed_rows)
+    assert health["breaker"]["state"] == "closed" and health["books_balanced"] is True
+    assert slo_live["n_requests"] == 40
+    print(
+        f"chaos: serve_overload ok — {books['ok']} served / {books['timeout']} "
+        f"deadline-timeout / {books['shed']} shed "
+        f"(reasons {sorted({e['shed_reason'] for e in shed_rows})}), queue depth "
+        f"<= {books['max_queue_depth']}, admitted queue-wait p99 {queue_p99}s <= "
+        f"{deadline}s deadline, warm ttft_p99 {ttft_p99}s <= {ttft_slo}s SLO, "
+        "books balanced, /healthz+/slo live"
+    )
+
+
+def scenario_serve_kill_mid_decode(tmp):
+    """A request dies between tokens: the slot is freed, books close with
+    exactly one ``error``, and exactly one flight dump names the dead
+    request's span."""
+    from perceiver_io_tpu.serving import FaultInjector, RequestFrontEnd
+
+    model, params = _serving_model()
+    recorder, clock, run_dir = _serve_env(tmp, "serve_kill")
+    injector = FaultInjector(clock=clock).kill_at(3, 2)
+    fe = RequestFrontEnd(model, params, num_latents=4, events=recorder,
+                         clock=clock, sleep=clock.sleep, injector=injector)
+    recs = fe.run_closed(_serve_spec().draw(8, 64), concurrency=2)
+    books = _audit_serving(fe, run_dir, "serve_kill_mid_decode")
+    assert [r.outcome for r in recs].count("error") == 1 and books["error"] == 1
+    assert books["admitted"] == 8 and books["ok"] == 7, books
+    dead = next(r for r in recs if r.outcome == "error")
+    assert dead.index == 3 and 0 < dead.tokens_out < dead.max_new_tokens, vars(dead)
+    assert [i["kind"] for i in injector.injected] == ["kill"]
+    dumps = recorder.dumps
+    assert len(dumps) == 1 and "flight-error" in os.path.basename(dumps[0]), dumps
+    with open(dumps[0]) as f:
+        dump = json.load(f)
+    err_rows = [e for e in _stream(run_dir)
+                if e.get("event") == "request" and e.get("outcome") == "error"]
+    assert len(err_rows) == 1
+    assert dump["trigger_span_id"] == err_rows[0]["span_id"], (
+        "flight dump does not name the dead request's span"
+    )
+    assert any(e.get("event") == "span" and e.get("span_id") == dump["trigger_span_id"]
+               for e in dump["events"]), "dump ring lacks the named span"
+    print(
+        f"chaos: serve_kill_mid_decode ok — request 3 killed after "
+        f"{dead.tokens_out} token(s), slot freed, books balanced "
+        f"(7 ok / 1 error), 1 flight dump names its span"
+    )
+
+
+def scenario_serve_deadline(tmp):
+    """An injected stall blows a request's deadline mid-decode: the
+    ``on_token`` seam cancels it, the ``timeout`` request event carries the
+    partial TTFT/TPOT, and one ``timeout`` dump names the span."""
+    from perceiver_io_tpu.serving import FaultInjector, RequestFrontEnd
+
+    model, params = _serving_model()
+    recorder, clock, run_dir = _serve_env(tmp, "serve_deadline")
+    injector = FaultInjector(clock=clock).stall_at(2, 1, 5.0)  # >> deadline
+    fe = RequestFrontEnd(model, params, num_latents=4, events=recorder,
+                         clock=clock, sleep=clock.sleep, injector=injector)
+    recs = fe.run_closed(_serve_spec().draw(5, 64), concurrency=1, deadline_s=1.0)
+    books = _audit_serving(fe, run_dir, "serve_deadline")
+    timed_out = [r for r in recs if r.outcome == "timeout"]
+    assert len(timed_out) == 1 and timed_out[0].index == 2, recs
+    assert books["ok"] == 4 and books["timeout"] == 1, books
+    # the partial stream is accounted: >=1 token out before the cut
+    assert 0 < timed_out[0].tokens_out < timed_out[0].max_new_tokens
+    rows = [e for e in _stream(run_dir)
+            if e.get("event") == "request" and e.get("outcome") == "timeout"]
+    assert len(rows) == 1
+    row = rows[0]
+    assert row["tokens_out"] == timed_out[0].tokens_out
+    assert row["ttft_s"] > 0 and row.get("tpot_hist"), (
+        "timeout event lacks the partial TTFT/TPOT it must carry"
+    )
+    dumps = recorder.dumps
+    assert len(dumps) == 1 and "flight-timeout" in os.path.basename(dumps[0]), dumps
+    with open(dumps[0]) as f:
+        dump = json.load(f)
+    assert dump["trigger_span_id"] == row["span_id"]
+    print(
+        f"chaos: serve_deadline ok — request 2 cancelled mid-decode after "
+        f"{timed_out[0].tokens_out} token(s) (5.0s stall vs 1.0s deadline), "
+        "timeout event carries partial TTFT/TPOT, 1 timeout dump names its span"
+    )
+
+
+def scenario_serve_drain(tmp):
+    """A REAL SIGTERM mid-run: the PreemptionGuard flips the front end into
+    drain — admission stops (late submissions shed ``draining``), queued
+    work finishes, and ``serve.drain`` carries the balanced final books."""
+    from perceiver_io_tpu.serving import RequestFrontEnd
+
+    model, params = _serving_model()
+    recorder, clock, run_dir = _serve_env(tmp, "serve_drain")
+    fe = RequestFrontEnd(model, params, num_latents=4, events=recorder,
+                         clock=clock, sleep=clock.sleep)
+    guard = fe.install_guard()
+    try:
+        specs = _serve_spec().draw(7, 64)
+        for s in specs[:5]:
+            fe.submit(s)
+        fe.pump(max_requests=2)
+        os.kill(os.getpid(), signal.SIGTERM)  # the real signal path
+        fe.pump()  # guard noticed at the boundary; queued work still finishes
+        late = [fe.submit(s) for s in specs[5:]]
+        books = fe.drain()
+    finally:
+        guard.uninstall()
+    assert guard.requested and books["draining"] is True
+    assert all(r.outcome == "shed" and r.shed_reason == "draining" for r in late), late
+    assert books["ok"] == 5 and books["shed"] == 2 and books["balanced"], books
+    _audit_serving(fe, run_dir, "serve_drain")
+    stream = _stream(run_dir)
+    assert any(e.get("event") == "serve.preempt" for e in stream), (
+        "no serve.preempt event for the SIGTERM"
+    )
+    drains = [e for e in stream if e.get("event") == "serve.drain"]
+    assert len(drains) == 1 and drains[0]["books"]["balanced"] is True, drains
+    assert drains[0]["books"]["in_flight"] == 0 and drains[0]["books"]["queued"] == 0
+    print(
+        "chaos: serve_drain ok — SIGTERM mid-run, 3 queued requests finished, "
+        "2 late submissions shed as draining, serve.drain books balanced"
+    )
+
+
+def scenario_serve_breaker(tmp):
+    """Consecutive injected errors open the circuit breaker: admissions
+    shed ``breaker_open`` with a ``breaker`` flight dump; after the
+    RetryPolicy-spaced probe delay (stepped on the manual clock) the
+    half-open probe closes it again."""
+    from perceiver_io_tpu.serving import (
+        BreakerConfig,
+        FaultInjector,
+        FrontEndConfig,
+        RequestFrontEnd,
+    )
+    from perceiver_io_tpu.training.faults import RetryPolicy
+
+    model, params = _serving_model()
+    recorder, clock, run_dir = _serve_env(tmp, "serve_breaker")
+    injector = FaultInjector(clock=clock)
+    for i in (1, 2, 3):
+        injector.kill_at(i, 1)
+    cfg = FrontEndConfig(breaker=BreakerConfig(
+        window=4, min_requests=3, error_rate_to_open=0.5,
+        probe_backoff=RetryPolicy(base_delay=2.0, max_delay=10.0, jitter=0.0),
+    ))
+    fe = RequestFrontEnd(model, params, num_latents=4, config=cfg, events=recorder,
+                         clock=clock, sleep=clock.sleep, injector=injector)
+    specs = _serve_spec().draw(10, 64)
+    recs = fe.run_closed(specs[:8], concurrency=1)
+    assert fe.breaker.state == "open", fe.breaker.state
+    breaker_sheds = [r for r in recs if r.shed_reason == "breaker_open"]
+    assert breaker_sheds, "breaker open but nothing shed"
+    # probe spacing is the RetryPolicy schedule: jitter=0 -> exactly base_delay
+    early = fe.submit(specs[8])
+    assert early.outcome == "shed" and early.shed_reason == "breaker_open"
+    clock.advance(2.0)
+    probe = fe.submit(specs[9])
+    fe.pump()
+    assert probe.probe is True and probe.outcome == "ok", vars(probe)
+    assert fe.breaker.state == "closed"
+    books = _audit_serving(fe, run_dir, "serve_breaker")
+    transitions = [(e["prev"], e["state"], e["reason"])
+                   for e in _stream(run_dir) if e.get("event") == "serve.breaker"]
+    assert transitions == [
+        ("closed", "open", "error-rate"),
+        ("open", "half_open", "probe-delay-elapsed"),
+        ("half_open", "closed", "probe-succeeded"),
+    ], transitions
+    assert any("flight-breaker" in os.path.basename(p) for p in recorder.dumps), (
+        recorder.dumps
+    )
+    print(
+        f"chaos: serve_breaker ok — {books['error']} injected errors opened the "
+        f"breaker ({len(breaker_sheds) + 1} shed breaker_open, 1 breaker dump), "
+        "2.0s probe delay on the manual clock, half-open probe closed it"
+    )
+
+
 SCENARIOS = {
     "preempt": scenario_preempt,
     "preempt_mesh": scenario_preempt_mesh,
@@ -564,6 +908,11 @@ SCENARIOS = {
     "elastic_grow": scenario_elastic_grow,
     "flat_to_mesh": scenario_flat_to_mesh,
     "mesh_to_flat": scenario_mesh_to_flat,
+    "serve_overload": scenario_serve_overload,
+    "serve_kill_mid_decode": scenario_serve_kill_mid_decode,
+    "serve_deadline": scenario_serve_deadline,
+    "serve_drain": scenario_serve_drain,
+    "serve_breaker": scenario_serve_breaker,
 }
 
 
@@ -604,7 +953,8 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--scenarios",
         default=",".join(SCENARIOS),
-        help=f"comma-separated subset of: {', '.join(SCENARIOS)}",
+        help="comma-separated scenario names and/or fnmatch globs "
+        f"(e.g. 'serve_*' or 'elastic_*,preempt') over: {', '.join(SCENARIOS)}",
     )
     parser.add_argument("--tmp", default=None, help="scratch dir (default: mkdtemp)")
     parser.add_argument(
@@ -615,10 +965,22 @@ def main(argv=None) -> int:
         "respawns each half with its own virtual-device count)",
     )
     args = parser.parse_args(argv)
-    wanted = [s for s in args.scenarios.split(",") if s]
-    unknown = [s for s in wanted if s not in SCENARIOS]
-    if unknown:
-        parser.error(f"unknown scenarios: {unknown}")
+    # each comma token is a literal name or an fnmatch glob; a token that
+    # matches nothing is a usage error (a typo'd selector silently running
+    # zero scenarios would read as a green gate)
+    import fnmatch
+
+    wanted = []
+    for token in (t.strip() for t in args.scenarios.split(",")):
+        if not token:
+            continue
+        matches = [s for s in SCENARIOS if fnmatch.fnmatch(s, token)]
+        if not matches:
+            parser.error(
+                f"scenario selector {token!r} matches nothing "
+                f"(known: {', '.join(SCENARIOS)})"
+            )
+        wanted.extend(m for m in matches if m not in wanted)
     if args.phase and any(s not in ELASTIC_SCENARIOS for s in wanted):
         parser.error("--phase applies only to the elastic scenarios")
 
