@@ -1004,8 +1004,9 @@ def propagate_shardings(
 
 @dataclasses.dataclass
 class CacheSite:
-    """One KV-cache append (a ``dynamic_update_slice`` under a cache scope):
-    the layout facts the cross-program rule compares."""
+    """One KV-cache append under a cache scope: a ``dynamic_update_slice``
+    (the contiguous discipline) or a ``scatter`` (the paged discipline's
+    page-indexed write) — the layout facts the cross-program rule compares."""
 
     nid: int
     scope: str
@@ -1015,6 +1016,12 @@ class CacheSite:
     update_dims: Tuple[int, ...]  # dims the append writes a sub-range of
     phase: str  # "loop" (inside scan/while) | "prompt"
     index_origin: str  # "carried" | "static" | "input" | "mixed"
+    primitive: str = "dynamic_update_slice"
+    # whether the write index's provenance passes through a gather — the
+    # signature of a page-table-indexed append (the index is LOOKED UP from
+    # a table, not carried directly); what the declared-paged-companion
+    # branch of cross-program-consistency requires
+    index_via_gather: bool = False
 
     @property
     def layout(self) -> tuple:
@@ -1051,14 +1058,26 @@ def _index_origin(df: Dataflow, vids: Sequence[int]) -> str:
     return "mixed"
 
 
+def _index_via_gather(df: Dataflow, vids: Sequence[int]) -> bool:
+    """Whether any write-index operand's backward provenance passes through
+    a gather (``jnp.take``/``take_along_axis`` lower to it) — the
+    page-table-lookup signature the paged companion check requires."""
+    ups = df._reach([("v", v) for v in vids], forward=False)
+    return any(
+        k == "n" and df.nodes[i].primitive == "gather" for k, i in ups
+    )
+
+
 def cache_sites(
-    df: Dataflow, scopes: Sequence[str] = ("*kv_cache_append*",)
+    df: Dataflow, scopes: Sequence[str] = ("*kv_cache_append*", "*paged_kv_append*")
 ) -> List[CacheSite]:
-    """Every cache-append site: ``dynamic_update_slice`` ops whose scope
-    matches one of the cache-scope patterns."""
+    """Every cache-append site whose scope matches one of the cache-scope
+    patterns: ``dynamic_update_slice`` (contiguous discipline) and
+    ``scatter`` (the paged discipline's page-indexed write, ``.at[ids,
+    offs].set``)."""
     out: List[CacheSite] = []
     for node in df.nodes:
-        if node.primitive != "dynamic_update_slice":
+        if node.primitive not in ("dynamic_update_slice", "scatter"):
             continue
         if not any(fnmatch(node.scope, p) for p in scopes):
             continue
@@ -1066,11 +1085,22 @@ def cache_sites(
         upd_aval = df.values[node.invals[1]].aval if len(node.invals) > 1 else None
         if op_aval is None or upd_aval is None:
             continue
-        update_dims = tuple(
-            d
-            for d in range(min(len(op_aval.shape), len(upd_aval.shape)))
-            if upd_aval.shape[d] != op_aval.shape[d]
-        )
+        if node.primitive == "scatter":
+            # scatter eqn operands: (operand, scatter_indices, updates) —
+            # the comparable "update" aval is the updates operand, and the
+            # written dims are whatever the scatter's update window misses;
+            # for layout purposes record no update_dims (the paged pools
+            # have no per-request slot axis to compare)
+            upd_aval = df.values[node.invals[2]].aval if len(node.invals) > 2 else upd_aval
+            idx_vids = [node.invals[1]]
+            update_dims: Tuple[int, ...] = ()
+        else:
+            idx_vids = list(node.invals[2:])
+            update_dims = tuple(
+                d
+                for d in range(min(len(op_aval.shape), len(upd_aval.shape)))
+                if upd_aval.shape[d] != op_aval.shape[d]
+            )
         # the scope tail from the last segment matching a cache label on
         segments = node.scope.split("/")
         tail = node.scope
@@ -1089,7 +1119,9 @@ def cache_sites(
                 rank=len(op_aval.shape),
                 update_dims=update_dims,
                 phase="loop" if in_loop else "prompt",
-                index_origin=_index_origin(df, node.invals[2:]),
+                index_origin=_index_origin(df, idx_vids),
+                primitive=node.primitive,
+                index_via_gather=_index_via_gather(df, idx_vids),
             )
         )
     return out

@@ -281,7 +281,7 @@ def build_targets(
             allow=allow,
         )
 
-    if "prefill" in targets or "decode" in targets:
+    if "prefill" in targets or "decode" in targets or "decode_paged" in targets:
         from perceiver_io_tpu.generation import GenerationConfig, make_generate_fn
 
         prompt = jnp.asarray(rng.integers(0, config.vocab_size, size=(b, n)))
@@ -292,7 +292,7 @@ def build_targets(
                 GenerationConfig(max_new_tokens=new_tokens, do_sample=True, top_k=10),
                 cache_dtype=dtype,
             )
-            # the prefill fn is always built: it is the decode target's
+            # the prefill fn is always built: it is the decode targets'
             # cross-program companion even when only decode is linted
             for tgt, new_tokens in (("prefill", 1), ("decode", g["decode_tokens"]))
         }
@@ -318,7 +318,82 @@ def build_targets(
                 ),
                 allow=allow,
             )
+        if "decode_paged" in targets:
+            # the ENGINE's batched paged decode step (serving.engine drives
+            # the same fn): per-slot lengths/windows/rng chains over paged
+            # caches. Companion = prefill (the disaggregated prompt pass);
+            # the paged appends are DECLARED page-table-indexed, so the
+            # cross-program rule holds them to the paged discipline instead
+            # of ignoring scatter-based writes.
+            fn, args = _build_decode_paged_args(model, config, params, g, dtype)
+            out["decode_paged"] = LintTarget(
+                name="decode_paged",
+                fn=fn,
+                args=args,
+                policy=LintPolicy(
+                    bf16_scopes=bf16_scopes,
+                    collective_budget=collective_budget,
+                    companion=CompanionProgram("prefill", fns["prefill"], (params, prompt)),
+                    paged_cache_scopes=("*paged_kv_append*",),
+                    **dataflow_policy,
+                ),
+                allow=allow,
+            )
     return out
+
+
+# paged-step geometry per flagship geometry: tokens per KV page
+PAGED_PAGE_SIZE = {"micro": 16, "flagship": 64}
+
+
+def _build_decode_paged_args(model, config, params, g: dict, dtype):
+    """The ``decode_paged`` program: ``make_paged_step_fn`` plus a
+    representative mid-serve state — every slot occupied at prompt fill
+    (the graph is shape-only; values just need to be plausible)."""
+    import jax
+    import jax.numpy as jnp
+
+    from perceiver_io_tpu.core.modules import CausalSequenceModel
+    from perceiver_io_tpu.generation import GenerationConfig, make_paged_step_fn
+
+    slots = g["batch"]
+    page = PAGED_PAGE_SIZE.get("flagship" if g["seq_len"] > 4096 else "micro", 16)
+    ca_tokens = g["seq_len"] + g["decode_tokens"]
+    sa_tokens = g["latents"] + g["decode_tokens"]
+    ca_pps = -(-ca_tokens // page)
+    sa_pps = -(-sa_tokens // page)
+    caches = CausalSequenceModel.init_paged_cache(
+        config, slots, page,
+        ca_num_pages=1 + slots * ca_pps, ca_pages_per_slot=ca_pps,
+        sa_num_pages=1 + slots * sa_pps, sa_pages_per_slot=sa_pps,
+        dtype=dtype,
+    )
+
+    def occupied(c, pps, tokens):
+        table = jnp.arange(1, 1 + slots * pps, dtype=jnp.int32).reshape(slots, pps)
+        return dataclasses.replace(
+            c,
+            page_table=table,
+            length=jnp.full((slots,), tokens, jnp.int32),
+        )
+
+    caches = (occupied(caches[0], ca_pps, g["seq_len"]),) + tuple(
+        occupied(c, sa_pps, g["latents"]) for c in caches[1:]
+    )
+    state = {
+        "cache": caches,
+        "ca_start": jnp.zeros((slots,), jnp.int32),
+        "sa_start": jnp.zeros((slots,), jnp.int32),
+        "token": jnp.zeros((slots,), jnp.int32),
+        "rng": jnp.stack([jax.random.PRNGKey(i) for i in range(slots)]),
+        "done": jnp.zeros((slots,), bool),
+        "pad_slots": jnp.zeros((slots, caches[0].capacity), bool),
+        "pos_shift": jnp.zeros((slots, 1), jnp.int32),
+    }
+    fn = make_paged_step_fn(
+        model, GenerationConfig(max_new_tokens=g["decode_tokens"], do_sample=True, top_k=10)
+    )
+    return fn, (params, state)
 
 
 def lint_flagship(
@@ -366,9 +441,11 @@ def lint_flagship(
 # (tasks.py perf): flat train, the Probeline-instrumented flat train (the
 # contract that probes add zero collectives/callbacks and bounded bytes),
 # the GSPMD and overlap-scheduled sharded train steps on the
-# DEFAULT_MESH_SPEC submesh, prefill, decode
+# DEFAULT_MESH_SPEC submesh, prefill, decode, and the engine's batched
+# paged decode step (decode_paged — PR 13 Pageline)
 PROGRAMS = (
-    "train_flat", "train_probed", "train_sharded", "train_overlap", "prefill", "decode"
+    "train_flat", "train_probed", "train_sharded", "train_overlap", "prefill",
+    "decode", "decode_paged",
 )
 DEFAULT_MESH_SPEC = "data=2,fsdp=2"
 
@@ -387,7 +464,7 @@ def build_programs(
     if unknown:
         raise ValueError(f"unknown program(s) {unknown}; known: {PROGRAMS}")
     out: Dict[str, LintTarget] = {}
-    flat = [p for p in ("train_flat", "prefill", "decode") if p in programs]
+    flat = [p for p in ("train_flat", "prefill", "decode", "decode_paged") if p in programs]
     if flat:
         built = build_targets(
             geometry, targets=tuple({"train_flat": "train"}.get(p, p) for p in flat)

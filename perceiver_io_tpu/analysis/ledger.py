@@ -116,10 +116,16 @@ def validate_ledger(ledger: Any) -> List[str]:
         problems.append("floors must be an object")
     else:
         for name, floor in floors.items():
-            if not isinstance(floor, dict) or not {"artifact", "key", "min"} <= set(floor):
-                problems.append(f"floors[{name!r}] must carry artifact/key/min")
-            elif not isinstance(floor["min"], (int, float)):
-                problems.append(f"floors[{name!r}].min must be a number")
+            if not isinstance(floor, dict) or not {"artifact", "key"} <= set(floor):
+                problems.append(f"floors[{name!r}] must carry artifact/key")
+            elif "min" not in floor and "max" not in floor:
+                # a floor pins a direction: min (throughput-like, higher is
+                # better) and/or max (latency-like ceiling, e.g. p99 TPOT)
+                problems.append(f"floors[{name!r}] must carry min and/or max")
+            else:
+                for bound in ("min", "max"):
+                    if bound in floor and not isinstance(floor[bound], (int, float)):
+                        problems.append(f"floors[{name!r}].{bound} must be a number")
     return problems
 
 
@@ -225,9 +231,14 @@ def check_bench_floors(ledger: Optional[dict], repo_root: str) -> List[str]:
                 f"{name}: {os.path.basename(path)}:{floor['key']} missing or non-numeric"
             )
             continue
-        if value < floor["min"]:
+        if "min" in floor and value < floor["min"]:
             failures.append(
                 f"{name}: {os.path.basename(path)}:{floor['key']} = {value} "
                 f"below floor {floor['min']}"
+            )
+        if "max" in floor and value > floor["max"]:
+            failures.append(
+                f"{name}: {os.path.basename(path)}:{floor['key']} = {value} "
+                f"above ceiling {floor['max']}"
             )
     return failures

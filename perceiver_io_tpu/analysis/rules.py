@@ -124,8 +124,19 @@ class LintPolicy:
     # (decode declares prefill as its companion). Inert until declared.
     companion: Optional["CompanionProgram"] = None
     # scope labels that mark cache-append sites (core/attention.py labels
-    # its dynamic_update_slice writes "kv_cache_append")
-    cache_scopes: Tuple[str, ...] = ("*kv_cache_append*",)
+    # its dynamic_update_slice writes "kv_cache_append"; the paged engine
+    # labels its page-indexed scatters "paged_kv_append" — surveyed
+    # everywhere so an undeclared paged append can never hide)
+    cache_scopes: Tuple[str, ...] = ("*kv_cache_append*", "*paged_kv_append*")
+    # cross-program-consistency, paged half: scope labels whose appends this
+    # program DECLARES as page-table-indexed (the decode_paged program
+    # declares "*paged_kv_append*"). A declared paged append must have a
+    # dynamic write index whose provenance walks a table (gather) and a
+    # dtype the companion's prompt pass actually builds; an UNdeclared
+    # scatter-based cache append is flagged — the paged layout is a declared
+    # companion, not an allowlist hole. Empty = this program has no paged
+    # discipline.
+    paged_cache_scopes: Tuple[str, ...] = ()
     # collective-overlap: declare that the compiled module's collectives are
     # meant to overlap compute (the parallel/overlap.py scheduling claim).
     # On async backends (TPU) each *-start/*-done pair must have compute
@@ -974,6 +985,77 @@ def cross_program_consistency(ctx: RuleContext) -> List[Violation]:
     sev = _severity(ctx, "cross-program-consistency")
     out: List[Violation] = []
 
+    # ---- paged half: declared page-table-indexed appends ------------------
+    paged_pats = ctx.policy.paged_cache_scopes
+    paged_sites = [s for s in ours if paged_pats and _match(s.scope, paged_pats)]
+    ours = [s for s in ours if s not in paged_sites]
+    companion_dtypes = {s.dtype for s in theirs}
+    for s in paged_sites:
+        if s.index_origin == "static":
+            out.append(
+                Violation(
+                    rule="cross-program-consistency",
+                    severity=sev,
+                    scope=s.scope,
+                    op=s.primitive,
+                    message=(
+                        "declared-paged cache append has a STATIC write index "
+                        "— the append position does not advance with the "
+                        "decoded length (slots will be overwritten)"
+                    ),
+                )
+            )
+        elif not s.index_via_gather:
+            out.append(
+                Violation(
+                    rule="cross-program-consistency",
+                    severity=sev,
+                    scope=s.scope,
+                    op=s.primitive,
+                    message=(
+                        "declared-paged cache append's write index never "
+                        "walks a page table (no gather in its provenance) — "
+                        "the append is not page-table-indexed; either route "
+                        "it through the page table or undeclare the paged "
+                        "scope"
+                    ),
+                )
+            )
+        if companion_dtypes and s.dtype not in companion_dtypes:
+            out.append(
+                Violation(
+                    rule="cross-program-consistency",
+                    severity=sev,
+                    scope=s.scope,
+                    op=s.primitive,
+                    message=(
+                        f"paged cache append stores dtype {s.dtype} but "
+                        f"{comp.name} builds caches only in "
+                        f"{sorted(companion_dtypes)} — the pool and the "
+                        "prompt pass disagree on storage dtype"
+                    ),
+                )
+            )
+    # an UNdeclared scatter-based cache append is exactly the allowlist hole
+    # the declaration exists to close: flag it rather than letting it fall
+    # through the slice-shaped checks below
+    undeclared = [s for s in ours if s.primitive == "scatter"]
+    ours = [s for s in ours if s.primitive != "scatter"]
+    for s in undeclared:
+        out.append(
+            Violation(
+                rule="cross-program-consistency",
+                severity=sev,
+                scope=s.scope,
+                op="scatter",
+                message=(
+                    "scatter-based cache append without a declared paged "
+                    "companion (policy.paged_cache_scopes) — declare the "
+                    "paged layout or use the contiguous append"
+                ),
+            )
+        )
+
     def multiset(sites):
         counts: Dict[tuple, int] = {}
         for s in sites:
@@ -982,7 +1064,12 @@ def cross_program_consistency(ctx: RuleContext) -> List[Violation]:
 
     our_prompt = [s for s in ours if s.phase == "prompt"]
     their_prompt = [s for s in theirs if s.phase == "prompt"]
-    if multiset(our_prompt) != multiset(their_prompt):
+    # a program running the PAGED discipline (declared) has no contiguous
+    # prompt appends of its own — its prompt pass is the companion program
+    # itself (prefill/decode disaggregation), so the multiset comparison is
+    # vacuous there, not a mismatch
+    skip_prompt_cmp = bool(paged_pats) and not our_prompt
+    if not skip_prompt_cmp and multiset(our_prompt) != multiset(their_prompt):
         ours_only = {k for k in multiset(our_prompt)} - {k for k in multiset(their_prompt)}
         theirs_only = {k for k in multiset(their_prompt)} - {k for k in multiset(our_prompt)}
         out.append(

@@ -43,6 +43,15 @@ from flax import linen as nn
 from flax import struct
 from jax import lax
 
+# the cache disciplines live in core/cache.py (the init/append/view seam the
+# sliding-window and paged paths both dispatch through); re-exported here so
+# every existing `from core.attention import KVCache` keeps working
+from perceiver_io_tpu.core.cache import (  # noqa: F401
+    KVCache,
+    PagedKVCache,
+    init_kv_cache,
+    quantize_kv,
+)
 from perceiver_io_tpu.core.position import apply_rotary_pos_emb
 from perceiver_io_tpu.ops.flash_attention import (
     flash_attention,
@@ -52,86 +61,6 @@ from perceiver_io_tpu.ops.flash_attention import (
     flash_supported,
     packed_supported,
 )
-
-
-@struct.dataclass
-class KVCache:
-    """Fixed-capacity cache: ``k``/``v`` are (B, capacity, C) with valid data
-    in slots [0, length); ``length`` is a traced int32 scalar.
-
-    ``int8`` storage (``init_kv_cache(dtype=jnp.int8)``) keeps per-token
-    symmetric quantization scales in ``k_scale``/``v_scale`` (B, capacity).
-    Decode is HBM-bandwidth-bound (docs/performance.md: batch-8 runs at the
-    chip's physical ceiling), so halving cache bytes buys real throughput —
-    the scales fold into elementwise ops OUTSIDE the two cache GEMMs, and
-    XLA reads the int8 operands at int8 bytes (measured:
-    tools/int8_cache_probe.py, 1.69x on the decode attention core)."""
-
-    k: jnp.ndarray
-    v: jnp.ndarray
-    length: jnp.ndarray
-    k_scale: Optional[jnp.ndarray] = None
-    v_scale: Optional[jnp.ndarray] = None
-
-    @property
-    def capacity(self) -> int:
-        return self.k.shape[1]
-
-    @property
-    def quantized(self) -> bool:
-        return self.k_scale is not None
-
-    def map_slots(self, fn, length=None) -> "KVCache":
-        """Apply ``fn`` to every per-slot array (k, v, and the scales when
-        present) — the one way generation code may rebuild a cache, so
-        slot reorders/rolls/tiles can never drop the scale planes."""
-        return KVCache(
-            k=fn(self.k),
-            v=fn(self.v),
-            length=self.length if length is None else length,
-            k_scale=None if self.k_scale is None else fn(self.k_scale),
-            v_scale=None if self.v_scale is None else fn(self.v_scale),
-        )
-
-
-def quantize_kv(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Per-token symmetric int8 quantization: (B, N, C) -> int8 values and a
-    (B, N) bf16 scale with ``x ~= q * scale``. int8->bf16 is exact (|q| <=
-    127), so dequantization error is the rounding step alone."""
-    x32 = x.astype(jnp.float32)
-    amax = jnp.max(jnp.abs(x32), axis=-1)
-    # round against the scale AS STORED (bf16): quantizing with a more
-    # precise scale than dequantization uses would leak the bf16 rounding
-    # into the error bound (up to ~0.25 extra steps at |q|=127). bf16
-    # rounds to nearest, so the stored scale can be a hair below amax/127;
-    # nudge up one ulp-ish factor to keep |q| <= 127 exactly.
-    scale = jnp.maximum(amax / 127.0, 1e-8).astype(jnp.bfloat16)
-    scale = jnp.where(scale.astype(jnp.float32) * 127.0 < amax, scale * jnp.bfloat16(1.0079), scale)
-    q = jnp.round(x32 / scale.astype(jnp.float32)[..., None])
-    q = jnp.clip(q, -127, 127).astype(jnp.int8)
-    return q, scale
-
-
-def init_kv_cache(
-    batch_size: int,
-    capacity: int,
-    num_qk_channels: int,
-    num_v_channels: int,
-    dtype=jnp.float32,
-) -> KVCache:
-    """Empty cache (length 0) — the analog of the reference's
-    ``empty_kv_cache`` (modules.py:282-285) with pre-allocated capacity.
-    ``dtype=jnp.int8`` selects quantized storage (see :class:`KVCache`)."""
-    scales = None
-    if dtype == jnp.int8:
-        scales = jnp.zeros((batch_size, capacity), jnp.bfloat16)
-    return KVCache(
-        k=jnp.zeros((batch_size, capacity, num_qk_channels), dtype),
-        v=jnp.zeros((batch_size, capacity, num_v_channels), dtype),
-        length=jnp.zeros((), jnp.int32),
-        k_scale=scales,
-        v_scale=scales,
-    )
 
 
 @struct.dataclass
@@ -351,6 +280,89 @@ class MultiHeadAttention(nn.Module):
         )
         return AttentionOutput(last_hidden_state=self.o_proj(o), kv_cache=None)
 
+    def _paged_decode_attend(
+        self, q, cache: PagedKVCache, pad_mask, rope_q, deterministic
+    ) -> AttentionOutput:
+        """Single-token decode attention over a paged cache (n_q == 1, the
+        engine's batched step). Numerically the contiguous decode branch of
+        ``__call__`` — same scaled/rotated block-diagonal query GEMM, same
+        f32 score island, same int8 scale folding — applied to the page
+        pool, so batched paged decode is token-exact vs the sequential
+        contiguous path (pinned by tests/test_paged_engine.py).
+
+        Two routes: the TPU Pallas kernel (ops/paged_attention.py) walks the
+        page table inside its BlockSpec index maps when the ``paged`` kernel
+        feature is on and the geometry qualifies; the default is the
+        ``jax.lax`` gather fallback — one budgeted gather per pool rebuilds
+        the contiguous view (the ``decode_paged`` contract pins that budget
+        and that no kv-axis concatenate appears)."""
+        b, n_q = q.shape[0], q.shape[1]
+        if n_q != 1:
+            raise ValueError(f"paged attention is decode-only (n_q == 1), got n_q={n_q}")
+        h = self.num_heads
+        qk_per_head = self.qk_channels // h
+        d_v = self.v_channels // h
+        q = self._split_heads(q, qk_per_head) * qk_per_head**-0.5
+        if rope_q is not None:
+            q = apply_rotary_pos_emb(q, rope_q[:, None, :, :])
+        qh = q[:, :, 0, :]  # (B, H, Dk)
+
+        from perceiver_io_tpu.ops.flash_attention import fast_features
+        from perceiver_io_tpu.ops.paged_attention import (
+            paged_decode_attention,
+            paged_kernel_supported,
+        )
+
+        if (
+            "paged" in fast_features()
+            and flash_enabled(self.use_flash)
+            and paged_kernel_supported(cache, h, qk_per_head, d_v)
+        ):
+            kv_idx = jnp.arange(cache.capacity, dtype=jnp.int32)
+            mask = kv_idx[None, :] >= cache.length[:, None]
+            if pad_mask is not None:
+                mask = mask | pad_mask[:, : cache.capacity]
+            o_row = paged_decode_attention(qh, cache, mask)  # (B, H, Dv/H)
+            return AttentionOutput(
+                last_hidden_state=self.o_proj(
+                    o_row.reshape(b, 1, self.v_channels).astype(q.dtype)
+                ),
+                kv_cache=cache,
+            )
+
+        with jax.named_scope("paged_kv_view"):
+            k_slots, v_slots, k_scale, v_scale = cache.gather_view()
+        n_kv = k_slots.shape[1]
+        kv_idx = jnp.arange(n_kv, dtype=jnp.int32)
+        # per-slot validity: slot j holds token j iff j < length[b]; the
+        # causal mask for the single query (absolute position length-1) is
+        # the same predicate, and expired sliding-window slots arrive via
+        # pad_mask (the engine derives them from its per-slot start counters)
+        masked_row = kv_idx[None, :] >= cache.length[:, None]
+        if pad_mask is not None:
+            masked_row = masked_row | pad_mask[:, :n_kv]
+        with jax.named_scope("decode_attend"):
+            eye = jnp.eye(h, dtype=qh.dtype)
+            qd = (qh[:, :, None, :] * eye[None, :, :, None]).reshape(b, h, h * qk_per_head)
+            quant = cache.quantized
+            k_op = k_slots.astype(qh.dtype) if quant else k_slots
+            scores = jnp.einsum("bhc,bjc->bhj", qd, k_op, preferred_element_type=jnp.float32)
+            if quant:
+                scores = scores * k_scale[:, None, :].astype(jnp.float32)
+            scores = jnp.where(masked_row[:, None, :], -jnp.finfo(jnp.float32).max, scores)
+            attn = jax.nn.softmax(scores)
+            attn = self.attn_dropout(attn, deterministic=deterministic)
+            if quant:
+                aw = (attn * v_scale[:, None, :].astype(jnp.float32)).astype(q.dtype)
+                v_op = v_slots.astype(q.dtype)
+            else:
+                aw, v_op = attn.astype(v_slots.dtype), v_slots
+            full = jnp.einsum("bhj,bjc->bhc", aw, v_op)
+            o_row = jnp.einsum("bhhc->bhc", full.reshape(b, h, h, d_v)).reshape(
+                b, 1, self.v_channels
+            )
+        return AttentionOutput(last_hidden_state=self.o_proj(o_row), kv_cache=cache)
+
     def __call__(
         self,
         x_q: jnp.ndarray,
@@ -403,30 +415,24 @@ class MultiHeadAttention(nn.Module):
                 k4 = k.reshape(k.shape[0], k.shape[1], h, qk_per_head)
                 k4 = apply_rotary_pos_emb(k4, rope_k[:, :, None, :])
                 k = k4.reshape(k.shape)
-            start = kv_cache.length
-            eff_len = start + x_kv.shape[1]
-            with jax.named_scope("kv_cache_append"):
-                if kv_cache.quantized:
-                    # rotate-then-quantize: rotation preserves per-token norms
-                    # only approximately, so the scale is computed from the
-                    # rotated keys that actually get stored
-                    k_q, k_sc_new = quantize_kv(k)
-                    v_q, v_sc_new = quantize_kv(v)
-                    k_slots = lax.dynamic_update_slice(kv_cache.k, k_q, (0, start, 0))
-                    v_slots = lax.dynamic_update_slice(kv_cache.v, v_q, (0, start, 0))
-                    k_scale = lax.dynamic_update_slice(kv_cache.k_scale, k_sc_new, (0, start))
-                    v_scale = lax.dynamic_update_slice(kv_cache.v_scale, v_sc_new, (0, start))
-                else:
-                    k_slots = lax.dynamic_update_slice(
-                        kv_cache.k, k.astype(kv_cache.k.dtype), (0, start, 0)
-                    )
-                    v_slots = lax.dynamic_update_slice(
-                        kv_cache.v, v.astype(kv_cache.v.dtype), (0, start, 0)
-                    )
-                    k_scale = v_scale = None
-                new_cache = KVCache(
-                    k=k_slots, v=v_slots, length=eff_len, k_scale=k_scale, v_scale=v_scale
+            if isinstance(kv_cache, PagedKVCache):
+                # paged discipline (the engine decode step): page-table-
+                # indexed append, then the paged attend — the contiguous
+                # code below never sees a paged cache, so the sliding-window
+                # graph is untouched by this dispatch
+                with jax.named_scope("paged_kv_append"):
+                    new_cache = kv_cache.append(k, v)
+                return self._paged_decode_attend(
+                    q, new_cache, pad_mask, rope_q, deterministic
                 )
+            with jax.named_scope("kv_cache_append"):
+                # the cache seam (core/cache.py): op-for-op the dynamic_
+                # update_slice writes that used to live inline here, pinned
+                # by the committed prefill/decode graphcheck contracts
+                new_cache = kv_cache.append(k, v)
+            eff_len = new_cache.length
+            k_slots, v_slots = new_cache.k, new_cache.v
+            k_scale, v_scale = new_cache.k_scale, new_cache.v_scale
 
             # prefill (see prefill_mode): the caches entered empty, so the
             # attention over [0, eff_len) IS the attention over the fresh

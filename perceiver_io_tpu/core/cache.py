@@ -1,0 +1,369 @@
+"""KV-cache disciplines behind one interface: init / append / view as pytree
+ops with static layouts (ROADMAP item 2, the clean way into the paged engine).
+
+Two disciplines dispatch through the same seam today:
+
+- :class:`KVCache` — the fixed-capacity **contiguous** cache the sliding-
+  window decode has always used (one ``(B, capacity, C)`` buffer + a scalar
+  valid length, written with ``lax.dynamic_update_slice``). This module is
+  its new home; ``core.attention`` re-exports it unchanged, and the append
+  it performs is op-for-op the code that used to live inline in
+  ``MultiHeadAttention.__call__`` — the committed ``decode``/``prefill``
+  graphcheck contracts pin that the extraction changed no compiled graph.
+- :class:`PagedKVCache` — fixed-size **pages** from a shared pool with a
+  per-request page table (arXiv:2604.15464, *Ragged Paged Attention*): every
+  decode slot owns whole pages, lengths are per-slot (ragged batching), and
+  a retired request's pages return to the host-side free list
+  (``serving.pages.PageAllocator``) without moving a byte of KV. Appends are
+  per-slot scatters under the ``paged_kv_append`` scope (the cross-program
+  rule's declared-paged-companion label); reads gather pages back through
+  the page table — ``gather_view`` is the ``jax.lax`` fallback CPU tier-1
+  certifies token-exact against the contiguous path, and
+  ``ops.paged_attention`` holds the TPU kernel that walks the table in
+  BlockSpec index maps instead of materializing the view.
+
+Both disciplines keep the int8 storage path: per-token symmetric scales ride
+in ``k_scale``/``v_scale`` planes shaped like the slots (contiguous) or the
+pages (paged), and :func:`quantize_kv` is shared so the rounding contract
+cannot fork.
+
+Layout invariants the seam pins (and the ``decode_paged`` contract checks):
+
+- slots-major storage ``(…, slot, C)`` — the channels-minor layout the
+  decode GEMMs read without a head transpose (see core/attention.py);
+- keys stored **rotated** (rotate-at-write): a token's rotation rides it
+  into whichever discipline stores it, so positions never need re-rotation;
+- appends never concatenate: ``dynamic_update_slice`` (contiguous) or a
+  page-indexed scatter (paged) — the kv-axis concatenate the twoseg kernels
+  killed must not reappear in any discipline's graph.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+from jax import lax
+
+
+@struct.dataclass
+class KVCache:
+    """Fixed-capacity cache: ``k``/``v`` are (B, capacity, C) with valid data
+    in slots [0, length); ``length`` is a traced int32 scalar.
+
+    ``int8`` storage (``init_kv_cache(dtype=jnp.int8)``) keeps per-token
+    symmetric quantization scales in ``k_scale``/``v_scale`` (B, capacity).
+    Decode is HBM-bandwidth-bound (docs/performance.md: batch-8 runs at the
+    chip's physical ceiling), so halving cache bytes buys real throughput —
+    the scales fold into elementwise ops OUTSIDE the two cache GEMMs, and
+    XLA reads the int8 operands at int8 bytes (measured:
+    tools/int8_cache_probe.py, 1.69x on the decode attention core)."""
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+    length: jnp.ndarray
+    k_scale: Optional[jnp.ndarray] = None
+    v_scale: Optional[jnp.ndarray] = None
+
+    @property
+    def capacity(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
+    def map_slots(self, fn, length=None) -> "KVCache":
+        """Apply ``fn`` to every per-slot array (k, v, and the scales when
+        present) — the one way generation code may rebuild a cache, so
+        slot reorders/rolls/tiles can never drop the scale planes."""
+        return KVCache(
+            k=fn(self.k),
+            v=fn(self.v),
+            length=self.length if length is None else length,
+            k_scale=None if self.k_scale is None else fn(self.k_scale),
+            v_scale=None if self.v_scale is None else fn(self.v_scale),
+        )
+
+    def append(self, k: jnp.ndarray, v: jnp.ndarray) -> "KVCache":
+        """Write ``k``/``v`` (B, N, C) — keys already rotated — at
+        ``length``; returns the advanced cache. Exactly the in-place
+        ``dynamic_update_slice`` writes the attention module has always
+        traced (callers own the ``kv_cache_append`` named scope), so the
+        extraction is invisible to the compiled graph."""
+        start = self.length
+        if self.quantized:
+            # rotate-then-quantize: rotation preserves per-token norms
+            # only approximately, so the scale is computed from the
+            # rotated keys that actually get stored
+            k_q, k_sc_new = quantize_kv(k)
+            v_q, v_sc_new = quantize_kv(v)
+            return KVCache(
+                k=lax.dynamic_update_slice(self.k, k_q, (0, start, 0)),
+                v=lax.dynamic_update_slice(self.v, v_q, (0, start, 0)),
+                length=start + k.shape[1],
+                k_scale=lax.dynamic_update_slice(self.k_scale, k_sc_new, (0, start)),
+                v_scale=lax.dynamic_update_slice(self.v_scale, v_sc_new, (0, start)),
+            )
+        return KVCache(
+            k=lax.dynamic_update_slice(self.k, k.astype(self.k.dtype), (0, start, 0)),
+            v=lax.dynamic_update_slice(self.v, v.astype(self.v.dtype), (0, start, 0)),
+            length=start + k.shape[1],
+            k_scale=None,
+            v_scale=None,
+        )
+
+
+def quantize_kv(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-token symmetric int8 quantization: (..., N, C) -> int8 values and
+    a (..., N) bf16 scale with ``x ~= q * scale``. int8->bf16 is exact (|q|
+    <= 127), so dequantization error is the rounding step alone."""
+    x32 = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x32), axis=-1)
+    # round against the scale AS STORED (bf16): quantizing with a more
+    # precise scale than dequantization uses would leak the bf16 rounding
+    # into the error bound (up to ~0.25 extra steps at |q|=127). bf16
+    # rounds to nearest, so the stored scale can be a hair below amax/127;
+    # nudge up one ulp-ish factor to keep |q| <= 127 exactly.
+    scale = jnp.maximum(amax / 127.0, 1e-8).astype(jnp.bfloat16)
+    scale = jnp.where(scale.astype(jnp.float32) * 127.0 < amax, scale * jnp.bfloat16(1.0079), scale)
+    q = jnp.round(x32 / scale.astype(jnp.float32)[..., None])
+    q = jnp.clip(q, -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def init_kv_cache(
+    batch_size: int,
+    capacity: int,
+    num_qk_channels: int,
+    num_v_channels: int,
+    dtype=jnp.float32,
+) -> KVCache:
+    """Empty cache (length 0) — the analog of the reference's
+    ``empty_kv_cache`` (modules.py:282-285) with pre-allocated capacity.
+    ``dtype=jnp.int8`` selects quantized storage (see :class:`KVCache`)."""
+    scales = None
+    if dtype == jnp.int8:
+        scales = jnp.zeros((batch_size, capacity), jnp.bfloat16)
+    return KVCache(
+        k=jnp.zeros((batch_size, capacity, num_qk_channels), dtype),
+        v=jnp.zeros((batch_size, capacity, num_v_channels), dtype),
+        length=jnp.zeros((), jnp.int32),
+        k_scale=scales,
+        v_scale=scales,
+    )
+
+
+# ---------------------------------------------------------------------------
+# paged discipline
+# ---------------------------------------------------------------------------
+
+
+@struct.dataclass
+class PagedKVCache:
+    """Paged KV cache: ``k``/``v`` are (num_pages, page_size, C) pools; each
+    decode slot ``s`` owns the pages ``page_table[s]`` names and has
+    ``length[s]`` valid tokens — token ``t`` of slot ``s`` lives at
+    ``(page_table[s, t // page_size], t % page_size)``.
+
+    Page 0 is the SCRATCH page by convention (``serving.pages.PageAllocator``
+    never hands it out): unallocated page-table entries point at it, and an
+    inactive slot's appends land there harmlessly — the compiled engine step
+    is total over all slots, active or not, so no per-slot control flow.
+
+    ``length`` is per-slot (B,) int32 — the ragged-batching axis the
+    contiguous cache's scalar length cannot express. Appends are one token
+    per slot (the engine decode step); prompt KV arrives via
+    ``commit_prefill`` from a contiguous prefill cache (prefill/decode
+    disaggregation — the prompt pass itself stays the committed ``prefill``
+    program, untouched).
+
+    int8 storage mirrors :class:`KVCache`: per-token bf16 scales in
+    ``k_scale``/``v_scale`` pools shaped (num_pages, page_size)."""
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+    page_table: jnp.ndarray  # (B, pages_per_slot) int32
+    length: jnp.ndarray  # (B,) int32 valid tokens per slot
+    k_scale: Optional[jnp.ndarray] = None
+    v_scale: Optional[jnp.ndarray] = None
+
+    @property
+    def page_size(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def num_pages(self) -> int:
+        return self.k.shape[0]
+
+    @property
+    def pages_per_slot(self) -> int:
+        return self.page_table.shape[1]
+
+    @property
+    def capacity(self) -> int:
+        """Per-slot token capacity (the contiguous view's slot axis)."""
+        return self.pages_per_slot * self.page_size
+
+    @property
+    def slots(self) -> int:
+        return self.page_table.shape[0]
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
+    def append(self, k: jnp.ndarray, v: jnp.ndarray) -> "PagedKVCache":
+        """Append ONE token per slot: ``k``/``v`` are (B, 1, C), keys already
+        rotated. The write position is page-table-indexed — a gather for the
+        page id, then a scatter into the pool (callers own the
+        ``paged_kv_append`` named scope the cross-program rule keys on).
+        Overflowing slots clamp to their last page (inactive slots point at
+        scratch and never overflow live data)."""
+        if k.shape[1] != 1:
+            raise ValueError(f"paged append is one token per slot, got {k.shape[1]}")
+        b = self.page_table.shape[0]
+        pos = self.length
+        page_idx = jnp.minimum(pos // self.page_size, self.pages_per_slot - 1)
+        page_id = jnp.take_along_axis(self.page_table, page_idx[:, None], axis=1)[:, 0]
+        offset = pos % self.page_size
+        if self.quantized:
+            rows = jnp.arange(b)
+            k_q, k_sc = quantize_kv(k)
+            v_q, v_sc = quantize_kv(v)
+            return PagedKVCache(
+                k=self.k.at[page_id, offset].set(k_q[:, 0].astype(self.k.dtype)),
+                v=self.v.at[page_id, offset].set(v_q[:, 0].astype(self.v.dtype)),
+                page_table=self.page_table,
+                length=pos + 1,
+                k_scale=self.k_scale.at[page_id, offset].set(k_sc[rows, 0]),
+                v_scale=self.v_scale.at[page_id, offset].set(v_sc[rows, 0]),
+            )
+        return PagedKVCache(
+            k=self.k.at[page_id, offset].set(k[:, 0].astype(self.k.dtype)),
+            v=self.v.at[page_id, offset].set(v[:, 0].astype(self.v.dtype)),
+            page_table=self.page_table,
+            length=pos + 1,
+            k_scale=None,
+            v_scale=None,
+        )
+
+    def gather_view(self) -> Tuple[jnp.ndarray, jnp.ndarray, Optional[jnp.ndarray], Optional[jnp.ndarray]]:
+        """The contiguous (B, capacity, C) view of every slot's pages — the
+        ``jax.lax`` gather fallback the CPU tier-1 suite certifies
+        token-exact against :class:`KVCache`. One gather per pool (k, v, and
+        the scale planes when quantized) — the ``decode_paged`` contract
+        budgets exactly these; the TPU kernel (ops/paged_attention.py) walks
+        the table in its BlockSpecs instead and never materializes this."""
+        b = self.slots
+        cap = self.capacity
+
+        def view(pool):
+            g = jnp.take(pool, self.page_table.reshape(-1), axis=0)
+            return g.reshape((b, cap) + pool.shape[2:])
+
+        k = view(self.k)
+        v = view(self.v)
+        if not self.quantized:
+            return k, v, None, None
+        return k, v, view(self.k_scale), view(self.v_scale)
+
+
+def init_paged_kv_cache(
+    slots: int,
+    num_pages: int,
+    page_size: int,
+    pages_per_slot: int,
+    num_qk_channels: int,
+    num_v_channels: int,
+    dtype=jnp.float32,
+) -> PagedKVCache:
+    """Empty paged cache: all page-table entries point at the scratch page
+    (page 0), all lengths 0. The pool is shared by every slot; the host-side
+    allocator (serving.pages) owns which pages each live request holds."""
+    if num_pages < 2:
+        raise ValueError("need at least 2 pages (page 0 is reserved scratch)")
+    scales = None
+    if dtype == jnp.int8:
+        scales = jnp.zeros((num_pages, page_size), jnp.bfloat16)
+    return PagedKVCache(
+        k=jnp.zeros((num_pages, page_size, num_qk_channels), dtype),
+        v=jnp.zeros((num_pages, page_size, num_v_channels), dtype),
+        page_table=jnp.zeros((slots, pages_per_slot), jnp.int32),
+        length=jnp.zeros((slots,), jnp.int32),
+        k_scale=scales,
+        v_scale=scales,
+    )
+
+
+def commit_prefill(
+    paged: PagedKVCache,
+    slot: int,
+    page_ids: jnp.ndarray,
+    prefill_cache: KVCache,
+    n_tokens: jnp.ndarray,
+) -> PagedKVCache:
+    """Move one request's prompt KV from a contiguous prefill cache into its
+    freshly allocated pages — the prefill/decode disaggregation seam: the
+    prompt pass runs the committed contiguous ``prefill`` program, then this
+    (jit-friendly, donation-safe) copy lands its rows in the pool.
+
+    ``page_ids`` is (n,) int32 naming the pages slot ``slot`` now owns (the
+    allocator's grant, scratch-padded to the static table width is the
+    CALLER's job — this writes ``len(page_ids)`` pages' worth of rows);
+    ``n_tokens`` is the request's true token count (page-tail rows beyond it
+    carry junk from the prefill buffer's slack — harmless: reads mask
+    ``>= length``). ``slot`` is a static int (one compiled copy per slot id
+    would retrace; callers jit with ``static_argnums`` on it or pass a
+    traced scalar via the (slot,) update below)."""
+    n = page_ids.shape[0]
+    page_size = paged.page_size
+
+    def rows_of(buf):
+        # (1, cap, ...) -> the first n*page_size slots as (n, page_size, ...);
+        # a prefill buffer shorter than the page span (its capacity is
+        # prompt + budget, not page-rounded) zero-pads the tail — those rows
+        # sit beyond `length` and reads mask them
+        want = n * page_size
+        rows = buf[0]
+        if rows.shape[0] < want:
+            widths = [(0, want - rows.shape[0])] + [(0, 0)] * (rows.ndim - 1)
+            rows = jnp.pad(rows, widths)
+        elif rows.shape[0] > want:
+            rows = lax.slice_in_dim(rows, 0, want, axis=0)
+        return rows.reshape((n, page_size) + buf.shape[2:])
+
+    table_row = jnp.zeros((paged.pages_per_slot,), jnp.int32).at[:n].set(page_ids)
+    k_scale = paged.k_scale
+    v_scale = paged.v_scale
+    if paged.quantized:
+        if not prefill_cache.quantized:
+            raise ValueError("paged cache is int8 but the prefill cache is not")
+        k_scale = k_scale.at[page_ids].set(rows_of(prefill_cache.k_scale))
+        v_scale = v_scale.at[page_ids].set(rows_of(prefill_cache.v_scale))
+    elif prefill_cache.quantized:
+        raise ValueError("prefill cache is int8 but the paged cache is not")
+    return PagedKVCache(
+        k=paged.k.at[page_ids].set(rows_of(prefill_cache.k)),
+        v=paged.v.at[page_ids].set(rows_of(prefill_cache.v)),
+        page_table=paged.page_table.at[slot].set(table_row),
+        length=paged.length.at[slot].set(n_tokens.astype(jnp.int32)),
+        k_scale=k_scale,
+        v_scale=v_scale,
+    )
+
+
+def release_slot(paged: PagedKVCache, slot: int) -> PagedKVCache:
+    """Point a retired slot's table row back at scratch and zero its length
+    (the device half of a retire; the host half returns the pages to the
+    allocator's free list). No pool bytes move."""
+    return PagedKVCache(
+        k=paged.k,
+        v=paged.v,
+        page_table=paged.page_table.at[slot].set(jnp.zeros((paged.pages_per_slot,), jnp.int32)),
+        length=paged.length.at[slot].set(0),
+        k_scale=paged.k_scale,
+        v_scale=paged.v_scale,
+    )

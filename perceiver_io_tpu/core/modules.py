@@ -1290,7 +1290,13 @@ class PerceiverAR(nn.Module):
         else:
             shift = None if pad_mask is None else pad_mask.sum(axis=1, keepdims=True).astype(jnp.int32)
         n_total = ca_cache.length + n_x  # dynamic
-        q_pos = positions(b, n_x, shift=shift, offset=n_total - n_x)
+        offset = n_total - n_x
+        if getattr(offset, "ndim", 0) == 1:
+            # paged cache: per-slot lengths (B,) — each decode slot continues
+            # from its own fill level (ragged batching); the contiguous
+            # cache's scalar length takes the branch above unchanged
+            offset = offset[:, None]
+        q_pos = positions(b, n_x, shift=shift, offset=offset)
 
         with jax.named_scope("embed"):
             x_emb, frq_q = self.input_adapter(x, q_pos)
@@ -1377,6 +1383,36 @@ class CausalSequenceModel(nn.Module):
         ca = init_kv_cache(batch_size, ca_capacity, config.num_channels, config.num_channels, dtype)
         sas = tuple(
             init_kv_cache(batch_size, sa_capacity, config.num_channels, config.num_channels, dtype)
+            for _ in range(config.num_self_attention_layers)
+        )
+        return (ca,) + sas
+
+    @staticmethod
+    def init_paged_cache(
+        config: CausalSequenceModelConfig,
+        slots: int,
+        page_size: int,
+        ca_num_pages: int,
+        ca_pages_per_slot: int,
+        sa_num_pages: int,
+        sa_pages_per_slot: int,
+        dtype=jnp.float32,
+    ):
+        """Empty paged caches for the batched decode engine: one page pool
+        for the cross-attention window and one per self-attention layer.
+        Every SA layer shares one page-id space (layers append in lockstep,
+        so one allocation covers them all — the engine writes identical
+        page tables into each layer's cache pytree)."""
+        from perceiver_io_tpu.core.cache import init_paged_kv_cache
+
+        c = config.num_channels
+        ca = init_paged_kv_cache(
+            slots, ca_num_pages, page_size, ca_pages_per_slot, c, c, dtype
+        )
+        sas = tuple(
+            init_paged_kv_cache(
+                slots, sa_num_pages, page_size, sa_pages_per_slot, c, c, dtype
+            )
             for _ in range(config.num_self_attention_layers)
         )
         return (ca,) + sas

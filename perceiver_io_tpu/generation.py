@@ -462,6 +462,87 @@ def _decode_step_body(model, mcfg, config, step_params, carry, pad_slots, pos_sh
     return carry_out, sampled, decode_health(out.logits[:, -1], out.kv_cache[0], ca_start)
 
 
+def _sample_per_slot(logits: jnp.ndarray, rngs: jnp.ndarray, config: GenerationConfig) -> jnp.ndarray:
+    """Per-slot sampling with per-slot key chains: each decode slot draws
+    exactly what a batch-1 :func:`_sample` call with its key would draw —
+    the property that makes the batched engine token-exact (rng chain
+    included) against the sequential path. ``logits`` (S, V), ``rngs``
+    (S,) keys; greedy short-circuits (argmax is row-local already)."""
+    if not config.do_sample:
+        return jnp.argmax(logits, axis=-1)
+    return jax.vmap(lambda row, key: _sample(row[None, :], key, config)[0])(logits, rngs)
+
+
+def _paged_decode_step_body(model, mcfg, config, step_params, state):
+    """One BATCHED decode step over paged caches — the engine analog of
+    :func:`_decode_step_body` with every window counter, length, rng chain
+    and done flag per-slot: slide each slot's window when full (expired
+    slots masked via the per-slot start counters, exactly the sequential
+    discipline), apply the model on each slot's last token, sample per slot
+    with that slot's key. The compiled step is total over all slots —
+    inactive slots decode garbage into their scratch page and their samples
+    are discarded by the host scheduler (no per-slot control flow, one
+    compiled program at every fill level).
+
+    ``state`` keys: ``cache`` (tuple: paged CA + per-layer paged SA),
+    ``ca_start``/``sa_start`` (S,), ``token`` (S,), ``rng`` (S,) keys,
+    ``done`` (S,) bool, ``pad_slots`` (S, ca_capacity), ``pos_shift``
+    (S, 1). Returns ``(new_state, sampled_tokens)``."""
+    cache = state["cache"]
+    ca_cache, sa_caches = cache[0], cache[1:]
+    ca_start, sa_start = state["ca_start"], state["sa_start"]
+    token, rng, done = state["token"], state["rng"], state["done"]
+    ca_idx = jnp.arange(ca_cache.capacity, dtype=jnp.int32)[None, :]
+    sa_idx = jnp.arange(sa_caches[0].capacity, dtype=jnp.int32)[None, :]
+
+    ca_full = (ca_cache.length - ca_start) >= mcfg.max_seq_len
+    ca_start = ca_start + ca_full.astype(jnp.int32)
+    sa_full = (sa_caches[0].length - sa_start) >= mcfg.max_latents
+    sa_start = sa_start + sa_full.astype(jnp.int32)
+
+    out = model.apply(
+        step_params,
+        token[:, None],
+        prefix_len=0,
+        pad_mask=state["pad_slots"] | (ca_idx < ca_start[:, None]),
+        kv_cache=cache,
+        decode=True,
+        sa_pad_mask=sa_idx < sa_start[:, None],
+        pos_shift=state["pos_shift"],
+    )
+    rng, step_rng = jax.vmap(jax.random.split, out_axes=1)(rng)
+    sampled = _sample_per_slot(out.logits[:, -1], step_rng, config)
+    if config.eos_token_id is not None:
+        sampled = jnp.where(done, config.pad_token_id, sampled)
+        done = done | (sampled == config.eos_token_id)
+    new_state = dict(
+        state, cache=out.kv_cache, ca_start=ca_start, sa_start=sa_start,
+        token=sampled, rng=rng, done=done,
+    )
+    return new_state, sampled
+
+
+def make_paged_step_fn(model, config: Optional[GenerationConfig] = None, weight_dtype=None):
+    """The batched engine's jitted decode step: ``fn(params, state) ->
+    (state, tokens)`` over a paged-cache state pytree (see
+    :func:`_paged_decode_step_body`). The STATE is donated — the page pools
+    update in place on TPU, so a step moves O(tokens-this-step) bytes of
+    cache writes, never O(pool); the (possibly int8) decode params ride as
+    a separate, never-donated argument. ``serving.engine`` owns building
+    the state and the join/retire host loop; ``analysis.flagship`` builds
+    the same fn as the ``decode_paged`` graphcheck program."""
+    config = config or GenerationConfig()
+    mcfg = model.config
+    compute_dtype = None if weight_dtype is None else getattr(model, "dtype", jnp.float32)
+
+    def step(params, state):
+        with jax.named_scope("decode_paged"):
+            step_params = _maybe_dequantize_weights(params, compute_dtype)
+            return _paged_decode_step_body(model, mcfg, config, step_params, state)
+
+    return jax.jit(step, donate_argnums=1)
+
+
 def make_generate_fn(
     model,
     num_latents: int = 1,

@@ -78,7 +78,10 @@ LOG2E = 1.4426950408889634  # log2(e)
 # prefix and latent K/V as separate operands — the concatenated x_kv tensor
 # and its LayerNorm output are never materialized). Gated like the trims so
 # tools/step_ab.py can A/B it same-process; see docs/performance.md round 6.
-ALL_FEATURES = frozenset({"base2", "nobias", "fastmask", "slimstats", "twoseg"})
+# "paged" is structural like "twoseg": it routes the engine's paged decode
+# attention through the page-walk kernel (ops/paged_attention.py) instead of
+# the gather-view fallback; default-off until a real-TPU A/B graduates it.
+ALL_FEATURES = frozenset({"base2", "nobias", "fastmask", "slimstats", "twoseg", "paged"})
 # scoped per-context (contextvar, not a module global): a probe thread
 # toggling features cannot leak them into another thread's traces
 _FAST_FEATURES = contextvars.ContextVar("flash_fast_features", default=frozenset())

@@ -27,6 +27,10 @@ from perceiver_io_tpu.serving.faultinject import (  # noqa: F401
     ManualClock,
     poison_params,
 )
+from perceiver_io_tpu.serving.engine import (  # noqa: F401
+    EngineConfig,
+    EngineFrontEnd,
+)
 from perceiver_io_tpu.serving.frontend import (  # noqa: F401
     SHED_REASONS,
     TERMINAL_OUTCOMES,
@@ -35,8 +39,18 @@ from perceiver_io_tpu.serving.frontend import (  # noqa: F401
     DecodePathFailure,
     RequestFrontEnd,
 )
+from perceiver_io_tpu.serving.pages import (  # noqa: F401
+    PageAllocator,
+    PageGrant,
+    PageStats,
+)
 
 __all__ = [
+    "EngineConfig",
+    "EngineFrontEnd",
+    "PageAllocator",
+    "PageGrant",
+    "PageStats",
     "STATE_VALUES",
     "BreakerConfig",
     "CircuitBreaker",

@@ -1,0 +1,610 @@
+"""Pageline — the continuous-batching serving engine on a paged KV cache.
+
+ROADMAP item 1, landed behind the PR-12 admission tier: where
+:class:`~perceiver_io_tpu.serving.frontend.RequestFrontEnd` serializes
+requests one worker at a time through the instrumented single-request path,
+:class:`EngineFrontEnd` keeps a fixed set of **decode slots** hot and drives
+them through ONE compiled batched step:
+
+- **admission** is inherited verbatim — bounded queue, deadline projection,
+  breaker, drain, clean books — plus a page-fit check: a request whose KV
+  footprint could never fit the page pool sheds ``kv_pages_exhausted`` at
+  admission (a first-class PR-12 shed, never a silent drop);
+- **prefill/decode disaggregation**: a joining request's prompt runs the
+  committed contiguous ``prefill`` program (batch 1 — prefill is
+  compute-bound and token-exactness rides the existing program), then
+  ``core.cache.commit_prefill`` lands its KV rows in freshly allocated
+  pages (``serving.pages.PageAllocator``) and the slot enters the batch;
+- **continuous batching**: every engine step decodes one token for every
+  active slot (``generation.make_paged_step_fn`` — per-slot lengths, window
+  counters, rng chains, so each slot's stream is token-exact vs the
+  sequential path); finished/cancelled/expired slots retire between steps,
+  their pages return to the free list, and queued requests join without
+  draining the batch — the classic join/retire loop of *Ragged Paged
+  Attention* (arXiv:2604.15464) and the Gemma-on-TPU serving comparison
+  (arXiv:2605.25645);
+- **telemetry**: per-request ``request`` events with TTFT, a real TPOT
+  histogram, queue wait and the new optional ``batch_size_at_decode``
+  field; ``engine_batch_fill_frac`` / ``engine_kv_pages_used`` gauges in
+  the shared registry (rendered by ``tools/obs_report.py``); mid-decode
+  kill/cancel/deadline land as terminal outcomes with the slot AND its
+  pages freed — ``tools/chaos.py serve_engine_*`` certifies books + pages.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from perceiver_io_tpu.serving.frontend import RequestFrontEnd, _Ticket
+from perceiver_io_tpu.serving.pages import PageAllocator
+
+
+@dataclass
+class EngineConfig:
+    """Geometry/policy of the batched engine."""
+
+    # decode slots (the max batch a step serves)
+    slots: int = 4
+    # tokens per KV page
+    page_size: int = 8
+    # per-slot token ceilings (prompt + decode budget); page-table width is
+    # derived from these. Requests beyond them shed kv_pages_exhausted.
+    max_ca_tokens: int = 64
+    max_sa_tokens: int = 32
+    # pool sizing in units of fully-loaded slots: 1.0 = exactly enough pages
+    # for `slots` maxed-out requests (+ the scratch page). Below 1.0 the
+    # allocator exerts real backpressure — the chaos scenarios run there.
+    pool_headroom: float = 1.0
+
+
+class EngineFrontEnd(RequestFrontEnd):
+    """The continuous-batching front end (see module docstring). Inherits
+    the whole admission/books/drain surface of :class:`RequestFrontEnd`;
+    only the SERVICE loop differs — batched join/step/retire instead of
+    one-request-at-a-time ``_serve_next``.
+
+    ``engine_config`` sizes the slot/page geometry. Everything else
+    (events, registry, clock, injector, breaker, deadlines) follows the
+    parent's contract, so the chaos machinery drives both unchanged.
+    """
+
+    def __init__(self, model, params, *, engine_config: Optional[EngineConfig] = None, **kw):
+        super().__init__(model, params, **kw)
+        import jax
+        import jax.numpy as jnp
+
+        self._jax, self._jnp = jax, jnp
+        self.engine_config = ec = engine_config or EngineConfig()
+        mcfg = model.config
+        ps = ec.page_size
+        self._ca_pages_per_slot = -(-ec.max_ca_tokens // ps)
+        self._sa_pages_per_slot = -(-ec.max_sa_tokens // ps)
+        ca_pool = 1 + max(2, int(round(ec.slots * self._ca_pages_per_slot * ec.pool_headroom)))
+        sa_pool = 1 + max(2, int(round(ec.slots * self._sa_pages_per_slot * ec.pool_headroom)))
+        self.ca_alloc = PageAllocator(ca_pool, ps)
+        self.sa_alloc = PageAllocator(sa_pool, ps)
+
+        from perceiver_io_tpu.core.modules import CausalSequenceModel
+        from perceiver_io_tpu.generation import (
+            GenerationConfig,
+            _maybe_quantize_weights,
+            make_paged_step_fn,
+        )
+        from perceiver_io_tpu.obs.recompile import RecompileTracker
+
+        self._gen_config = self.base_config or GenerationConfig()
+        cache_dtype = self.cache_dtype if self.cache_dtype is not None else jnp.float32
+        caches = CausalSequenceModel.init_paged_cache(
+            mcfg, ec.slots, ps,
+            ca_num_pages=ca_pool, ca_pages_per_slot=self._ca_pages_per_slot,
+            sa_num_pages=sa_pool, sa_pages_per_slot=self._sa_pages_per_slot,
+            dtype=cache_dtype,
+        )
+        self._decode_params, _ = _maybe_quantize_weights(model, params, self.weight_dtype)
+        s = ec.slots
+        self._state = {
+            "cache": caches,
+            "ca_start": jnp.zeros((s,), jnp.int32),
+            "sa_start": jnp.zeros((s,), jnp.int32),
+            "token": jnp.zeros((s,), jnp.int32),
+            "rng": jnp.stack([jax.random.PRNGKey(0)] * s),
+            "done": jnp.ones((s,), bool),
+            "pad_slots": jnp.zeros((s, caches[0].capacity), bool),
+            "pos_shift": jnp.zeros((s, 1), jnp.int32),
+        }
+        self._tracker = RecompileTracker(events=self.events)
+        self._step_fn = self._tracker.wrap(
+            make_paged_step_fn(model, self._gen_config, self.weight_dtype),
+            "engine_decode_step",
+        )
+        self._prefill_fns: Dict[int, object] = {}
+        self._join_fn = self._tracker.wrap(
+            jax.jit(_join_state, donate_argnums=0), "engine_join"
+        )
+        self._retire_fn = self._tracker.wrap(
+            jax.jit(_retire_state, donate_argnums=0), "engine_retire"
+        )
+        self._slots: List[Optional[_EngineSlot]] = [None] * s
+        self._engine_steps = 0
+        self._fill_sum = 0  # sum of active-slot counts over steps
+        # request index -> decoded token ids (the streaming surface a real
+        # consumer reads; the token-exactness tests compare these against
+        # the sequential path)
+        self.served_tokens: Dict[int, List[int]] = {}
+        r = self.registry
+        self._m_tokens = r.counter("generate_tokens_out_total")
+        self._m_requests = r.counter("generate_requests_total")
+        self._m_ttft = r.histogram("generate_ttft_s")
+        self._m_tpot = r.histogram("generate_tpot_s")
+        self._m_queue_wait = r.histogram("generate_queue_wait_s")
+        self._m_fill = r.gauge("engine_batch_fill_frac")
+        self._m_pages = r.gauge("engine_kv_pages_used")
+        self._m_pages_frac = r.gauge("engine_kv_pages_frac")
+        self._admission_checks.append(self._page_fit_check)
+
+    # -- admission -----------------------------------------------------------
+
+    def _page_fit_check(self, spec, deadline_s):
+        """Shed a request whose KV footprint can NEVER fit: prompt + budget
+        over a per-slot ceiling (CA window OR SA latent stream — both
+        UNCAPPED, exactly what :meth:`_try_join` will allocate: an SA
+        stream beyond the slot's page span would clamp into its last page
+        and overwrite live window slots) or over the whole pool. Transient
+        shortage is backpressure (the request waits), never a shed."""
+        ca_tokens = int(spec.prompt_len) + int(spec.max_new_tokens)
+        sa_tokens = self.num_latents + int(spec.max_new_tokens)
+        ec = self.engine_config
+        fits = (
+            ca_tokens <= ec.max_ca_tokens
+            and sa_tokens <= ec.max_sa_tokens
+            and self.ca_alloc.can_ever_fit(ca_tokens)
+            and self.sa_alloc.can_ever_fit(sa_tokens)
+        )
+        if fits:
+            return None
+        return "kv_pages_exhausted", {
+            "ca_tokens": ca_tokens,
+            "max_ca_tokens": ec.max_ca_tokens,
+            "sa_tokens": sa_tokens,
+            "max_sa_tokens": ec.max_sa_tokens,
+            "pool_pages": self.ca_alloc.num_allocatable,
+        }
+
+    # -- join ----------------------------------------------------------------
+
+    def _prefill_for(self, max_new: int):
+        if max_new not in self._prefill_fns:
+            import dataclasses as _dc
+
+            from perceiver_io_tpu.generation import make_decode_fns
+
+            cfg = _dc.replace(self._gen_config, max_new_tokens=max_new)
+            kwargs = {} if self.cache_dtype is None else {"cache_dtype": self.cache_dtype}
+            prefill, _ = make_decode_fns(
+                self.model, self.num_latents, cfg,
+                weight_dtype=self.weight_dtype, **kwargs,
+            )
+            self._prefill_fns[max_new] = self._tracker.wrap(prefill, "engine_prefill")
+        return self._prefill_fns[max_new]
+
+    def _try_join(self, ticket: _Ticket, slot_id: int) -> bool:
+        """Prefill the ticket's request and land it in ``slot_id``. Returns
+        False (ticket stays queued) when pages are short RIGHT NOW; raises
+        nothing — a prefill failure books the request as a terminal error
+        (pages freed), keeping the stream 1:1."""
+        import jax
+
+        jnp = self._jnp
+        rec = ticket.record
+        ca_tokens = rec.prompt_len + rec.max_new_tokens
+        sa_tokens = self.num_latents + rec.max_new_tokens
+        ca_grant = self.ca_alloc.alloc_tokens(ca_tokens)
+        if ca_grant is None:
+            return False
+        sa_grant = self.sa_alloc.alloc_tokens(sa_tokens)
+        if sa_grant is None:
+            self.ca_alloc.free(ca_grant)
+            return False
+        self._queue.remove(ticket)
+        self._set_queue_gauge()
+        now = float(self._clock())
+        rec.queue_wait_s = round(max(now - ticket.arrival_s, 0.0), 6)
+        self._m_queue_wait.record(rec.queue_wait_s)
+        slot = _EngineSlot(ticket=ticket, slot_id=slot_id,
+                           ca_grant=ca_grant, sa_grant=sa_grant)
+        if self.events is not None and self._tracer is not None:
+            # DETACHED span (no contextvar nesting): slot lifetimes overlap
+            # and close out of LIFO order, which the nested span stack
+            # cannot express — the span row is recorded at retire
+            from perceiver_io_tpu.obs.trace import Span
+
+            slot.span = Span(name="request", parent_id=None,
+                             attrs={"request_id": slot.request_id})
+        compiles0 = self._tracker.total_compiles
+        t0 = time.perf_counter()
+        try:
+            if self._injector is not None:
+                self._injector.before_attempt(rec.index)
+            prefill = self._prefill_for(rec.max_new_tokens)
+            serve_params = (
+                self._injector.params_for(rec.index, self.params)
+                if self._injector is not None
+                else self.params
+            )
+            token, pstate = prefill(
+                serve_params,
+                jnp.asarray(ticket.spec.input_ids),
+                None,
+                jax.random.PRNGKey(int(ticket.spec.rng_seed)),
+            )
+            first = int(token[0])
+        except Exception as e:  # noqa: BLE001 — books close, pages return
+            self.ca_alloc.free(ca_grant)
+            self.sa_alloc.free(sa_grant)
+            rec.error = repr(e)
+            rec.attempts += 1
+            self._retire_books(slot, "error", emit=True)
+            return True  # the ticket reached a terminal outcome
+        slot.ttft_s = time.perf_counter() - t0
+        rec.attempts += 1
+        slot.compiled = self._tracker.total_compiles > compiles0
+        slot.tokens_out = 1
+        slot.first_token = first
+        self.served_tokens[rec.index] = [first]
+        self._state = self._join_fn(
+            self._state,
+            jnp.int32(slot_id),
+            jnp.asarray(ca_grant.pages, jnp.int32),
+            jnp.asarray(sa_grant.pages, jnp.int32),
+            pstate["cache"],
+            (token[0].astype(jnp.int32), pstate["rng"],
+             pstate["done"][0], pstate["pad_slots"][0], pstate["pos_shift"][0]),
+        )
+        self._slots[slot_id] = slot
+        self._in_flight += 1
+        if not slot.compiled:
+            self._m_ttft.record(slot.ttft_s)
+        # the per-token seam fires for token 0 exactly like the sequential
+        # path (injector stalls/kills, cancellation, deadline)
+        self._token_seam(slot, 0)
+        return True
+
+    # -- the per-token seam (injector / cancel / deadline) -------------------
+
+    def _token_seam(self, slot: "_EngineSlot", i: int) -> None:
+        rec = slot.ticket.record
+        rec.tokens_out = slot.tokens_out
+        try:
+            if self._injector is not None:
+                self._injector.on_token(rec.index, i)
+            if slot.ticket.cancelled:
+                slot.outcome = "cancelled"
+                return
+            if (slot.ticket.deadline_at is not None
+                    and self._clock() > slot.ticket.deadline_at):
+                slot.outcome = "timeout"
+        except Exception as e:  # noqa: BLE001 — injected kill
+            slot.outcome = "error"
+            rec.error = repr(e)
+
+    # -- retire --------------------------------------------------------------
+
+    def _retire_books(self, slot: "_EngineSlot", outcome: str, emit: bool) -> None:
+        """Terminal accounting for one slot: books, pages, span, event."""
+        rec = slot.ticket.record
+        rec.ttft_s = None if slot.ttft_s is None else round(slot.ttft_s, 6)
+        rec.tokens_out = slot.tokens_out
+        rec.compiled = slot.compiled
+        rec.decode_s = round(sum(slot.step_times), 6)
+        rec.service_s = round(time.perf_counter() - slot.t_joined, 6)
+        self._finish(slot.ticket, outcome)
+        if slot.span is not None:
+            slot.span.set("outcome", outcome)
+            slot.span.set("tokens_out", slot.tokens_out)
+            self._tracer.record(slot.span)
+            self._tracer.flush()  # span row BEFORE the request row
+        if emit and self.events is not None:
+            row = dict(
+                request_id=slot.request_id,
+                batch=1,
+                prompt_len=rec.prompt_len,
+                new_tokens=rec.max_new_tokens,
+                ttft_s=0.0 if slot.ttft_s is None else round(slot.ttft_s, 6),
+                tokens_out=slot.tokens_out,
+                outcome=outcome,
+                compiled=slot.compiled,
+                queue_wait_s=rec.queue_wait_s,
+                decode_s=round(sum(slot.step_times), 6),
+                tpot_hist=dict(sorted((str(k), v) for k, v in slot.hist.counts.items())),
+            )
+            if slot.batch_sizes:
+                row["batch_size_at_decode"] = round(
+                    sum(slot.batch_sizes) / len(slot.batch_sizes), 3
+                )
+            if slot.span is not None:
+                row["span_id"] = slot.span.span_id
+            for p in (50, 90, 99):
+                row[f"tpot_p{p}_s"] = slot.hist.percentile(p)
+            if rec.error is not None:
+                row["error"] = rec.error
+            self.events.emit("request", **row)
+        self._m_requests.inc()
+        self._m_tokens.inc(slot.tokens_out)
+        if self.events is not None:
+            # snapshot cadence matches the instrumented wrapper: the engine
+            # gauges (batch fill, page use) land in `metrics` rows while the
+            # batch is still live, not only after the drain zeroes them
+            self.registry.maybe_emit(
+                self.events, min_interval_s=self.config.snapshot_interval_s
+            )
+
+    def _retire_slot(self, slot_id: int, outcome: str) -> None:
+        slot = self._slots[slot_id]
+        self._slots[slot_id] = None
+        self._in_flight -= 1
+        self.ca_alloc.free(slot.ca_grant)
+        self.sa_alloc.free(slot.sa_grant)
+        self._state = self._retire_fn(self._state, self._jnp.int32(slot_id))
+        self._retire_books(slot, outcome, emit=True)
+        self._busy_until = float(self._clock())
+
+    # -- the engine loop -----------------------------------------------------
+
+    def _active_ids(self) -> List[int]:
+        return [i for i, s in enumerate(self._slots) if s is not None]
+
+    def _fill_slots(self) -> None:
+        """Batched prefill admission: join queued requests into every free
+        slot (page backpressure stops the fill, never sheds)."""
+        for slot_id, occupant in enumerate(self._slots):
+            if occupant is not None:
+                continue
+            while self._queue:
+                ticket = self._queue[0]
+                now = float(self._clock())
+                if ticket.cancelled:
+                    self._queue.popleft()
+                    self._set_queue_gauge()
+                    ticket.record.queue_wait_s = round(max(now - ticket.arrival_s, 0.0), 6)
+                    self._finish(ticket, "cancelled")
+                    self._emit_frontend_request(ticket.record,
+                                                queue_wait_s=ticket.record.queue_wait_s)
+                    continue
+                if ticket.deadline_at is not None and now > ticket.deadline_at:
+                    self._m_queue_expired.inc()
+                    self._queue.popleft()
+                    self._set_queue_gauge()
+                    ticket.record.queue_wait_s = round(max(now - ticket.arrival_s, 0.0), 6)
+                    self._finish(ticket, "timeout")
+                    self._emit_frontend_request(ticket.record,
+                                                queue_wait_s=ticket.record.queue_wait_s,
+                                                queue_expired=True)
+                    continue
+                if not self._try_join(ticket, slot_id):
+                    return  # pages short: backpressure, keep the queue
+                break  # joined (or terminally booked) — next slot
+        self._update_gauges()
+
+    def _update_gauges(self) -> None:
+        active = len(self._active_ids())
+        self._m_fill.set(active / max(self.engine_config.slots, 1))
+        stats = self.ca_alloc.stats()
+        self._m_pages.set(stats.pages_used + self.sa_alloc.stats().pages_used)
+        self._m_pages_frac.set(stats.used_frac)
+
+    def _sweep_terminal(self) -> None:
+        """Retire slots whose outcome is ALREADY terminal (a kill at token
+        0 in the join seam, a cancel/deadline landing between steps) before
+        the next batched step decodes — and books — an extra token for a
+        dead request; the sequential path retires at exactly the same
+        boundary."""
+        for slot_id, slot in enumerate(self._slots):
+            if slot is not None and slot.outcome is not None:
+                self._retire_slot(slot_id, slot.outcome)
+
+    def _engine_step(self) -> None:
+        """One batched decode step + per-slot accounting/retires."""
+        self._sweep_terminal()
+        active = self._active_ids()
+        if not active:
+            return
+        compiles0 = self._tracker.total_compiles
+        t0 = time.perf_counter()
+        self._state, tokens = self._step_fn(self._decode_params, self._state)
+        tokens = np.asarray(tokens)  # ONE host fetch for the whole batch
+        dt = time.perf_counter() - t0
+        self._engine_steps += 1
+        self._fill_sum += len(active)
+        cold_step = self._tracker.total_compiles > compiles0
+        batch_size = len(active)
+        for slot_id in active:
+            slot = self._slots[slot_id]
+            slot.tokens_out += 1
+            self.served_tokens[slot.ticket.record.index].append(int(tokens[slot_id]))
+            slot.hist.record(dt)
+            slot.step_times.append(dt)
+            slot.batch_sizes.append(batch_size)
+            if cold_step:
+                slot.compiled = True
+            else:
+                self._m_tpot.record(dt)
+            self._token_seam(slot, slot.tokens_out - 1)
+            rec = slot.ticket.record
+            eos = self._gen_config.eos_token_id
+            finished = (
+                slot.tokens_out >= rec.max_new_tokens
+                or (eos is not None and int(tokens[slot_id]) == eos)
+            )
+            if slot.outcome is not None:  # killed / cancelled / deadline
+                self._retire_slot(slot_id, slot.outcome)
+            elif finished:
+                self._retire_slot(slot_id, "ok")
+        self._update_gauges()
+
+    def cancel(self, request_index: int) -> bool:
+        """Cancel a queued request or one live in a decode SLOT — the slot
+        retires ``cancelled`` at its next token boundary (the same
+        between-tokens seam the sequential path uses)."""
+        for slot in self._slots:
+            if slot is not None and slot.ticket.record.index == request_index:
+                slot.ticket.cancelled = True
+                return True
+        return super().cancel(request_index)
+
+    @property
+    def mean_batch_fill(self) -> float:
+        """Mean active-slot fraction over every decode step — the engine's
+        occupancy figure of merit (1.0 = every step fully batched)."""
+        denom = self._engine_steps * max(self.engine_config.slots, 1)
+        return self._fill_sum / denom if denom else 0.0
+
+    # -- driving (overrides the sequential service loop) ---------------------
+
+    def pump(self, max_requests: Optional[int] = None) -> int:
+        """Drive the engine until the queue AND the batch drain (or until
+        ``max_requests`` reached terminal outcomes)."""
+        terminal0 = sum(self._n[o] for o in
+                        ("ok", "error", "timeout", "cancelled"))
+        done = 0
+        while self._queue or self._active_ids():
+            self._check_guard()
+            self._fill_slots()
+            self._engine_step()
+            done = sum(self._n[o] for o in
+                       ("ok", "error", "timeout", "cancelled")) - terminal0
+            if max_requests is not None and done >= max_requests:
+                break
+        return done
+
+    def run_closed(self, specs, *, concurrency: int = 4,
+                   deadline_s: Optional[float] = None):
+        """Closed-loop drive through the ENGINE: ``concurrency`` requests
+        admitted/in flight; completions admit the next. Same record/books
+        contract as the parent's sequential loop."""
+        if concurrency < 1:
+            raise ValueError("run_closed needs concurrency >= 1")
+        from collections import deque as _deque
+
+        pending = _deque(specs)
+        out = []
+
+        def admit():
+            while pending and (len(self._queue) + len(self._active_ids())) < concurrency:
+                out.append(self.submit(pending.popleft(), deadline_s=deadline_s))
+
+        admit()
+        while self._queue or pending or self._active_ids():
+            self._check_guard()
+            admit()
+            if not (self._queue or self._active_ids()):
+                continue
+            self._fill_slots()
+            self._engine_step()
+        if self._draining:
+            self.drain()
+        return out
+
+    def run_open(self, specs, **kw):
+        """Not yet implemented for the engine: the parent's open-loop drive
+        interleaves arrivals with SEQUENTIAL service — inheriting it would
+        silently bypass the batched path. Open-loop engine drive (rate
+        floors at engine scale) is the ROADMAP follow-up; loud beats
+        wrong-path-silent."""
+        raise NotImplementedError(
+            "EngineFrontEnd serves closed-loop (run_closed / submit+pump); "
+            "open-loop engine drive is not implemented yet"
+        )
+
+    # the engine keeps no per-request worker estimate: queue-wait projection
+    # rides the parent's EWMA, updated here per retire via _busy_until
+
+
+@dataclass
+class _EngineSlot:
+    """Host-side record of one occupied decode slot."""
+
+    ticket: _Ticket
+    slot_id: int
+    ca_grant: object
+    sa_grant: object
+    tokens_out: int = 0
+    ttft_s: Optional[float] = None
+    compiled: bool = False
+    first_token: Optional[int] = None
+    outcome: Optional[str] = None  # set mid-decode by the token seam
+    span = None
+
+    def __post_init__(self):
+        from perceiver_io_tpu.obs import trace as obs_trace
+        from perceiver_io_tpu.obs.metrics import Histogram
+
+        self.request_id = obs_trace.new_span_id()
+        self.hist = Histogram("tpot_s")
+        self.step_times: List[float] = []
+        self.batch_sizes: List[int] = []
+        self.t_joined = time.perf_counter()
+
+
+# ---------------------------------------------------------------------------
+# jitted state transitions (join / retire)
+# ---------------------------------------------------------------------------
+
+
+def _join_state(state, slot, ca_pages, sa_pages, prefill_cache, slot_row):
+    """Land one prefilled request in decode slot ``slot``: commit its prompt
+    KV into the granted pages and write its per-slot scalars. Donated —
+    pools update in place."""
+    import jax.numpy as jnp
+
+    from perceiver_io_tpu.core.cache import commit_prefill
+
+    first_token, rng, done0, pad_row_pre, pos_shift_row = slot_row
+    caches = state["cache"]
+    new_ca = commit_prefill(
+        caches[0], slot, ca_pages, prefill_cache[0], prefill_cache[0].length
+    )
+    new_sas = tuple(
+        commit_prefill(c, slot, sa_pages, pc, pc.length)
+        for c, pc in zip(caches[1:], prefill_cache[1:])
+    )
+    cap = caches[0].capacity
+    pad_row = jnp.zeros((cap,), bool)
+    n_pre = pad_row_pre.shape[0]
+    pad_row = lax_update(pad_row, pad_row_pre, min(n_pre, cap))
+    return dict(
+        state,
+        cache=(new_ca,) + new_sas,
+        ca_start=state["ca_start"].at[slot].set(0),
+        sa_start=state["sa_start"].at[slot].set(0),
+        token=state["token"].at[slot].set(first_token),
+        rng=state["rng"].at[slot].set(rng),
+        done=state["done"].at[slot].set(done0),
+        pad_slots=state["pad_slots"].at[slot].set(pad_row),
+        pos_shift=state["pos_shift"].at[slot].set(pos_shift_row),
+    )
+
+
+def lax_update(row, prefix, n):
+    """row[:n] = prefix[:n] with static n (helper kept tiny for jit reuse)."""
+    return row.at[:n].set(prefix[:n])
+
+
+def _retire_state(state, slot):
+    """Device half of a retire: table row back to scratch, length 0, slot
+    parked done with a neutral token."""
+    from perceiver_io_tpu.core.cache import release_slot
+
+    caches = tuple(release_slot(c, slot) for c in state["cache"])
+    return dict(
+        state,
+        cache=caches,
+        token=state["token"].at[slot].set(0),
+        done=state["done"].at[slot].set(True),
+        ca_start=state["ca_start"].at[slot].set(0),
+        sa_start=state["sa_start"].at[slot].set(0),
+        pad_slots=state["pad_slots"].at[slot].set(False),
+    )

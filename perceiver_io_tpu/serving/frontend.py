@@ -77,8 +77,14 @@ if frozenset(TERMINAL_OUTCOMES) != _REQUEST_OUTCOMES:  # pragma: no cover
         f"{sorted(TERMINAL_OUTCOMES)} vs {sorted(_REQUEST_OUTCOMES)}"
     )
 
-# shed reasons (the `shed_reason` field of a shed request event)
-SHED_REASONS = ("queue_full", "deadline_unmeetable", "breaker_open", "draining")
+# shed reasons (the `shed_reason` field of a shed request event);
+# kv_pages_exhausted is the engine's (serving.engine) page-admission shed: a
+# request whose KV footprint can never fit the page pool is rejected at
+# admission instead of waiting in queue forever
+SHED_REASONS = (
+    "queue_full", "deadline_unmeetable", "breaker_open", "draining",
+    "kv_pages_exhausted",
+)
 
 
 class DecodePathFailure(RuntimeError):
@@ -132,6 +138,7 @@ class FrontEndRecord:
     queue_wait_s: Optional[float] = None
     service_s: Optional[float] = None
     ttft_s: Optional[float] = None
+    decode_s: Optional[float] = None  # engine-measured decode wall (sum of step times)
     tokens_out: int = 0
     attempts: int = 0
     compiled: bool = False
@@ -198,6 +205,11 @@ class RequestFrontEnd:
         self._injector = injector
         self._fns: Dict[int, Callable] = {}
         self._queue: deque = deque()
+        # extra admission predicates run after the standard shed chain; each
+        # is fn(spec, deadline_s) -> None (admit) or (reason, detail_dict).
+        # The engine front end (serving.engine) registers its page-fit check
+        # here so kv_pages_exhausted sheds ride the same books/events path.
+        self._admission_checks: List[Callable] = []
         self._busy_until = float(clock())
         self._est_service = float(self.config.est_service_s)
         self._n = {k: 0 for k in ("submitted", "admitted", *TERMINAL_OUTCOMES)}
@@ -328,6 +340,12 @@ class RequestFrontEnd:
             reason = "deadline_unmeetable"
             detail = {"projected_wait_s": round(projected, 6),
                       "deadline_s": round(deadline_s, 6)}
+        if reason is None:
+            for check in self._admission_checks:
+                verdict = check(spec, deadline_s)
+                if verdict is not None:
+                    reason, detail = verdict
+                    break
         probe = False
         if reason is None and self.breaker is not None:
             verdict = self.breaker.allow()

@@ -1,0 +1,186 @@
+"""Host-side page allocator for the paged KV cache (Pageline).
+
+Pure bookkeeping over integer page ids — no device arrays, no clocks, no
+randomness: ``alloc``/``free`` sequences are exactly reproducible, which is
+what lets the engine's chaos scenarios assert page-exact clean books. The
+device half is ``core.cache.PagedKVCache``; the page-id space here indexes
+its pools.
+
+Discipline:
+
+- page 0 is **scratch** (never allocated): unowned page-table entries point
+  at it, inactive decode slots write into it harmlessly;
+- the free list is LIFO (most-recently-freed first) — reuse is maximally
+  hot in cache terms and the allocation order is a pure function of the
+  alloc/free history (pinned by tests);
+- ``alloc_tokens`` grants whole pages (``ceil(tokens / page_size)``); the
+  rounded-up remainder is **internal fragmentation**, accounted per grant
+  so the engine's ``engine_kv_pages_used`` gauge and the fragmentation
+  stats agree with the books at all times;
+- exhaustion is a first-class answer (``None``), not an exception: the
+  engine turns "cannot fit now" into backpressure (the request waits) and
+  "can never fit" into a ``kv_pages_exhausted`` shed through the PR-12
+  shed vocabulary.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+SCRATCH_PAGE = 0
+
+
+@dataclass
+class PageStats:
+    """The allocator's accounting surface (the gauge/fragmentation feed)."""
+
+    num_pages: int  # allocatable pages (scratch excluded)
+    page_size: int
+    pages_used: int
+    pages_free: int
+    grants: int  # live grants
+    tokens_reserved: int  # sum of granted token counts
+    internal_frag_tokens: int  # granted page slack beyond the token counts
+
+    @property
+    def used_frac(self) -> float:
+        return self.pages_used / self.num_pages if self.num_pages else 0.0
+
+    @property
+    def internal_frag_frac(self) -> float:
+        granted = self.pages_used * self.page_size
+        return self.internal_frag_tokens / granted if granted else 0.0
+
+
+class PageAllocator:
+    """Fixed-pool page allocator with LIFO free-list reuse.
+
+    :param num_pages: TOTAL pool pages including the reserved scratch page 0
+        (mirrors the ``PagedKVCache`` pool's leading dimension).
+    :param page_size: tokens per page (fragmentation accounting only).
+    """
+
+    def __init__(self, num_pages: int, page_size: int):
+        if num_pages < 2:
+            raise ValueError("need at least 2 pages (page 0 is reserved scratch)")
+        if page_size < 1:
+            raise ValueError("page_size must be >= 1")
+        self.page_size = int(page_size)
+        self.total_pages = int(num_pages)
+        # LIFO: ascending ids pushed once, so the FIRST allocations are
+        # low ids (deterministic), and freed pages come back hottest-first
+        self._free: List[int] = list(range(num_pages - 1, 0, -1))
+        self._grants: Dict[int, dict] = {}
+        self._next_grant = 0
+
+    # -- capacity questions --------------------------------------------------
+
+    @property
+    def num_allocatable(self) -> int:
+        return self.total_pages - 1
+
+    @property
+    def pages_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def pages_used(self) -> int:
+        return self.num_allocatable - len(self._free)
+
+    def pages_needed(self, n_tokens: int) -> int:
+        return -(-int(n_tokens) // self.page_size)
+
+    def can_ever_fit(self, n_tokens: int) -> bool:
+        """Whether an EMPTY pool could hold ``n_tokens`` — the admission-time
+        shed test (``kv_pages_exhausted``): a request over this bound would
+        wait in queue forever."""
+        return self.pages_needed(n_tokens) <= self.num_allocatable
+
+    def can_fit_now(self, n_tokens: int) -> bool:
+        return self.pages_needed(n_tokens) <= len(self._free)
+
+    # -- alloc / free --------------------------------------------------------
+
+    def alloc_tokens(self, n_tokens: int) -> Optional["PageGrant"]:
+        """Grant whole pages for ``n_tokens`` tokens, or ``None`` when the
+        free list cannot cover it (backpressure, not an exception — and not
+        a partial grant: it is all-or-nothing so a failed join leaks
+        nothing)."""
+        n = self.pages_needed(n_tokens)
+        if n < 1:
+            raise ValueError(f"alloc_tokens needs n_tokens >= 1, got {n_tokens}")
+        if n > len(self._free):
+            return None
+        pages = [self._free.pop() for _ in range(n)]
+        gid = self._next_grant
+        self._next_grant += 1
+        self._grants[gid] = {"pages": pages, "tokens": int(n_tokens)}
+        return PageGrant(gid, tuple(pages), int(n_tokens), self.page_size)
+
+    def free(self, grant: "PageGrant") -> None:
+        """Return a grant's pages to the free list (LIFO). Double-free is an
+        error — the books invariant's page-level analog."""
+        entry = self._grants.pop(grant.grant_id, None)
+        if entry is None:
+            raise ValueError(f"grant {grant.grant_id} is not live (double free?)")
+        if entry["pages"] != list(grant.pages):
+            raise ValueError(f"grant {grant.grant_id} pages drifted from the books")
+        # freed most-recent-first so reuse order is deterministic
+        self._free.extend(reversed(entry["pages"]))
+
+    def stats(self) -> PageStats:
+        tokens = sum(g["tokens"] for g in self._grants.values())
+        granted_slots = sum(len(g["pages"]) for g in self._grants.values()) * self.page_size
+        return PageStats(
+            num_pages=self.num_allocatable,
+            page_size=self.page_size,
+            pages_used=self.pages_used,
+            pages_free=self.pages_free,
+            grants=len(self._grants),
+            tokens_reserved=tokens,
+            internal_frag_tokens=granted_slots - tokens,
+        )
+
+    def audit(self) -> List[str]:
+        """Invariant problems (empty = clean): every page is either free or
+        owned by exactly one live grant, scratch is never owned."""
+        problems: List[str] = []
+        owned: Dict[int, int] = {}
+        for gid, g in self._grants.items():
+            for p in g["pages"]:
+                if p in owned:
+                    problems.append(f"page {p} owned by grants {owned[p]} and {gid}")
+                owned[p] = gid
+        if SCRATCH_PAGE in owned:
+            problems.append("scratch page 0 is owned by a grant")
+        if SCRATCH_PAGE in self._free:
+            problems.append("scratch page 0 is on the free list")
+        free_set = set(self._free)
+        if len(free_set) != len(self._free):
+            problems.append("free list holds duplicates")
+        overlap = free_set & set(owned)
+        if overlap:
+            problems.append(f"pages both free and owned: {sorted(overlap)}")
+        missing = set(range(1, self.total_pages)) - free_set - set(owned)
+        if missing:
+            problems.append(f"pages leaked (neither free nor owned): {sorted(missing)}")
+        return problems
+
+
+@dataclass(frozen=True)
+class PageGrant:
+    """One live allocation: the pages a request's cache rows live in."""
+
+    grant_id: int
+    pages: tuple
+    tokens: int
+    page_size: int
+
+    @property
+    def n_pages(self) -> int:
+        return len(self.pages)
+
+    @property
+    def frag_tokens(self) -> int:
+        return self.n_pages * self.page_size - self.tokens

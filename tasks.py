@@ -214,6 +214,11 @@ def perf(args):
     # instrumented path — events validate, planted breach -> one flight
     # dump, run-vs-itself diff clean, LOAD_r* ledger floors hold
     run(sys.executable, "tools/loadgen.py", "--smoke")
+    # engine leg (Pageline, docs/serving.md): the same closed loop through
+    # the continuous-batching paged-KV engine — books + page-allocator
+    # audits, a planted mid-decode kill inside a live batch, engine gauges
+    # on /metrics, and the engine throughput/p99-TPOT ledger floors
+    run(sys.executable, "tools/loadgen.py", "--smoke", "--engine")
     # serve-chaos smoke leg: kill a request mid-decode through the hardened
     # front end and audit the books (the full serve_* family runs under
     # `tasks.py chaos`; this pins the books invariant in perf CI)

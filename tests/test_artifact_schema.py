@@ -243,6 +243,68 @@ def test_serving_hardening_event_kinds_and_outcomes_pinned(tmp_path):
     assert any("[serve.drain]: missing field 'books'" in p for p in problems)
 
 
+def test_engine_event_vocabulary_pinned(tmp_path):
+    """The Pageline vocabulary (ISSUE 13): ``kv_pages_exhausted`` is a
+    first-class shed reason, and ``batch_size_at_decode`` is an OPTIONAL
+    request-row field — a row carrying either validates with zero problems
+    and zero forward-compat warnings, and neither is required (older
+    streams without them stay valid), so the engine's telemetry is
+    forward-compatible by construction."""
+    from perceiver_io_tpu.obs.events import (
+        _REQUIRED_FIELDS,
+        EVENT_SCHEMA_VERSION,
+        validate_events,
+    )
+    from perceiver_io_tpu.serving import SHED_REASONS
+
+    assert "kv_pages_exhausted" in SHED_REASONS
+    # forward-compat: the new fields must NOT be required on request rows
+    assert "batch_size_at_decode" not in _REQUIRED_FIELDS["request"]
+    assert "shed_reason" not in _REQUIRED_FIELDS["request"]
+
+    def write_stream(rows):
+        path = tmp_path / "events.jsonl"
+        with open(path, "w") as f:
+            for row in rows:
+                f.write(json.dumps({"ts": 1.0, "schema_version": EVENT_SCHEMA_VERSION, **row}) + "\n")
+        return str(path)
+
+    req = {"event": "request", "request_id": "r", "batch": 1, "prompt_len": 8,
+           "ttft_s": 0.0, "tokens_out": 0}
+    good = write_stream(
+        [
+            {**req, "outcome": "shed", "shed_reason": "kv_pages_exhausted"},
+            {**req, "outcome": "ok", "tokens_out": 6, "batch_size_at_decode": 3.5,
+             "queue_wait_s": 0.01},
+        ]
+    )
+    warnings_out = []
+    assert validate_events(good, strict_spans=False, warnings_out=warnings_out) == []
+    assert warnings_out == []
+    # rows WITHOUT the engine fields stay valid (older streams)
+    old = write_stream([{**req, "outcome": "ok"}])
+    assert validate_events(old, strict_spans=False) == []
+
+
+def test_ledger_floor_ceilings_supported():
+    """Ledger floors support ``max`` ceilings (ISSUE 13: the engine p99-TPOT
+    ceiling rides one) alongside ``min`` floors; an entry with neither is
+    invalid."""
+    base = {"schema_version": 1, "features": {}}
+    ok = {**base, "floors": {
+        "f1": {"artifact": "X_r*.json", "key": "a.b", "min": 1.0},
+        "f2": {"artifact": "X_r*.json", "key": "a.c", "max": 0.5},
+        "f3": {"artifact": "X_r*.json", "key": "a.d", "min": 0, "max": 2},
+    }}
+    assert validate_ledger(ok) == []
+    bad = {**base, "floors": {"f": {"artifact": "X_r*.json", "key": "a"}}}
+    assert any("min and/or max" in p for p in validate_ledger(bad))
+    # the committed ledger actually USES a ceiling for the engine tail
+    doc = json.load(open(os.path.join(CONTRACTS, "ledger.json")))
+    assert "max" in doc["floors"]["engine_tpot_p99_s"]
+    assert "min" in doc["floors"]["engine_throughput_tok_s"]
+
+
 def test_load_rounds_monotone_and_well_formed():
     """LOAD_r*.json — the committed serving-load artifacts (ISSUE 11):
     contiguous round numbering and the machine-read surface the load gate's

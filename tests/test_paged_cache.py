@@ -1,0 +1,209 @@
+"""Paged KV cache units (ISSUE 13 Pageline): the cache discipline seam —
+paged append/gather-view exactness vs the contiguous cache, the prefill
+commit path, int8 storage parity, the page-walk Pallas kernel vs its gather
+reference (interpret mode), and the pure host-side page allocator."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from perceiver_io_tpu.core.cache import (
+    KVCache,
+    PagedKVCache,
+    commit_prefill,
+    init_kv_cache,
+    init_paged_kv_cache,
+    release_slot,
+)
+from perceiver_io_tpu.serving.pages import PageAllocator
+
+C = 64  # channels (8 heads x 8 or 4 x 16 — kernel tests pick their own)
+
+
+def _rand(rng, *shape):
+    return jnp.asarray(rng.standard_normal(shape).astype(np.float32))
+
+
+# ---------------------------------------------------------------- disciplines
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.int8])
+def test_paged_append_matches_contiguous(dtype):
+    """Token-for-token: appending the same stream into a contiguous cache
+    and into pages yields identical slot contents through gather_view —
+    the storage seam the engine's token-exactness rides on."""
+    rng = np.random.default_rng(0)
+    b, page, pps = 3, 4, 3
+    cap = page * pps
+    cont = init_kv_cache(b, cap, C, C, dtype=dtype)
+    paged = init_paged_kv_cache(b, 1 + b * pps, page, pps, C, C, dtype=dtype)
+    table = jnp.arange(1, 1 + b * pps, dtype=jnp.int32).reshape(b, pps)
+    paged = PagedKVCache(
+        k=paged.k, v=paged.v, page_table=table, length=paged.length,
+        k_scale=paged.k_scale, v_scale=paged.v_scale,
+    )
+    for _ in range(cap):
+        k = _rand(rng, b, 1, C)
+        v = _rand(rng, b, 1, C)
+        cont = cont.append(k, v)
+        paged = paged.append(k, v)
+    pk, pv, pks, pvs = paged.gather_view()
+    np.testing.assert_array_equal(np.asarray(pk), np.asarray(cont.k))
+    np.testing.assert_array_equal(np.asarray(pv), np.asarray(cont.v))
+    assert np.all(np.asarray(paged.length) == cap)
+    assert int(cont.length) == cap
+    if dtype == jnp.int8:
+        np.testing.assert_array_equal(np.asarray(pks), np.asarray(cont.k_scale))
+        np.testing.assert_array_equal(np.asarray(pvs), np.asarray(cont.v_scale))
+
+
+def test_ragged_lengths_stay_independent():
+    """Per-slot lengths: appends advance every slot, but each slot's view
+    masks at ITS length — slot contents never bleed across page tables."""
+    rng = np.random.default_rng(1)
+    b, page, pps = 2, 4, 2
+    paged = init_paged_kv_cache(b, 1 + b * pps, page, pps, C, C)
+    table = jnp.arange(1, 1 + b * pps, dtype=jnp.int32).reshape(b, pps)
+    paged = PagedKVCache(k=paged.k, v=paged.v, page_table=table,
+                         length=jnp.asarray([0, 3], jnp.int32))
+    k = _rand(rng, b, 1, C)
+    paged2 = paged.append(k, k)
+    assert np.asarray(paged2.length).tolist() == [1, 4]
+    pk, _, _, _ = paged2.gather_view()
+    # slot 0 wrote its page 1 at offset 0; slot 1 wrote its page 3 at offset 3
+    np.testing.assert_array_equal(np.asarray(pk[0, 0]), np.asarray(k[0, 0]))
+    np.testing.assert_array_equal(np.asarray(pk[1, 3]), np.asarray(k[1, 0]))
+
+
+def test_commit_prefill_and_release_roundtrip():
+    """The disaggregation seam: a contiguous prefill cache's rows land in
+    the granted pages with the request's true length; release parks the
+    table row back on scratch without touching pool bytes."""
+    rng = np.random.default_rng(2)
+    b_slots, page, pps, n_tok = 2, 4, 3, 7
+    paged = init_paged_kv_cache(b_slots, 1 + b_slots * pps, page, pps, C, C)
+    pre = init_kv_cache(1, n_tok + 2, C, C)  # capacity beyond the tokens
+    pre = pre.append(_rand(rng, 1, n_tok, C), _rand(rng, 1, n_tok, C))
+    pages = jnp.asarray([2, 5], jnp.int32)  # ceil(7/4) = 2 pages
+    out = commit_prefill(paged, 1, pages, pre, pre.length)
+    assert int(out.length[1]) == n_tok and int(out.length[0]) == 0
+    assert np.asarray(out.page_table[1]).tolist() == [2, 5, 0]
+    pk, pv, _, _ = out.gather_view()
+    np.testing.assert_array_equal(
+        np.asarray(pk[1, :n_tok]), np.asarray(pre.k[0, :n_tok])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(pv[1, :n_tok]), np.asarray(pre.v[0, :n_tok])
+    )
+    released = release_slot(out, 1)
+    assert int(released.length[1]) == 0
+    assert np.asarray(released.page_table[1]).tolist() == [0, 0, 0]
+    # pool bytes untouched — only the table moved
+    np.testing.assert_array_equal(np.asarray(released.k), np.asarray(out.k))
+
+
+def test_paged_append_rejects_multi_token():
+    paged = init_paged_kv_cache(1, 3, 4, 2, C, C)
+    with pytest.raises(ValueError, match="one token per slot"):
+        paged.append(jnp.zeros((1, 2, C)), jnp.zeros((1, 2, C)))
+
+
+# ------------------------------------------------------------- pallas kernel
+
+
+def test_page_walk_kernel_matches_gather_reference():
+    """The TPU page-walk kernel (scalar-prefetched page-table BlockSpecs)
+    against the gather-view reference, in interpret mode — ragged lengths,
+    including an empty slot (fully masked -> zeros)."""
+    from perceiver_io_tpu.ops.paged_attention import (
+        paged_attention_reference,
+        paged_decode_attention,
+        paged_kernel_supported,
+    )
+
+    rng = np.random.default_rng(3)
+    s_slots, pool, page, h, d = 3, 10, 8, 4, 32  # h*d = 128 lanes
+    table = np.zeros((s_slots, 3), np.int32)
+    for s in range(s_slots):
+        table[s] = [1 + 3 * s, 2 + 3 * s, 3 + 3 * s]
+    cache = PagedKVCache(
+        k=_rand(rng, pool, page, h * d),
+        v=_rand(rng, pool, page, h * d),
+        page_table=jnp.asarray(table),
+        length=jnp.asarray([0, 17, 24], jnp.int32),
+    )
+    q = _rand(rng, s_slots, h, d)
+    assert paged_kernel_supported(cache, h, d, d)
+    got = paged_decode_attention(q, cache)
+    ref = paged_attention_reference(q, cache)
+    # every slot, including the EMPTY one (slot 0): a fully masked row
+    # softmaxes uniform over MASK_VALUE scores in both implementations —
+    # garbage either way, but the SAME garbage (the engine discards it)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-5)
+
+
+def test_kernel_gate_excludes_unsupported():
+    from perceiver_io_tpu.ops.paged_attention import paged_kernel_supported
+
+    quant = init_paged_kv_cache(1, 3, 8, 2, 128, 128, dtype=jnp.int8)
+    assert not paged_kernel_supported(quant, 4, 32, 32)  # int8 stays on fallback
+    tiny_pages = init_paged_kv_cache(1, 3, 4, 2, 128, 128)
+    assert not paged_kernel_supported(tiny_pages, 4, 32, 32)  # page < 8 rows
+    odd = init_paged_kv_cache(1, 3, 8, 2, 96, 96)
+    assert not paged_kernel_supported(odd, 4, 24, 24)  # 96 lanes unaligned
+
+
+# ------------------------------------------------------------ page allocator
+
+
+def test_allocator_deterministic_reuse():
+    """Alloc/free determinism: same history, same page ids; LIFO reuse
+    hands back the most recently freed pages first."""
+    a = PageAllocator(num_pages=8, page_size=4)
+    g1 = a.alloc_tokens(7)  # 2 pages
+    g2 = a.alloc_tokens(4)  # 1 page
+    assert g1.pages == (1, 2) and g2.pages == (3,)
+    a.free(g1)
+    g3 = a.alloc_tokens(5)  # 2 pages, LIFO: g1's pages back, most-recent first
+    assert g3.pages == (1, 2)
+    b = PageAllocator(num_pages=8, page_size=4)
+    h1 = b.alloc_tokens(7)
+    h2 = b.alloc_tokens(4)
+    b.free(h1)
+    h3 = b.alloc_tokens(5)
+    assert (h1.pages, h2.pages, h3.pages) == (g1.pages, g2.pages, g3.pages)
+    assert a.audit() == []
+
+
+def test_allocator_fragmentation_accounting():
+    a = PageAllocator(num_pages=10, page_size=8)
+    a.alloc_tokens(9)   # 2 pages, 7 slack
+    a.alloc_tokens(8)   # 1 page, 0 slack
+    st = a.stats()
+    assert st.pages_used == 3 and st.pages_free == 6
+    assert st.tokens_reserved == 17
+    assert st.internal_frag_tokens == 3 * 8 - 17 == 7
+    assert 0 < st.internal_frag_frac < 1
+    assert st.used_frac == 3 / 9
+
+
+def test_allocator_exhaustion_and_double_free():
+    a = PageAllocator(num_pages=4, page_size=4)  # 3 allocatable
+    g = a.alloc_tokens(12)  # all 3 pages
+    assert a.alloc_tokens(1) is None  # exhausted: first-class None, no raise
+    assert not a.can_fit_now(1) and a.can_ever_fit(12)
+    assert not a.can_ever_fit(13)  # beyond an EMPTY pool: shed territory
+    a.free(g)
+    with pytest.raises(ValueError, match="double free"):
+        a.free(g)
+    assert a.audit() == []
+    assert a.pages_used == 0 and a.pages_free == 3
+
+
+def test_allocator_scratch_reserved():
+    a = PageAllocator(num_pages=3, page_size=2)
+    g1, g2 = a.alloc_tokens(2), a.alloc_tokens(2)
+    assert g2 is not None and 0 not in g1.pages + g2.pages
+    with pytest.raises(ValueError):
+        PageAllocator(num_pages=1, page_size=2)

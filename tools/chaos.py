@@ -897,6 +897,105 @@ def scenario_serve_breaker(tmp):
     )
 
 
+def scenario_serve_engine_kill_mid_decode(tmp):
+    """Pageline: a request dies between tokens INSIDE a live decode batch —
+    only its slot retires (the rest of the batch keeps decoding), its pages
+    return to the free list, books close with exactly one ``error``, and
+    exactly one flight dump names the dead request's span."""
+    from perceiver_io_tpu.serving import EngineConfig, EngineFrontEnd, FaultInjector
+
+    model, params = _serving_model()
+    recorder, clock, run_dir = _serve_env(tmp, "serve_engine_kill")
+    injector = FaultInjector(clock=clock).kill_at(3, 2)
+    fe = EngineFrontEnd(
+        model, params, num_latents=4,
+        engine_config=EngineConfig(slots=4, page_size=8, max_ca_tokens=24,
+                                   max_sa_tokens=16),
+        events=recorder, clock=clock, sleep=clock.sleep, injector=injector,
+    )
+    recs = fe.run_closed(_serve_spec().draw(8, 64), concurrency=4)
+    books = _audit_serving(fe, run_dir, "serve_engine_kill_mid_decode")
+    assert [r.outcome for r in recs].count("error") == 1 and books["error"] == 1
+    assert books["admitted"] == 8 and books["ok"] == 7, books
+    dead = next(r for r in recs if r.outcome == "error")
+    assert dead.index == 3 and 0 < dead.tokens_out < dead.max_new_tokens, vars(dead)
+    # page-exact clean books: every page back on the free list, allocator
+    # invariants hold (no double-ownership, no leak)
+    assert fe.ca_alloc.pages_used == 0 and fe.sa_alloc.pages_used == 0, (
+        fe.ca_alloc.pages_used, fe.sa_alloc.pages_used
+    )
+    assert fe.ca_alloc.audit() == [] and fe.sa_alloc.audit() == []
+    dumps = recorder.dumps
+    assert len(dumps) == 1 and "flight-error" in os.path.basename(dumps[0]), dumps
+    with open(dumps[0]) as f:
+        dump = json.load(f)
+    err_rows = [e for e in _stream(run_dir)
+                if e.get("event") == "request" and e.get("outcome") == "error"]
+    assert len(err_rows) == 1
+    assert dump["trigger_span_id"] == err_rows[0]["span_id"], (
+        "flight dump does not name the dead request's span"
+    )
+    # the batch stayed live: the victim's event shows >1 requests in its
+    # decode batch, and the survivors' streams completed in full
+    assert err_rows[0].get("batch_size_at_decode", 0) > 1, err_rows[0]
+    ok_rows = [e for e in _stream(run_dir)
+               if e.get("event") == "request" and e.get("outcome") == "ok"]
+    assert all(e["tokens_out"] == 4 for e in ok_rows), ok_rows
+    print(
+        f"chaos: serve_engine_kill_mid_decode ok — request 3 killed after "
+        f"{dead.tokens_out} token(s) in a live batch "
+        f"(batch_size {err_rows[0]['batch_size_at_decode']}), slot + pages freed, "
+        "books balanced (7 ok / 1 error), 1 flight dump names its span"
+    )
+
+
+def scenario_serve_engine_pages(tmp):
+    """Pageline page-pool discipline: an impossible request (KV footprint
+    over the pool) sheds ``kv_pages_exhausted`` at admission; a pool sized
+    BELOW the slot count exerts backpressure (requests wait for pages, none
+    shed) and still serves everything; the allocator's books stay exact."""
+    from perceiver_io_tpu.obs.loadgen import RequestSpec
+    from perceiver_io_tpu.serving import EngineConfig, EngineFrontEnd
+
+    import numpy as np
+
+    model, params = _serving_model()
+    recorder, clock, run_dir = _serve_env(tmp, "serve_engine_pages")
+    # pool_headroom 0.5: pages for ~2 of the 4 slots — joins must wait
+    fe = EngineFrontEnd(
+        model, params, num_latents=4,
+        engine_config=EngineConfig(slots=4, page_size=8, max_ca_tokens=24,
+                                   max_sa_tokens=16, pool_headroom=0.5),
+        events=recorder, clock=clock, sleep=clock.sleep,
+    )
+    specs = list(_serve_spec().draw(8, 64))
+    # an impossible request: prompt + budget over max_ca_tokens
+    rng = np.random.default_rng(3)
+    specs.append(RequestSpec(index=len(specs), prompt_len=20, max_new_tokens=16,
+                             input_ids=rng.integers(0, 64, size=(1, 20)),
+                             rng_seed=7))
+    recs = fe.run_closed(specs, concurrency=9)
+    books = _audit_serving(fe, run_dir, "serve_engine_pages")
+    assert books["ok"] == 8 and books["shed"] == 1 and books["balanced"], books
+    shed = [r for r in recs if r.outcome == "shed"]
+    assert len(shed) == 1 and shed[0].shed_reason == "kv_pages_exhausted", shed
+    shed_rows = [e for e in _stream(run_dir)
+                 if e.get("event") == "request" and e.get("outcome") == "shed"]
+    assert len(shed_rows) == 1 and shed_rows[0]["shed_reason"] == "kv_pages_exhausted"
+    assert fe.ca_alloc.pages_used == 0 and fe.ca_alloc.audit() == []
+    assert fe.sa_alloc.pages_used == 0 and fe.sa_alloc.audit() == []
+    # backpressure really happened: the half-size CA pool (6 pages, 2 per
+    # request) caps the live batch at 3 of 4 slots — the 4th join must wait
+    # for a retire, so mean fill can never reach the full-pool value
+    assert fe.mean_batch_fill <= 0.75 + 1e-6, fe.mean_batch_fill
+    print(
+        "chaos: serve_engine_pages ok — half-size pool backpressured joins "
+        f"(mean batch fill {fe.mean_batch_fill:.2f}, page-capped at 3 of 4 "
+        "slots), 8 served / 1 impossible request shed kv_pages_exhausted, "
+        "page books exact"
+    )
+
+
 SCENARIOS = {
     "preempt": scenario_preempt,
     "preempt_mesh": scenario_preempt_mesh,
@@ -913,6 +1012,8 @@ SCENARIOS = {
     "serve_deadline": scenario_serve_deadline,
     "serve_drain": scenario_serve_drain,
     "serve_breaker": scenario_serve_breaker,
+    "serve_engine_kill_mid_decode": scenario_serve_engine_kill_mid_decode,
+    "serve_engine_pages": scenario_serve_engine_pages,
 }
 
 

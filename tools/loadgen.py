@@ -239,10 +239,18 @@ def run_gate(args) -> int:
         if args.write_artifact:
             # pre-validate THIS doc against the LOAD floors before it hits
             # disk: a sub-floor artifact (e.g. a --smoke-size run) would
-            # become the latest round and fail every future gate run
+            # become the latest round and fail every future gate run.
+            # NOTE the LOAD family is ENGINE-floored since PR 13 (a
+            # deliberate ratchet: committed serving rounds must sustain
+            # engine-scale throughput) — sequential runs certify telemetry
+            # here but produce new rounds with --engine.
             floor_fails = check_doc_floors(doc)
             if floor_fails:
-                problems += [f"refusing to write artifact: {f}" for f in floor_fails]
+                problems += [
+                    f"refusing to write artifact: {f} (the LOAD family is "
+                    "engine-floored — produce committed rounds with --engine)"
+                    for f in floor_fails
+                ]
             else:
                 path = os.path.join(_REPO, f"LOAD_r{doc['n']:02d}.json")
                 with open(path, "w") as f:
@@ -263,6 +271,228 @@ def run_gate(args) -> int:
             f"ttft_p99={summary['ttft_s']['p99']}s "
             f"queue_p99={summary['queue_wait_s']['p99']}s "
             f"(1 planted breach -> 1 flight dump)"
+        )
+        return 0
+    except Exception as e:  # noqa: BLE001 — CI must see crash != verdict
+        print(f"loadgen: internal error: {e}", file=sys.stderr)
+        import traceback
+
+        traceback.print_exc()
+        return 3
+    finally:
+        if not keep:
+            shutil.rmtree(out_dir, ignore_errors=True)
+
+
+def run_engine_gate(args) -> int:
+    """The ENGINE leg (``--engine``): a closed-loop run through the
+    continuous-batching paged-KV engine (``serving.engine.EngineFrontEnd``,
+    docs/serving.md) instead of the sequential instrumented path. Asserts:
+
+    1. every request served ok, books balanced, zero leaked slots AND zero
+       leaked pages (allocator audit);
+    2. the event stream validates — engine ``request`` rows carry
+       queue-wait and the ``batch_size_at_decode`` field;
+    3. a planted mid-decode kill (its own engine instance + recorder, so
+       the main artifact stays clean) leaves books balanced with exactly
+       one flight dump naming the dead request's span;
+    4. ``/metrics`` exposes the engine gauges
+       (``engine_batch_fill_frac`` / ``engine_kv_pages_used``);
+    5. the summary diffs clean against itself and the LOAD floors hold —
+       including the engine throughput floor and p99-TPOT ceiling.
+    """
+    import time as _time
+
+    from perceiver_io_tpu.obs.events import EventLog, validate_events, write_run_manifest
+    from perceiver_io_tpu.obs.flightrec import FlightRecorder, SLOBounds
+    from perceiver_io_tpu.obs.loadgen import (
+        RequestRecord,
+        WorkloadSpec,
+        build_load_doc,
+        diff_load,
+        format_load_diff,
+        summarize_load,
+    )
+    from perceiver_io_tpu.obs.metrics import MetricsRegistry
+    from perceiver_io_tpu.obs.server import ObsServer
+    from perceiver_io_tpu.serving import EngineConfig, EngineFrontEnd
+    from perceiver_io_tpu.serving.faultinject import FaultInjector
+
+    out_dir = args.out or tempfile.mkdtemp(prefix="loadgen_engine_")
+    keep = args.keep or args.out is not None
+    problems: list = []
+    try:
+        n_requests = args.requests
+        spec = WorkloadSpec(seed=args.seed)
+        engine_cfg = EngineConfig(
+            slots=args.slots, page_size=8, max_ca_tokens=24, max_sa_tokens=16
+        )
+        print(
+            f"loadgen: ENGINE closed-loop, {n_requests} requests "
+            f"(slots {engine_cfg.slots}, concurrency {args.concurrency}) -> {out_dir}"
+        )
+        model, params, config = build_workload()
+        events = EventLog(out_dir, main_process=True)
+        manifest = write_run_manifest(
+            out_dir, model_config=config,
+            extra={"workload_spec": spec.to_dict(), "engine": True},
+            main_process=True,
+        )
+        recorder = FlightRecorder(
+            events, out_dir=out_dir,
+            slo=SLOBounds(ttft_s=args.ttft_slo, tpot_p99_s=args.tpot_slo),
+        )
+        from perceiver_io_tpu.serving import FrontEndConfig
+
+        registry = MetricsRegistry()
+        fe = EngineFrontEnd(
+            model, params, num_latents=4, engine_config=engine_cfg,
+            # frequent enough that live batch-fill/page gauges land in the
+            # stream, coarse enough that snapshot I/O stays off the hot loop
+            config=FrontEndConfig(snapshot_interval_s=0.25),
+            events=recorder, registry=registry,
+        )
+        specs = spec.draw(n_requests, int(config.vocab_size))
+        with ObsServer(registry=registry, run_dir=out_dir, health=fe.health) as server:
+            t0 = _time.perf_counter()
+            recs = fe.run_closed(specs, concurrency=args.concurrency)
+            duration_s = _time.perf_counter() - t0
+
+            metrics_text = _fetch(server.url + "/metrics")
+            for gauge in ("engine_batch_fill_frac", "engine_kv_pages_used"):
+                if gauge not in metrics_text:
+                    problems.append(f"/metrics lacks the {gauge} gauge")
+            health = json.loads(_fetch(server.url + "/healthz"))
+            if health.get("books_balanced") is not True:
+                problems.append(f"/healthz books_balanced {health.get('books_balanced')!r}")
+
+        books = fe.books()
+        problems += [f"engine books: {p}" for p in fe.audit()]
+        problems += [f"ca pages: {p}" for p in fe.ca_alloc.audit()]
+        problems += [f"sa pages: {p}" for p in fe.sa_alloc.audit()]
+        if fe.ca_alloc.pages_used or fe.sa_alloc.pages_used:
+            problems.append(
+                f"pages leaked after drain: ca={fe.ca_alloc.pages_used} "
+                f"sa={fe.sa_alloc.pages_used}"
+            )
+        if books["ok"] != n_requests:
+            problems.append(f"served {books['ok']}/{n_requests} ok: {books}")
+
+        records = [
+            RequestRecord(
+                index=r.index, prompt_len=r.prompt_len,
+                max_new_tokens=r.max_new_tokens, batch=r.batch,
+                queue_wait_s=r.queue_wait_s or 0.0,
+                outcome="ok" if r.outcome == "ok" else "error",
+                compiled=r.compiled, ttft_s=r.ttft_s, decode_s=r.decode_s,
+                tokens_out=r.tokens_out,
+            )
+            for r in recs
+        ]
+        summary = summarize_load(
+            records, duration_s, registry=registry, mode="closed",
+            concurrency=args.concurrency,
+        )
+        summary["engine"] = {
+            "slots": engine_cfg.slots,
+            "page_size": engine_cfg.page_size,
+            "decode_steps": fe._engine_steps,
+            "batch_fill_frac": round(fe.mean_batch_fill, 6),
+        }
+        if events is not None:
+            events.emit("load.summary", **summary)
+            registry.maybe_emit(events, min_interval_s=0.0)
+        print(
+            f"loadgen: engine served {summary['n_requests']} requests in "
+            f"{summary['duration_s']:.2f}s ({summary['throughput_tok_s']:.0f} tok/s, "
+            f"{fe._engine_steps} batched steps, {summary['errors']} errors)"
+        )
+
+        # --- planted mid-decode kill: separate instance, clean main books --
+        plant_dir = os.path.join(out_dir, "plant")
+        plant_events = EventLog(plant_dir, main_process=True)
+        plant_rec = FlightRecorder(plant_events, out_dir=plant_dir, slo=SLOBounds())
+        injector = FaultInjector().kill_at(2, 1)
+        plant_fe = EngineFrontEnd(
+            model, params, num_latents=4, engine_config=engine_cfg,
+            events=plant_rec, injector=injector,
+        )
+        plant_recs = plant_fe.run_closed(spec.draw(6, int(config.vocab_size)),
+                                         concurrency=4)
+        plant_books = plant_fe.books()
+        if not plant_books["balanced"] or plant_books["error"] != 1:
+            problems.append(f"planted kill books not clean: {plant_books}")
+        if plant_fe.ca_alloc.pages_used or plant_fe.sa_alloc.pages_used:
+            problems.append("planted kill leaked pages")
+        if len(plant_rec.dumps) != 1:
+            problems.append(
+                f"planted kill produced {len(plant_rec.dumps)} flight dumps, want 1"
+            )
+        else:
+            with open(plant_rec.dumps[0]) as f:
+                dump = json.load(f)
+            from perceiver_io_tpu.obs.events import merged_events as _merged
+
+            err_rows = [e for e in _merged(plant_dir)
+                        if e.get("event") == "request" and e.get("outcome") == "error"]
+            if len(err_rows) != 1 or dump.get("trigger_span_id") != err_rows[0].get("span_id"):
+                problems.append("kill dump does not name the dead request's span")
+        dead = next((r for r in plant_recs if r.outcome == "error"), None)
+        if dead is None or not (0 < dead.tokens_out < dead.max_new_tokens):
+            problems.append(f"planted kill not mid-decode: {dead}")
+
+        # --- stream validation (engine rows carry the new optional field) --
+        warnings_out: list = []
+        problems += validate_events(out_dir, warnings_out=warnings_out)
+        for w in warnings_out:
+            print(f"loadgen: warning: {w}")
+        from perceiver_io_tpu.obs.events import merged_events
+
+        stream = merged_events(out_dir)
+        req_rows = [e for e in stream if e.get("event") == "request"]
+        if len(req_rows) != n_requests:
+            problems.append(f"{len(req_rows)} request rows, want {n_requests}")
+        if not any(e.get("batch_size_at_decode") for e in req_rows):
+            problems.append("no request row carries batch_size_at_decode")
+        if not all(e.get("queue_wait_s") is not None for e in req_rows):
+            problems.append("engine request rows missing queue_wait_s")
+
+        for key in ("achieved_rps", "throughput_tok_s", "error_rate", "ttft_s",
+                    "queue_wait_s", "tpot_s", "breakdown_ms"):
+            if key not in summary:
+                problems.append(f"engine summary missing {key!r}")
+
+        doc = build_load_doc(
+            args.round or _next_round(), summary, spec, manifest=manifest,
+        )
+        self_diff = diff_load(doc, doc)
+        if not (self_diff["comparable"] and self_diff["ok"]):
+            problems.append("run-vs-itself load diff NOT clean: "
+                            + format_load_diff(self_diff))
+
+        if args.write_artifact:
+            floor_fails = check_doc_floors(doc)
+            if floor_fails:
+                problems += [f"refusing to write artifact: {f}" for f in floor_fails]
+            else:
+                path = os.path.join(_REPO, f"LOAD_r{doc['n']:02d}.json")
+                with open(path, "w") as f:
+                    json.dump(doc, f, indent=1, sort_keys=True)
+                    f.write("\n")
+                print(f"loadgen: wrote {path}")
+
+        problems += check_load_floors()
+
+        if problems:
+            print("loadgen: engine gate FAILED:")
+            for p in problems:
+                print(f"  - {p}")
+            return 1
+        print(
+            "loadgen: engine OK — "
+            f"{summary['throughput_tok_s']:.0f} tok/s at ok_rate "
+            f"{summary['ok_rate']} (planted mid-decode kill: books balanced, "
+            "1 flight dump, pages freed)"
         )
         return 0
     except Exception as e:  # noqa: BLE001 — CI must see crash != verdict
@@ -305,8 +535,13 @@ def check_doc_floors(doc: dict) -> list:
     failures = []
     for name, floor in _load_floors().items():
         value = _dig(doc, floor["key"])
-        if not isinstance(value, (int, float)) or value < floor["min"]:
+        if not isinstance(value, (int, float)):
+            failures.append(f"{name}: {floor['key']} = {value!r} missing or non-numeric")
+            continue
+        if "min" in floor and value < floor["min"]:
             failures.append(f"{name}: {floor['key']} = {value!r} below floor {floor['min']}")
+        if "max" in floor and value > floor["max"]:
+            failures.append(f"{name}: {floor['key']} = {value!r} above ceiling {floor['max']}")
     return failures
 
 
@@ -358,6 +593,13 @@ def main(argv=None) -> int:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--smoke", action="store_true",
                    help="CI-fast gate: 24 requests, same assertions")
+    p.add_argument("--engine", action="store_true",
+                   help="drive the continuous-batching paged-KV engine "
+                        "(serving.engine) instead of the sequential path; "
+                        "includes a planted mid-decode kill with a clean-books "
+                        "audit (default 400 requests, 24 with --smoke)")
+    p.add_argument("--slots", type=int, default=8,
+                   help="engine decode slots (batched step width)")
     p.add_argument("--out", default=None, help="run dir (default: a temp dir)")
     p.add_argument("--keep", action="store_true", help="keep the run dir (implied by --out)")
     p.add_argument("--write-artifact", action="store_true",
@@ -375,9 +617,13 @@ def main(argv=None) -> int:
     if args.diff:
         return run_diff(args)
     if args.requests is None:
-        args.requests = 24 if args.smoke else 200
+        args.requests = 24 if args.smoke else (400 if args.engine else 200)
     if args.mode == "open" and not args.rate:
         p.error("--mode open needs --rate")
+    if args.engine:
+        if args.mode != "closed":
+            p.error("--engine runs the closed-loop gate")
+        return run_engine_gate(args)
     return run_gate(args)
 
 

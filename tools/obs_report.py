@@ -323,6 +323,31 @@ def render(run_dir: str, max_compile_rows: int = 20) -> str:
                 f"  queue_wait_s: p50 {_pct(qws, 50):.4g}  p99 {_pct(qws, 99):.4g}  "
                 f"mean {sum(qws)/len(qws):.4g}" + note
             )
+        # batched-engine occupancy (Pageline, docs/serving.md): requests
+        # served by the continuous-batching engine carry the batch size
+        # their decode steps ran at
+        bsz = [float(g["batch_size_at_decode"]) for g in reqs
+               if g.get("batch_size_at_decode") is not None]
+        if bsz:
+            lines.append(
+                f"  batch_size_at_decode: mean {sum(bsz)/len(bsz):.4g}  "
+                f"min {min(bsz):.4g}  max {max(bsz):.4g}  ({len(bsz)} engine requests)"
+            )
+
+    # engine gauges (Pageline): the LAST registry snapshot's engine_* gauges
+    # plus their run maxima — batch occupancy and page-pool utilization
+    metric_rows = [e for e in events if e.get("event") == "metrics"]
+    engine_series: Dict[str, List[float]] = {}
+    for e in metric_rows:
+        for k, v in (e.get("gauges") or {}).items():
+            if k.startswith("engine_") and isinstance(v, (int, float)):
+                engine_series.setdefault(k, []).append(float(v))
+    if engine_series:
+        lines.append("")
+        lines.append("== engine (paged KV / continuous batching) ==")
+        for k in sorted(engine_series):
+            vals = engine_series[k]
+            lines.append(f"  {k}: last {vals[-1]:.4g}  max {max(vals):.4g}")
 
     # per-request tail attribution: queue-wait -> prefill -> decode ->
     # compile-if-cold, the compile leg joined from span-stamped compile
