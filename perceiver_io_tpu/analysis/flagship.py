@@ -339,11 +339,62 @@ def build_targets(
                 ),
                 allow=allow,
             )
+    if "decode_spec" in targets:
+        # the SPECULATIVE draft/verify span (Specline): drafter scan + ONE
+        # flagship verify forward + rejection-sampling accept + length-
+        # counter rollback — the contract pins that no kv-axis concatenate
+        # appears and the verify stays a single span-append per cache
+        fn, args = _build_decode_spec_args(model, config, params, g, dtype)
+        out["decode_spec"] = LintTarget(
+            name="decode_spec",
+            fn=fn,
+            args=args,
+            policy=LintPolicy(
+                bf16_scopes=bf16_scopes,
+                collective_budget=collective_budget,
+                **dataflow_policy,
+            ),
+            allow=allow,
+        )
     return out
 
 
 # paged-step geometry per flagship geometry: tokens per KV page
 PAGED_PAGE_SIZE = {"micro": 16, "flagship": 64}
+
+# decode_spec program geometry: draft-span width and drafter depth — tiny
+# on purpose (graph shape, not perf, is what the contract pins)
+SPEC_K = 2
+SPEC_DEPTH = 1
+
+
+def _build_decode_spec_args(model, config, params, g: dict, dtype):
+    """The ``decode_spec`` program: one speculative draft/verify span
+    (``generation.make_speculative_decode_fns``' step fn) plus its
+    post-prefill state (produced by actually running the jitted spec
+    prefill at build time — the program under contract is the STEP).
+    Half-window prompt and half the latent budget keep the no-slide
+    validation satisfied at every geometry."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from perceiver_io_tpu.generation import GenerationConfig, make_speculative_decode_fns
+
+    rng = np.random.default_rng(7)
+    prompt_len = g["seq_len"] // 2
+    num_latents = g["latents"] // 2
+    prompt = jnp.asarray(rng.integers(0, config.vocab_size, size=(1, prompt_len)))
+    prefill, step = make_speculative_decode_fns(
+        model,
+        num_latents,
+        GenerationConfig(max_new_tokens=g["decode_tokens"], do_sample=True, top_k=10),
+        k=SPEC_K,
+        draft_depth=SPEC_DEPTH,
+        cache_dtype=dtype,
+    )
+    _, state = prefill(params, prompt, None, jax.random.PRNGKey(0))
+    return step, (state,)
 
 
 def _build_decode_paged_args(model, config, params, g: dict, dtype):
@@ -441,11 +492,12 @@ def lint_flagship(
 # (tasks.py perf): flat train, the Probeline-instrumented flat train (the
 # contract that probes add zero collectives/callbacks and bounded bytes),
 # the GSPMD and overlap-scheduled sharded train steps on the
-# DEFAULT_MESH_SPEC submesh, prefill, decode, and the engine's batched
-# paged decode step (decode_paged — PR 13 Pageline)
+# DEFAULT_MESH_SPEC submesh, prefill, decode, the engine's batched paged
+# decode step (decode_paged — PR 13 Pageline), and the speculative
+# draft/verify span (decode_spec — PR 14 Specline)
 PROGRAMS = (
     "train_flat", "train_probed", "train_sharded", "train_overlap", "prefill",
-    "decode", "decode_paged",
+    "decode", "decode_paged", "decode_spec",
 )
 DEFAULT_MESH_SPEC = "data=2,fsdp=2"
 
@@ -464,7 +516,11 @@ def build_programs(
     if unknown:
         raise ValueError(f"unknown program(s) {unknown}; known: {PROGRAMS}")
     out: Dict[str, LintTarget] = {}
-    flat = [p for p in ("train_flat", "prefill", "decode", "decode_paged") if p in programs]
+    flat = [
+        p
+        for p in ("train_flat", "prefill", "decode", "decode_paged", "decode_spec")
+        if p in programs
+    ]
     if flat:
         built = build_targets(
             geometry, targets=tuple({"train_flat": "train"}.get(p, p) for p in flat)

@@ -198,6 +198,42 @@ def _latest_artifact(repo_root: str, pattern: str) -> Optional[str]:
     return best
 
 
+def doc_matches(doc: Any, match: Optional[dict]) -> bool:
+    """True iff every dotted key of a floor's ``match`` clause holds in the
+    doc: the sentinel value ``"*"`` requires presence (non-null), anything
+    else requires equality. No clause matches everything."""
+    for dotted, want in (match or {}).items():
+        got = _dig(doc, dotted)
+        if (got is None) if want == "*" else (got != want):
+            return False
+    return True
+
+
+def _floor_artifact(repo_root: str, floor: dict) -> Optional[str]:
+    """The artifact a floor reads: the highest round of its glob whose doc
+    satisfies the floor's optional ``match`` clause. One ``X_r*.json``
+    family can hold rounds of several modes (LOAD_r01 sequential-closed,
+    r02 engine-closed, r03 engine-open); without the clause every floor
+    would read whatever mode committed last — an open-loop round silently
+    standing in for the closed-loop certification and vice versa."""
+    match = floor.get("match")
+    if not match:
+        return _latest_artifact(repo_root, floor["artifact"])
+    rounds = []
+    for path in glob.glob(os.path.join(repo_root, floor["artifact"])):
+        m = _ROUND_RE.search(path)
+        rounds.append((int(m.group(1)) if m else 0, path))
+    for _, path in sorted(rounds, reverse=True):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if doc_matches(doc, match):
+            return path
+    return None
+
+
 def _dig(doc: Any, dotted: str) -> Any:
     cur = doc
     for part in dotted.split("."):
@@ -215,9 +251,10 @@ def check_bench_floors(ledger: Optional[dict], repo_root: str) -> List[str]:
         return []
     failures: List[str] = []
     for name, floor in ledger.get("floors", {}).items():
-        path = _latest_artifact(repo_root, floor["artifact"])
+        path = _floor_artifact(repo_root, floor)
         if path is None:
-            failures.append(f"{name}: no artifact matches {floor['artifact']!r}")
+            clause = f" with {floor['match']}" if floor.get("match") else ""
+            failures.append(f"{name}: no artifact matches {floor['artifact']!r}{clause}")
             continue
         try:
             with open(path) as f:

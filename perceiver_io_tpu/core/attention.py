@@ -363,6 +363,53 @@ class MultiHeadAttention(nn.Module):
             )
         return AttentionOutput(last_hidden_state=self.o_proj(o_row), kv_cache=cache)
 
+    def _paged_span_attend(
+        self, q, cache: PagedKVCache, pad_mask, rope_q, deterministic
+    ) -> AttentionOutput:
+        """Multi-query decode attention over a paged cache (n_q > 1) — the
+        speculative VERIFY geometry: a k+1-token span scored in ONE forward
+        against each slot's pages (``generation.make_speculative_paged_
+        step_fn``). Numerically the generic einsum fallback of ``__call__``
+        with PER-SLOT lengths: gather view, per-row right-aligned causal
+        mask (query i of slot b sits at absolute slot ``length[b] - n_q +
+        i`` — the span was just appended), f32 score island, materialized
+        int8 dequant (the span is k+1 queries — the block-diagonal
+        single-query trick does not apply). The TPU page-walk kernel stays
+        single-query; the span always takes the budgeted gather route."""
+        b, n_q = q.shape[0], q.shape[1]
+        h = self.num_heads
+        qk_per_head = self.qk_channels // h
+        q = self._split_heads(q, qk_per_head) * qk_per_head**-0.5
+        if rope_q is not None:
+            q = apply_rotary_pos_emb(q, rope_q[:, None, :, :])
+
+        with jax.named_scope("paged_kv_view"):
+            k_slots, v_slots, k_scale, v_scale = cache.gather_view()
+        n_kv = k_slots.shape[1]
+        kv_idx = jnp.arange(n_kv, dtype=jnp.int32)
+        q_abs = cache.length[:, None] - n_q + jnp.arange(n_q, dtype=jnp.int32)[None, :]
+        masked = kv_idx[None, None, :] > q_abs[:, :, None]  # (B, n_q, n_kv)
+        if pad_mask is not None:
+            masked = masked | pad_mask[:, None, :n_kv]
+        masked = masked[:, None]  # (B, 1, n_q, n_kv)
+
+        if cache.quantized:
+            k_read = k_slots.astype(q.dtype) * k_scale[..., None].astype(q.dtype)
+            v_read = v_slots.astype(q.dtype) * v_scale[..., None].astype(q.dtype)
+        else:
+            k_read, v_read = k_slots, v_slots
+        k_h = k_read.reshape(b, n_kv, h, qk_per_head)
+        v_h = v_read.reshape(b, n_kv, h, self.v_channels // h)
+        with jax.named_scope("decode_attend"):
+            scores = jnp.einsum(
+                "bhic,bjhc->bhij", q, k_h, preferred_element_type=jnp.float32
+            )
+            scores = jnp.where(masked, -jnp.finfo(jnp.float32).max, scores)
+            attn = jax.nn.softmax(scores)
+            attn = self.attn_dropout(attn, deterministic=deterministic)
+            o = jnp.einsum("bhij,bjhc->bhic", attn.astype(v_h.dtype), v_h)
+        return AttentionOutput(last_hidden_state=self.merge_output(o), kv_cache=cache)
+
     def __call__(
         self,
         x_q: jnp.ndarray,
@@ -419,10 +466,21 @@ class MultiHeadAttention(nn.Module):
                 # paged discipline (the engine decode step): page-table-
                 # indexed append, then the paged attend — the contiguous
                 # code below never sees a paged cache, so the sliding-window
-                # graph is untouched by this dispatch
+                # graph is untouched by this dispatch. n_q == 1 keeps the
+                # committed decode_paged append/attend graphs op-for-op; a
+                # multi-token span (the speculative verify) takes the span
+                # scatter + per-slot-causal gather route
                 with jax.named_scope("paged_kv_append"):
-                    new_cache = kv_cache.append(k, v)
-                return self._paged_decode_attend(
+                    new_cache = (
+                        kv_cache.append(k, v)
+                        if n_q == 1
+                        else kv_cache.append_span(k, v)
+                    )
+                if n_q == 1:
+                    return self._paged_decode_attend(
+                        q, new_cache, pad_mask, rope_q, deterministic
+                    )
+                return self._paged_span_attend(
                     q, new_cache, pad_mask, rope_q, deterministic
                 )
             with jax.named_scope("kv_cache_append"):

@@ -250,6 +250,43 @@ class PagedKVCache:
             v_scale=None,
         )
 
+    def append_span(self, k: jnp.ndarray, v: jnp.ndarray) -> "PagedKVCache":
+        """Append N tokens per slot at each slot's own fill level — the
+        SPECULATIVE VERIFY geometry (``generation.make_speculative_paged_
+        step_fn``): token ``i`` of slot ``b`` lands at position
+        ``length[b] + i``, via a page-table gather for the page ids and one
+        scatter per pool (k, v, and the scale planes when quantized) —
+        still no kv-axis concatenate, the same discipline :meth:`append`
+        pins one token at a time. Rollback of a rejected span suffix is the
+        CALLER adjusting ``length`` back down (a per-slot counter move; the
+        written slots beyond the new length are dead until the next span
+        overwrites them). Out-of-range positions clamp into the slot's last
+        page — callers provision ``pages_per_slot`` with span slack."""
+        n = k.shape[1]
+        pos = self.length[:, None] + jnp.arange(n, dtype=jnp.int32)[None, :]  # (B, n)
+        page_idx = jnp.minimum(pos // self.page_size, self.pages_per_slot - 1)
+        page_id = jnp.take_along_axis(self.page_table, page_idx, axis=1)  # (B, n)
+        offset = pos % self.page_size
+        if self.quantized:
+            k_q, k_sc = quantize_kv(k)
+            v_q, v_sc = quantize_kv(v)
+            return PagedKVCache(
+                k=self.k.at[page_id, offset].set(k_q.astype(self.k.dtype)),
+                v=self.v.at[page_id, offset].set(v_q.astype(self.v.dtype)),
+                page_table=self.page_table,
+                length=self.length + n,
+                k_scale=self.k_scale.at[page_id, offset].set(k_sc),
+                v_scale=self.v_scale.at[page_id, offset].set(v_sc),
+            )
+        return PagedKVCache(
+            k=self.k.at[page_id, offset].set(k.astype(self.k.dtype)),
+            v=self.v.at[page_id, offset].set(v.astype(self.v.dtype)),
+            page_table=self.page_table,
+            length=self.length + n,
+            k_scale=None,
+            v_scale=None,
+        )
+
     def gather_view(self) -> Tuple[jnp.ndarray, jnp.ndarray, Optional[jnp.ndarray], Optional[jnp.ndarray]]:
         """The contiguous (B, capacity, C) view of every slot's pages — the
         ``jax.lax`` gather fallback the CPU tier-1 suite certifies

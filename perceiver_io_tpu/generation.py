@@ -197,21 +197,21 @@ def _shift_left_if_full(cache: KVCache) -> KVCache:
     return lax.cond(full, shift, lambda c: c, cache)
 
 
-@jax.named_scope("sample")
-def _sample(logits: jnp.ndarray, rng: jax.Array, config: GenerationConfig) -> jnp.ndarray:
-    """Sample next-token ids from (B, V) logits."""
-    if not config.do_sample:
-        return jnp.argmax(logits, axis=-1)
-
+def _filtered_logits(logits: jnp.ndarray, config: GenerationConfig) -> jnp.ndarray:
+    """The f32 temperature/top-k/top-p-filtered logits :func:`_sample` draws
+    from, factored out so the speculative accept/residual math (rejection
+    sampling needs the REAL sampling distributions p and q, filters
+    included) can never drift from the sampling path. Rank-generic over
+    leading axes; op-for-op the filtering `_sample` has always traced."""
     logits = logits.astype(jnp.float32) / jnp.maximum(config.temperature, 1e-6)
 
     if config.top_k is not None:
         top_k = min(config.top_k, logits.shape[-1])
-        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
 
     if config.top_p is not None:
-        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
         probs = jax.nn.softmax(sorted_logits, axis=-1)
         cum = jnp.cumsum(probs, axis=-1)
         # number of tokens needed to reach top_p mass (at least 1)
@@ -219,7 +219,15 @@ def _sample(logits: jnp.ndarray, rng: jax.Array, config: GenerationConfig) -> jn
         cutoff_logit = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
         logits = jnp.where(logits < cutoff_logit, -jnp.inf, logits)
 
-    return jax.random.categorical(rng, logits, axis=-1)
+    return logits
+
+
+@jax.named_scope("sample")
+def _sample(logits: jnp.ndarray, rng: jax.Array, config: GenerationConfig) -> jnp.ndarray:
+    """Sample next-token ids from (B, V) logits."""
+    if not config.do_sample:
+        return jnp.argmax(logits, axis=-1)
+    return jax.random.categorical(rng, _filtered_logits(logits, config), axis=-1)
 
 
 def _require_pads_in_prefix(pad_mask, prefix_len: int) -> None:
@@ -784,6 +792,458 @@ def make_decode_fns(
             return new_state, token
 
     return jax.jit(prefill), jax.jit(step)
+
+
+# ---------------------------------------------------------------------------
+# Specline — speculative self-drafting decode (draft k cheap tokens, verify
+# them in ONE flagship forward; arXiv:2603.09555 for the drafter-state
+# design, the PR-13 paged substrate for the ragged verify geometry)
+# ---------------------------------------------------------------------------
+
+# keeps drafter proposal keys off the sequential rng chain: the chain itself
+# advances one split per EMITTED token (the alignment that makes seeds
+# reproduce across the speculative and sequential paths)
+_DRAFT_SALT = 0x5BEC
+
+
+def make_drafter(model, draft_depth: int):
+    """The truncated-depth SELF-drafter: the same model class over a config
+    whose latent self-attention stack keeps only the FIRST ``draft_depth``
+    layers — no separate training, the drafter runs the flagship's own
+    weights (:func:`drafter_decode_params` carves the matching subtree).
+    Because layer i's input is layer i-1's output, the drafter's forward is
+    the flagship's forward truncated after layer ``draft_depth - 1`` (plus
+    the shared out-norm / tied-logits readout), so its prefill caches are
+    literally a PREFIX of the flagship's (CA + SA layers 0..draft_depth-1)
+    — the speculative prefill reuses them without a second prompt pass."""
+    import dataclasses as _dc
+
+    mcfg = model.config
+    n_layers = mcfg.num_self_attention_layers
+    if not 1 <= draft_depth < n_layers:
+        raise ValueError(
+            f"draft_depth must be in [1..{n_layers - 1}] "
+            f"(a {n_layers}-layer flagship), got {draft_depth}"
+        )
+    rotary = mcfg.num_self_attention_rotary_layers
+    cfg = _dc.replace(
+        mcfg,
+        num_self_attention_layers=draft_depth,
+        num_self_attention_rotary_layers=(
+            rotary if rotary == -1 else min(rotary, draft_depth)
+        ),
+    )
+    return type(model)(config=cfg, dtype=getattr(model, "dtype", jnp.float32))
+
+
+def drafter_decode_params(params, draft_depth: int):
+    """The drafter's parameter tree: the flagship tree with the latent SA
+    stack truncated to its first ``draft_depth`` layers (embedding,
+    cross-attention, out-norm and the tied readout ride unchanged). Pure
+    restructuring — identical on the raw tree and on the int8-quantized
+    decode tree (ops/quant.py preserves module structure), and free under
+    jit (no bytes move)."""
+    col = params["params"]
+    pa = col["perceiver_ar"]
+    sa = pa["self_attention"]
+    kept = {f"layer_{i}": sa[f"layer_{i}"] for i in range(draft_depth)}
+    return {
+        **params,
+        "params": {**col, "perceiver_ar": {**pa, "self_attention": kept}},
+    }
+
+
+def _speculative_accept(config: GenerationConfig, drafts, q_logits, p_logits, rng, done):
+    """The draft/verify acceptance core shared by the contiguous pair and
+    the engine's paged slot mode — everything is per ROW, so ragged batches
+    (per-slot accepted-prefix lengths) fall out naturally.
+
+    Greedy: accept while the flagship argmax agrees with the draft; the
+    first disagreement (or the bonus position after k accepts) emits the
+    flagship argmax — token-for-token the sequential greedy stream.
+    Sampling: standard speculative rejection sampling over the REAL
+    sampling distributions (temperature/top-k/top-p filters included, via
+    the shared :func:`_filtered_logits`): accept ``d_i`` with probability
+    ``min(1, p_i(d_i) / q_i(d_i))``, resample the first rejection from the
+    residual ``norm(max(p_i - q_i, 0))``, and the bonus position samples
+    ``p_{k+1}`` — the emitted marginals are exactly the sequential path's.
+
+    The rng chain advances ONE split per EMITTED token (the sequential
+    discipline), so after m emitted tokens the returned key equals the
+    sequential path's chain state after m tokens: seeds reproduce, and a
+    speculative→sequential handoff continues the same stream.
+
+    :param drafts: (B, k) drafter proposals.
+    :param q_logits: (B, k, V) drafter logits the proposals were drawn from.
+    :param p_logits: (B, k+1, V) flagship verify logits (one forward).
+    :param rng: (B, 2) per-row chain keys; ``done`` (B,) EOS flags.
+    :return: ``(tokens (B, k+1), m (B,), new_token (B,), rng_new (B, 2),
+        done_new (B,))`` — rows emit ``tokens[:m]``; ``new_token`` is the
+        pending carry (== ``tokens[m-1]``).
+    """
+    b, k = drafts.shape
+    # the chain the sequential path would thread: chain[j] is the rng state
+    # BEFORE emitting token j, step_keys[j] is token j's per-step key
+    chain = [rng]
+    step_keys = []
+    for _ in range(k + 1):
+        nxt, step = jax.vmap(jax.random.split, out_axes=1)(chain[-1])
+        chain.append(nxt)
+        step_keys.append(step)
+    chain_stack = jnp.stack(chain, axis=1)  # (B, k+2, 2)
+
+    if config.do_sample:
+        pf = jax.nn.softmax(_filtered_logits(p_logits, config), axis=-1)  # (B, k+1, V)
+        qf = jax.nn.softmax(_filtered_logits(q_logits, config), axis=-1)  # (B, k, V)
+        p_d = jnp.take_along_axis(pf[:, :k], drafts[..., None], axis=-1)[..., 0]
+        q_d = jnp.take_along_axis(qf, drafts[..., None], axis=-1)[..., 0]
+        u = jnp.stack(
+            [
+                jax.vmap(lambda key: jax.random.uniform(jax.random.fold_in(key, 1)))(
+                    step_keys[j]
+                )
+                for j in range(k)
+            ],
+            axis=1,
+        )  # (B, k)
+        # accept with prob min(1, p/q) — multiplied form, so q == 0 (cannot
+        # happen for a drafter-sampled token, but stays total) never divides
+        accept = u * q_d <= p_d
+        residual = jnp.maximum(pf[:, :k] - qf, 0.0)
+        rsum = residual.sum(axis=-1, keepdims=True)
+        # degenerate residual (p == q exactly): fall back to sampling p
+        resid = jnp.where(rsum > 0, residual / jnp.maximum(rsum, 1e-20), pf[:, :k])
+        fix = []
+        for j in range(k + 1):
+            dist = resid[:, j] if j < k else pf[:, k]
+            logd = jnp.where(dist > 0, jnp.log(jnp.maximum(dist, 1e-38)), -jnp.inf)
+            keys = jax.vmap(lambda key: jax.random.fold_in(key, 2))(step_keys[j])
+            fix.append(
+                jax.vmap(lambda row, key: jax.random.categorical(key, row))(logd, keys)
+            )
+    else:
+        flag = jnp.argmax(p_logits, axis=-1)  # (B, k+1)
+        accept = flag[:, :k] == drafts
+        fix = [flag[:, j] for j in range(k + 1)]
+
+    cum = jnp.cumprod(accept.astype(jnp.int32), axis=1)  # (B, k)
+    n_acc = cum.sum(axis=1)  # (B,) leading accepts
+    m = n_acc + 1  # emitted tokens this span, in [1, k+1]
+
+    pad = jnp.int32(config.pad_token_id)
+    toks = []
+    d_carry = done
+    for j in range(k + 1):
+        drafted = drafts[:, j] if j < k else jnp.zeros_like(fix[j])
+        raw = jnp.where(j < n_acc, drafted, jnp.where(j == n_acc, fix[j], pad))
+        emitted = j < m
+        if config.eos_token_id is not None:
+            # the sequential EOS discipline per emitted token: pad after
+            # done, done latches on the emitted token — positions beyond m
+            # never advance the flag
+            raw = jnp.where(d_carry, pad, raw)
+            d_carry = jnp.where(emitted, d_carry | (raw == config.eos_token_id), d_carry)
+        toks.append(jnp.where(emitted, raw, pad).astype(jnp.int32))
+    tokens = jnp.stack(toks, axis=1)  # (B, k+1)
+
+    new_token = jnp.take_along_axis(tokens, n_acc[:, None], axis=1)[:, 0]
+    rng_new = jnp.take_along_axis(chain_stack, m[:, None, None], axis=1)[:, 0]
+    return tokens, m, new_token, rng_new, d_carry
+
+
+def _validate_no_slide(mcfg, seq_len: int, num_latents: int, config: GenerationConfig):
+    """Speculative decode scores k+1 query positions against the caches in
+    one forward; a window that slides MID-SPAN would need a different
+    expiry mask per query position, which the single slot-aligned pad mask
+    cannot express — so, exactly like :func:`beam_search`, the speculative
+    paths require geometry where the windows never fill during decode and
+    fail loudly otherwise."""
+    n_lat = min(seq_len, num_latents)
+    if (
+        seq_len + config.max_new_tokens > mcfg.max_seq_len
+        or n_lat + config.max_new_tokens > mcfg.max_latents
+    ):
+        raise ValueError(
+            "speculative decode does not slide the window: need "
+            f"seq_len + max_new_tokens <= max_seq_len ({seq_len} + "
+            f"{config.max_new_tokens} vs {mcfg.max_seq_len}) and "
+            f"num_latents + max_new_tokens <= max_latents ({n_lat} + "
+            f"{config.max_new_tokens} vs {mcfg.max_latents})"
+        )
+
+
+def make_speculative_decode_fns(
+    model,
+    num_latents: int = 1,
+    config: Optional[GenerationConfig] = None,
+    *,
+    k: int = 4,
+    draft_depth: int = 1,
+    cache_dtype=jnp.float32,
+    weight_dtype=None,
+):
+    """The speculative host-driven pair: ``(prefill_fn, spec_step_fn)``.
+
+    - ``prefill_fn(params, input_ids, pad_mask=None, rng=None) ->
+      (first_token, state)`` — the :func:`make_decode_fns` prefill contract
+      (batch 1; batched speculative decode is the engine's paged slot mode,
+      :func:`make_speculative_paged_step_fn`) plus the drafter wiring: the
+      drafter's caches are the flagship prefill caches' PREFIX (CA + first
+      ``draft_depth`` SA layers — shared weights make them identical, see
+      :func:`make_drafter`), so there is no second prompt pass. Caches get
+      ``k + 1`` slots of slack for the transient pre-rollback span.
+    - ``spec_step_fn(state) -> (state, tokens (1, k+1), m (1,))`` — ONE
+      draft/verify span: the drafter proposes k tokens autoregressively
+      (k+1 single-token drafter steps in a compiled scan — the last append
+      keeps the drafter cache current through an all-accept span), the
+      flagship scores all k+1 positions in ONE batched forward against its
+      KV cache (the prefill geometry with tiny q — no per-token flagship
+      loop), and :func:`_speculative_accept` emits ``m ∈ [1, k+1]`` tokens.
+      The caller streams ``tokens[:, :m]`` and calls again while budget
+      remains. Rollback of the rejected span suffix is a LENGTH-COUNTER
+      adjustment on every cache (static shapes, no concat/gather — the
+      ``decode_spec`` graphcheck contract pins this).
+
+    Greedy output is token-exact to the sequential pair (pinned by
+    tests/test_speculative.py); temperature sampling is distribution-faithful
+    with the rng chain advanced one split per emitted token, so seeds
+    reproduce and the chain state matches the sequential path at every
+    emitted-token count.
+    """
+    config = config or GenerationConfig()
+    if config.max_new_tokens < 1:
+        raise ValueError("speculative decode fns require max_new_tokens >= 1")
+    if k < 1:
+        raise ValueError(f"k (draft tokens per span) must be >= 1, got {k}")
+    mcfg = model.config
+    drafter = make_drafter(model, draft_depth)
+    compute_dtype = None if weight_dtype is None else getattr(model, "dtype", jnp.float32)
+
+    def prefill(params, input_ids, pad_mask=None, rng=None):
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        b, seq_len = input_ids.shape
+        if b != 1:
+            raise ValueError(
+                "the speculative host-driven pair serves batch 1 (ragged "
+                "accepted-prefix lengths need per-row cache lengths — "
+                "batched speculative decode is the engine's paged slot mode)"
+            )
+        prefix_len = _validate_window(mcfg, seq_len, num_latents)
+        _require_pads_in_prefix(pad_mask, prefix_len)
+        _validate_no_slide(mcfg, seq_len, num_latents, config)
+
+        from perceiver_io_tpu.core.modules import CausalSequenceModel
+
+        # + k + 1 slack: a verify span transiently appends k+1 tokens
+        # before rollback trims the rejected suffix
+        ca_capacity = seq_len + config.max_new_tokens + k + 1
+        sa_capacity = num_latents + config.max_new_tokens + k + 1
+        cache = CausalSequenceModel.init_cache(
+            mcfg, b, ca_capacity=ca_capacity, sa_capacity=sa_capacity, dtype=cache_dtype
+        )
+        if pad_mask is None:
+            pad_mask = jnp.zeros((b, seq_len), bool)
+        pos_shift = pad_mask.sum(axis=1, keepdims=True).astype(jnp.int32)
+        pad_slots = jnp.zeros((b, ca_capacity), bool).at[:, :seq_len].set(pad_mask)
+
+        with jax.named_scope("prefill"), prefill_mode():
+            out = model.apply(
+                params, input_ids, prefix_len=prefix_len, pad_mask=pad_mask, kv_cache=cache
+            )
+        rng, first_rng = jax.random.split(rng)
+        next_token = _sample(out.logits[:, -1], first_rng, config)
+        done = jnp.zeros((b,), bool)
+        if config.eos_token_id is not None:
+            done = next_token == config.eos_token_id
+
+        decode_params, _ = _maybe_quantize_weights(model, params, weight_dtype)
+        state = {
+            "params": decode_params,
+            "cache": out.kv_cache,
+            # the drafter's caches ARE the flagship prefill caches' prefix
+            # (shared trunk weights — see make_drafter); functional updates
+            # keep the two streams independent from here on
+            "draft_cache": (out.kv_cache[0],) + tuple(out.kv_cache[1 : 1 + draft_depth]),
+            "token": next_token,
+            "rng": rng,
+            "done": done,
+            "pad_slots": pad_slots,
+            "pos_shift": pos_shift,
+        }
+        return next_token, state
+
+    def step(state):
+        with jax.named_scope("decode_spec"):
+            cache, dcache = state["cache"], state["draft_cache"]
+            token, rng, done = state["token"], state["rng"], state["done"]
+            pad_slots, pos_shift = state["pad_slots"], state["pos_shift"]
+            step_params = _maybe_dequantize_weights(state["params"], compute_dtype)
+            dparams = drafter_decode_params(state["params"], draft_depth)
+
+            with jax.named_scope("draft"):
+                draft_base = jax.random.fold_in(rng, _DRAFT_SALT)
+
+                def body(carry, i):
+                    dc, cur = carry
+                    dp = _maybe_dequantize_weights(dparams, compute_dtype)
+                    out = drafter.apply(
+                        dp, cur[:, None], prefix_len=0, pad_mask=pad_slots,
+                        kv_cache=dc, decode=True, pos_shift=pos_shift,
+                    )
+                    logits = out.logits[:, -1]
+                    if config.do_sample:
+                        nxt = jax.random.categorical(
+                            jax.random.fold_in(draft_base, i),
+                            _filtered_logits(logits, config),
+                            axis=-1,
+                        )
+                    else:
+                        nxt = jnp.argmax(logits, axis=-1)
+                    return (out.kv_cache, nxt), (nxt, logits)
+
+                # k+1 drafter steps: k proposals + one catch-up append so the
+                # drafter cache holds d_{k-1}'s kv through an all-accept span
+                (dcache_full, _), (draft_seq, q_seq) = lax.scan(
+                    body, (dcache, token), jnp.arange(k + 1)
+                )
+                drafts = draft_seq[:k].T  # (1, k)
+                q_logits = jnp.moveaxis(q_seq[:k], 0, 1)  # (1, k, V)
+
+            with jax.named_scope("verify"):
+                # ONE flagship forward scores all k+1 positions against the
+                # cache — the prefill geometry with tiny q; appends ride the
+                # same dynamic_update_slice discipline (no kv-axis concat)
+                inputs = jnp.concatenate([token[:, None], drafts], axis=1)
+                out = model.apply(
+                    step_params, inputs, prefix_len=0, pad_mask=pad_slots,
+                    kv_cache=cache, decode=True, pos_shift=pos_shift,
+                )
+                cache_full, p_logits = out.kv_cache, out.logits
+
+            with jax.named_scope("accept"):
+                tokens, m, new_token, rng_rows, done = _speculative_accept(
+                    config, drafts, q_logits, p_logits, rng[None], done
+                )
+
+            with jax.named_scope("rollback"):
+                # static-shape rollback: both spans appended k+1 slots; the
+                # accepted prefix is a length-counter adjustment — rejected
+                # slots are dead until the next span overwrites them
+                m0 = m[0]
+
+                def roll(c):
+                    return c.replace(length=c.length - (k + 1) + m0)
+
+                cache_new = tuple(roll(c) for c in cache_full)
+                dcache_new = tuple(roll(c) for c in dcache_full)
+
+            new_state = dict(
+                state, cache=cache_new, draft_cache=dcache_new,
+                token=new_token, rng=rng_rows[0], done=done,
+            )
+            return new_state, tokens, m
+
+    return jax.jit(prefill), jax.jit(step)
+
+
+def make_speculative_paged_step_fn(
+    model,
+    config: Optional[GenerationConfig] = None,
+    *,
+    k: int = 4,
+    draft_depth: int = 1,
+    weight_dtype=None,
+):
+    """The engine's SPECULATIVE batched step: ``fn(params, state) ->
+    (state, tokens (S, k+1), m (S,))`` over the paged state pytree of
+    :func:`make_paged_step_fn` extended with ``draft_cache`` (a paged CA
+    pool + the first ``draft_depth`` SA pools, mirroring the flagship
+    pools' geometry and page ids — ``serving.engine`` owns the mirrored
+    ``commit_prefill``/``release_slot`` bookkeeping).
+
+    One drafter span (k+1 single-token paged steps in a compiled scan) +
+    ONE flagship verify forward over all k+1 positions per engine step;
+    per-slot acceptance, rng chains, done flags and length rollbacks —
+    ragged accepted-prefix lengths are NATIVE to the paged discipline's
+    per-slot length counters (rollback subtracts per slot; no bytes move).
+    Inactive slots draft/verify garbage into their scratch page exactly as
+    the non-speculative step does — the compiled program is total over all
+    slots at every fill level. Requires no-slide geometry (the engine
+    validates at construction). State is donated like the plain step."""
+    config = config or GenerationConfig()
+    if k < 1:
+        raise ValueError(f"k (draft tokens per span) must be >= 1, got {k}")
+    drafter = make_drafter(model, draft_depth)
+    compute_dtype = None if weight_dtype is None else getattr(model, "dtype", jnp.float32)
+
+    def step(params, state):
+        with jax.named_scope("decode_spec"):
+            cache, dcache = state["cache"], state["draft_cache"]
+            token, rng, done = state["token"], state["rng"], state["done"]
+            pos_shift = state["pos_shift"]
+            ca_idx = jnp.arange(cache[0].capacity, dtype=jnp.int32)[None, :]
+            pad_rows = state["pad_slots"] | (ca_idx < state["ca_start"][:, None])
+            step_params = _maybe_dequantize_weights(params, compute_dtype)
+            dparams = drafter_decode_params(params, draft_depth)
+
+            with jax.named_scope("draft"):
+                draft_base = jax.vmap(
+                    lambda key: jax.random.fold_in(key, _DRAFT_SALT)
+                )(rng)
+
+                def body(carry, i):
+                    dc, cur = carry
+                    dp = _maybe_dequantize_weights(dparams, compute_dtype)
+                    out = drafter.apply(
+                        dp, cur[:, None], prefix_len=0, pad_mask=pad_rows,
+                        kv_cache=dc, decode=True, pos_shift=pos_shift,
+                    )
+                    logits = out.logits[:, -1]
+                    if config.do_sample:
+                        keys = jax.vmap(lambda key: jax.random.fold_in(key, i))(draft_base)
+                        fl = _filtered_logits(logits, config)
+                        nxt = jax.vmap(
+                            lambda row, key: jax.random.categorical(key, row)
+                        )(fl, keys)
+                    else:
+                        nxt = jnp.argmax(logits, axis=-1)
+                    return (out.kv_cache, nxt), (nxt, logits)
+
+                (dcache_full, _), (draft_seq, q_seq) = lax.scan(
+                    body, (dcache, token), jnp.arange(k + 1)
+                )
+                drafts = draft_seq[:k].T  # (S, k)
+                q_logits = jnp.moveaxis(q_seq[:k], 0, 1)  # (S, k, V)
+
+            with jax.named_scope("verify"):
+                inputs = jnp.concatenate([token[:, None], drafts], axis=1)
+                out = model.apply(
+                    step_params, inputs, prefix_len=0, pad_mask=pad_rows,
+                    kv_cache=cache, decode=True, pos_shift=pos_shift,
+                )
+                cache_full, p_logits = out.kv_cache, out.logits
+
+            with jax.named_scope("accept"):
+                tokens, m, new_token, rng_new, done = _speculative_accept(
+                    config, drafts, q_logits, p_logits, rng, done
+                )
+
+            with jax.named_scope("rollback"):
+                # per-slot rollback: lengths are (S,) int32 — the ragged
+                # accepted prefixes land as a counter subtraction per slot
+                def roll(c):
+                    return c.replace(length=c.length - (k + 1) + m)
+
+                cache_new = tuple(roll(c) for c in cache_full)
+                dcache_new = tuple(roll(c) for c in dcache_full)
+
+            new_state = dict(
+                state, cache=cache_new, draft_cache=dcache_new,
+                token=new_token, rng=rng_new, done=done,
+            )
+            return new_state, tokens, m
+
+    return jax.jit(step, donate_argnums=1)
 
 
 @dataclass

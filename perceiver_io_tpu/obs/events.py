@@ -360,6 +360,20 @@ _REQUIRED_FIELDS: Dict[str, tuple] = {
     "serve.drain": ("books",),
 }
 
+# OPTIONAL fields validated WHEN PRESENT (type-checked, never required —
+# forward compatibility: older streams without them stay valid, newer
+# streams with them validate their types instead of sailing through):
+# the engine's request-row telemetry — batch_size_at_decode (Pageline) and
+# the speculative-decode quality pair (Specline: per-request drafter
+# acceptance rate and decode tokens emitted per batched verify step)
+_OPTIONAL_FIELD_TYPES: Dict[str, Dict[str, tuple]] = {
+    "request": {
+        "batch_size_at_decode": (int, float),
+        "acceptance_rate": (int, float),
+        "tokens_per_step": (int, float),
+    },
+}
+
 # the closed terminal-outcome vocabulary of `request` rows (the serving
 # front end's clean-books invariant rides on it): "shed" is stamped at
 # admission by perceiver_io_tpu.serving, "timeout"/"cancelled" by the
@@ -446,6 +460,17 @@ def validate_events(
             for field in _REQUIRED_FIELDS.get(kind, ()):
                 if field not in row:
                     problems.append(f"{name}:{i + 1} [{kind}]: missing field {field!r}")
+            for field, types in _OPTIONAL_FIELD_TYPES.get(kind, {}).items():
+                # bool is an int subclass — "numeric" here means a real
+                # measurement, so True/False fail like any other non-number
+                if field in row and (
+                    isinstance(row[field], bool)
+                    or not isinstance(row[field], types)
+                ):
+                    problems.append(
+                        f"{name}:{i + 1} [{kind}]: optional field {field!r} "
+                        f"must be numeric when present, got {row[field]!r}"
+                    )
             if kind == "request" and "outcome" in row:
                 # outcome is validated against the CLOSED vocabulary: a
                 # missing outcome is a hard failure (required field above),

@@ -156,6 +156,20 @@ class Histogram:
         with self._lock:
             return dict(self.counts), self.n, self.sum, self.min, self.max
 
+    def reset(self) -> None:
+        """Drop every recorded sample — the warmup seam: a drive that warms
+        compile caches through the SAME instance it then measures resets
+        the latency histograms at the measured-window boundary, so committed
+        percentiles cover only measured traffic. Exposition scrapes handle
+        the count going backwards the way Prometheus clients handle any
+        counter reset; call it between windows, not mid-scrape-storm."""
+        with self._lock:
+            self.counts = {}
+            self.n = 0
+            self.sum = 0.0
+            self.min = None
+            self.max = None
+
     def percentile(self, p: float) -> Optional[float]:
         """Bucket-midpoint percentile, clamped into the observed [min, max]
         (a one-sample histogram reports the sample, not its bucket's

@@ -59,6 +59,16 @@ class EngineConfig:
     # for `slots` maxed-out requests (+ the scratch page). Below 1.0 the
     # allocator exerts real backpressure — the chaos scenarios run there.
     pool_headroom: float = 1.0
+    # Specline speculative slot mode: spec_k > 0 drafts that many tokens per
+    # engine step with a truncated-depth self-drafter (spec_depth latent SA
+    # layers sharing the flagship's weights) and verifies them in ONE
+    # batched flagship forward — a step emits m ∈ [1, spec_k+1] tokens per
+    # slot. Requires max_ca_tokens <= model max_seq_len and max_sa_tokens
+    # <= model max_latents (speculative decode never slides the window —
+    # validated loudly at construction); per-slot pools grow by spec_k+1
+    # slots of slack for the transient pre-rollback span.
+    spec_k: int = 0
+    spec_depth: int = 1
 
 
 class EngineFrontEnd(RequestFrontEnd):
@@ -81,8 +91,21 @@ class EngineFrontEnd(RequestFrontEnd):
         self.engine_config = ec = engine_config or EngineConfig()
         mcfg = model.config
         ps = ec.page_size
-        self._ca_pages_per_slot = -(-ec.max_ca_tokens // ps)
-        self._sa_pages_per_slot = -(-ec.max_sa_tokens // ps)
+        self._spec = ec.spec_k > 0
+        # verify spans transiently append spec_k+1 tokens before rollback;
+        # per-slot page spans (and grants) carry that slack
+        self._spec_slack = ec.spec_k + 1 if self._spec else 0
+        if self._spec and (
+            ec.max_ca_tokens > mcfg.max_seq_len or ec.max_sa_tokens > mcfg.max_latents
+        ):
+            raise ValueError(
+                "speculative slot mode never slides the window: need "
+                f"max_ca_tokens <= max_seq_len ({ec.max_ca_tokens} vs "
+                f"{mcfg.max_seq_len}) and max_sa_tokens <= max_latents "
+                f"({ec.max_sa_tokens} vs {mcfg.max_latents})"
+            )
+        self._ca_pages_per_slot = -(-(ec.max_ca_tokens + self._spec_slack) // ps)
+        self._sa_pages_per_slot = -(-(ec.max_sa_tokens + self._spec_slack) // ps)
         ca_pool = 1 + max(2, int(round(ec.slots * self._ca_pages_per_slot * ec.pool_headroom)))
         sa_pool = 1 + max(2, int(round(ec.slots * self._sa_pages_per_slot * ec.pool_headroom)))
         self.ca_alloc = PageAllocator(ca_pool, ps)
@@ -117,10 +140,34 @@ class EngineFrontEnd(RequestFrontEnd):
             "pos_shift": jnp.zeros((s, 1), jnp.int32),
         }
         self._tracker = RecompileTracker(events=self.events)
-        self._step_fn = self._tracker.wrap(
-            make_paged_step_fn(model, self._gen_config, self.weight_dtype),
-            "engine_decode_step",
-        )
+        if self._spec:
+            from perceiver_io_tpu.generation import (
+                make_drafter,
+                make_speculative_paged_step_fn,
+            )
+
+            # drafter pools mirror the flagship pools' geometry AND page
+            # ids: a slot's grant indexes both pool families, so the page
+            # allocator's books cover the drafter for free
+            self._drafter = make_drafter(model, ec.spec_depth)
+            self._state["draft_cache"] = CausalSequenceModel.init_paged_cache(
+                self._drafter.config, s, ps,
+                ca_num_pages=ca_pool, ca_pages_per_slot=self._ca_pages_per_slot,
+                sa_num_pages=sa_pool, sa_pages_per_slot=self._sa_pages_per_slot,
+                dtype=cache_dtype,
+            )
+            self._step_fn = self._tracker.wrap(
+                make_speculative_paged_step_fn(
+                    model, self._gen_config, k=ec.spec_k,
+                    draft_depth=ec.spec_depth, weight_dtype=self.weight_dtype,
+                ),
+                "engine_decode_spec_step",
+            )
+        else:
+            self._step_fn = self._tracker.wrap(
+                make_paged_step_fn(model, self._gen_config, self.weight_dtype),
+                "engine_decode_step",
+            )
         self._prefill_fns: Dict[int, object] = {}
         self._join_fn = self._tracker.wrap(
             jax.jit(_join_state, donate_argnums=0), "engine_join"
@@ -144,6 +191,11 @@ class EngineFrontEnd(RequestFrontEnd):
         self._m_fill = r.gauge("engine_batch_fill_frac")
         self._m_pages = r.gauge("engine_kv_pages_used")
         self._m_pages_frac = r.gauge("engine_kv_pages_frac")
+        if self._spec:
+            # per-request drafter quality, recorded at retire: the A/B
+            # inputs the graduation ledger and docs/performance.md cite
+            self._m_accept = r.histogram("spec_acceptance_rate")
+            self._m_tps = r.histogram("spec_tokens_per_step")
         self._admission_checks.append(self._page_fit_check)
 
     # -- admission -----------------------------------------------------------
@@ -161,8 +213,8 @@ class EngineFrontEnd(RequestFrontEnd):
         fits = (
             ca_tokens <= ec.max_ca_tokens
             and sa_tokens <= ec.max_sa_tokens
-            and self.ca_alloc.can_ever_fit(ca_tokens)
-            and self.sa_alloc.can_ever_fit(sa_tokens)
+            and self.ca_alloc.can_ever_fit(ca_tokens + self._spec_slack)
+            and self.sa_alloc.can_ever_fit(sa_tokens + self._spec_slack)
         )
         if fits:
             return None
@@ -200,8 +252,10 @@ class EngineFrontEnd(RequestFrontEnd):
 
         jnp = self._jnp
         rec = ticket.record
-        ca_tokens = rec.prompt_len + rec.max_new_tokens
-        sa_tokens = self.num_latents + rec.max_new_tokens
+        # spec slack rides the grant: the verify span transiently appends
+        # spec_k+1 tokens past the request's budget before rollback
+        ca_tokens = rec.prompt_len + rec.max_new_tokens + self._spec_slack
+        sa_tokens = self.num_latents + rec.max_new_tokens + self._spec_slack
         ca_grant = self.ca_alloc.alloc_tokens(ca_tokens)
         if ca_grant is None:
             return False
@@ -302,6 +356,17 @@ class EngineFrontEnd(RequestFrontEnd):
         rec.decode_s = round(sum(slot.step_times), 6)
         rec.service_s = round(time.perf_counter() - slot.t_joined, 6)
         self._finish(slot.ticket, outcome)
+        # speculative quality accounting (the measurement half of the
+        # graduation story): raw drafter acceptance over the slot's verify
+        # spans, and decode tokens emitted per batched step
+        accept_rate = tokens_per_step = None
+        if slot.spec_spans:
+            accept_rate = slot.spec_accepted / (
+                slot.spec_spans * max(self.engine_config.spec_k, 1)
+            )
+            tokens_per_step = max(slot.tokens_out - 1, 0) / slot.spec_spans
+            self._m_accept.record(accept_rate)
+            self._m_tps.record(tokens_per_step)
         if slot.span is not None:
             slot.span.set("outcome", outcome)
             slot.span.set("tokens_out", slot.tokens_out)
@@ -325,6 +390,9 @@ class EngineFrontEnd(RequestFrontEnd):
                 row["batch_size_at_decode"] = round(
                     sum(slot.batch_sizes) / len(slot.batch_sizes), 3
                 )
+            if accept_rate is not None:
+                row["acceptance_rate"] = round(accept_rate, 6)
+                row["tokens_per_step"] = round(tokens_per_step, 6)
             if slot.span is not None:
                 row["span_id"] = slot.span.span_id
             for p in (50, 90, 99):
@@ -401,45 +469,77 @@ class EngineFrontEnd(RequestFrontEnd):
         0 in the join seam, a cancel/deadline landing between steps) before
         the next batched step decodes — and books — an extra token for a
         dead request; the sequential path retires at exactly the same
-        boundary."""
+        boundary. A slot whose budget the PREFILL token already filled
+        (max_new_tokens == 1) retires ``ok`` here for the same reason: it
+        must not ride a batched step that can emit nothing — in spec mode
+        that phantom span would record tokens_per_step == 0 and unemitted
+        'accepted' drafts into the acceptance telemetry."""
         for slot_id, slot in enumerate(self._slots):
-            if slot is not None and slot.outcome is not None:
+            if slot is None:
+                continue
+            if slot.outcome is not None:
                 self._retire_slot(slot_id, slot.outcome)
+            elif slot.tokens_out >= slot.ticket.record.max_new_tokens:
+                self._retire_slot(slot_id, "ok")
 
     def _engine_step(self) -> None:
-        """One batched decode step + per-slot accounting/retires."""
+        """One batched decode step + per-slot accounting/retires. In the
+        speculative slot mode a step emits ``m ∈ [1, spec_k+1]`` tokens per
+        slot — EVERY emitted token streams through the same per-token seam
+        (injector / cancel / deadline), so mid-SPAN cancellation retires the
+        slot at the same token boundary the sequential path would; the
+        span's remaining tokens are dropped, never served."""
         self._sweep_terminal()
         active = self._active_ids()
         if not active:
             return
         compiles0 = self._tracker.total_compiles
         t0 = time.perf_counter()
-        self._state, tokens = self._step_fn(self._decode_params, self._state)
-        tokens = np.asarray(tokens)  # ONE host fetch for the whole batch
+        if self._spec:
+            self._state, tokens, m = self._step_fn(self._decode_params, self._state)
+            tokens, m = np.asarray(tokens), np.asarray(m)
+        else:
+            self._state, tokens = self._step_fn(self._decode_params, self._state)
+            tokens = np.asarray(tokens)[:, None]  # ONE host fetch either way
+            m = np.ones(len(self._slots), np.int64)
         dt = time.perf_counter() - t0
         self._engine_steps += 1
         self._fill_sum += len(active)
         cold_step = self._tracker.total_compiles > compiles0
         batch_size = len(active)
+        eos = self._gen_config.eos_token_id
         for slot_id in active:
             slot = self._slots[slot_id]
-            slot.tokens_out += 1
-            self.served_tokens[slot.ticket.record.index].append(int(tokens[slot_id]))
-            slot.hist.record(dt)
-            slot.step_times.append(dt)
-            slot.batch_sizes.append(batch_size)
-            if cold_step:
-                slot.compiled = True
-            else:
-                self._m_tpot.record(dt)
-            self._token_seam(slot, slot.tokens_out - 1)
             rec = slot.ticket.record
-            eos = self._gen_config.eos_token_id
-            finished = (
-                slot.tokens_out >= rec.max_new_tokens
-                or (eos is not None and int(tokens[slot_id]) == eos)
-            )
-            if slot.outcome is not None:  # killed / cancelled / deadline
+            span = int(m[slot_id])
+            # a span may overshoot the request's remaining budget — clip;
+            # acceptance counters record the RAW span (drafter quality)
+            n_emit = min(span, rec.max_new_tokens - slot.tokens_out)
+            if self._spec:
+                slot.spec_spans += 1
+                slot.spec_accepted += span - 1
+            per_tok = dt / max(n_emit, 1)
+            finished = False
+            for j in range(n_emit):
+                tok = int(tokens[slot_id, j])
+                slot.tokens_out += 1
+                self.served_tokens[rec.index].append(tok)
+                slot.hist.record(per_tok)
+                slot.step_times.append(per_tok)
+                slot.batch_sizes.append(batch_size)
+                if cold_step:
+                    slot.compiled = True
+                else:
+                    self._m_tpot.record(per_tok)
+                self._token_seam(slot, slot.tokens_out - 1)
+                if slot.outcome is not None:  # killed / cancelled / deadline
+                    break
+                if eos is not None and tok == eos:
+                    finished = True
+                    break
+            if slot.tokens_out >= rec.max_new_tokens:
+                finished = True
+            if slot.outcome is not None:
                 self._retire_slot(slot_id, slot.outcome)
             elif finished:
                 self._retire_slot(slot_id, "ok")
@@ -508,16 +608,43 @@ class EngineFrontEnd(RequestFrontEnd):
             self.drain()
         return out
 
-    def run_open(self, specs, **kw):
-        """Not yet implemented for the engine: the parent's open-loop drive
-        interleaves arrivals with SEQUENTIAL service — inheriting it would
-        silently bypass the batched path. Open-loop engine drive (rate
-        floors at engine scale) is the ROADMAP follow-up; loud beats
-        wrong-path-silent."""
-        raise NotImplementedError(
-            "EngineFrontEnd serves closed-loop (run_closed / submit+pump); "
-            "open-loop engine drive is not implemented yet"
-        )
+    def run_open(self, specs, *, rate_rps: Optional[float] = None,
+                 offsets: Optional[List[float]] = None,
+                 deadline_s: Optional[float] = None, seed: int = 1):
+        """Open-loop drive through the ENGINE (the item-1 certification
+        remainder: rate floors at engine scale): arrivals at seeded Poisson
+        offsets (or explicit ``offsets``); between arrivals the live batch
+        keeps stepping, and every arrival whose time has passed joins at
+        the next fill/step boundary — so the measured achieved-rps is the
+        engine absorbing an externally-imposed rate, not self-throttling.
+        Under a ``ManualClock`` the idle gaps advance the injected
+        timeline; under a real clock the batched steps themselves move it."""
+        from collections import deque as _deque
+
+        specs = list(specs)
+        offsets = self._resolve_offsets(specs, rate_rps, offsets, seed)
+        t0 = float(self._clock())
+        pending = _deque(zip(specs, offsets))
+        out = []
+        while pending or self._queue or self._active_ids():
+            self._check_guard()
+            # admit every arrival whose time has passed on the clock
+            while pending and t0 + pending[0][1] <= float(self._clock()):
+                spec, off = pending.popleft()
+                out.append(self.submit(spec, arrival_s=t0 + off, deadline_s=deadline_s))
+            if not (self._queue or self._active_ids()):
+                if pending:  # idle: jump to the next arrival
+                    spec, off = pending.popleft()
+                    self._advance_to(t0 + off)
+                    out.append(
+                        self.submit(spec, arrival_s=t0 + off, deadline_s=deadline_s)
+                    )
+                continue
+            self._fill_slots()
+            self._engine_step()
+        if self._draining:
+            self.drain()
+        return out
 
     # the engine keeps no per-request worker estimate: queue-wait projection
     # rides the parent's EWMA, updated here per retire via _busy_until
@@ -536,6 +663,11 @@ class _EngineSlot:
     compiled: bool = False
     first_token: Optional[int] = None
     outcome: Optional[str] = None  # set mid-decode by the token seam
+    # speculative slot mode: verify spans this slot rode and raw accepted
+    # draft tokens across them (pre-budget-clip — drafter quality, not
+    # serving accounting)
+    spec_spans: int = 0
+    spec_accepted: int = 0
     span = None
 
     def __post_init__(self):
@@ -571,6 +703,21 @@ def _join_state(state, slot, ca_pages, sa_pages, prefill_cache, slot_row):
         commit_prefill(c, slot, sa_pages, pc, pc.length)
         for c, pc in zip(caches[1:], prefill_cache[1:])
     )
+    extra = {}
+    if "draft_cache" in state:
+        # speculative slot mode: the drafter's caches are the flagship
+        # prefill caches' PREFIX (shared trunk weights — generation.
+        # make_drafter), committed into the mirrored drafter pools under
+        # the SAME page ids the slot's grant names
+        dcaches = state["draft_cache"]
+        new_dca = commit_prefill(
+            dcaches[0], slot, ca_pages, prefill_cache[0], prefill_cache[0].length
+        )
+        new_dsas = tuple(
+            commit_prefill(c, slot, sa_pages, pc, pc.length)
+            for c, pc in zip(dcaches[1:], prefill_cache[1:])
+        )
+        extra["draft_cache"] = (new_dca,) + new_dsas
     cap = caches[0].capacity
     pad_row = jnp.zeros((cap,), bool)
     n_pre = pad_row_pre.shape[0]
@@ -578,6 +725,7 @@ def _join_state(state, slot, ca_pages, sa_pages, prefill_cache, slot_row):
     return dict(
         state,
         cache=(new_ca,) + new_sas,
+        **extra,
         ca_start=state["ca_start"].at[slot].set(0),
         sa_start=state["sa_start"].at[slot].set(0),
         token=state["token"].at[slot].set(first_token),
@@ -599,9 +747,15 @@ def _retire_state(state, slot):
     from perceiver_io_tpu.core.cache import release_slot
 
     caches = tuple(release_slot(c, slot) for c in state["cache"])
+    extra = {}
+    if "draft_cache" in state:
+        extra["draft_cache"] = tuple(
+            release_slot(c, slot) for c in state["draft_cache"]
+        )
     return dict(
         state,
         cache=caches,
+        **extra,
         token=state["token"].at[slot].set(0),
         done=state["done"].at[slot].set(True),
         ca_start=state["ca_start"].at[slot].set(0),

@@ -641,6 +641,24 @@ class RequestFrontEnd:
             self.drain()
         return out
 
+    def _resolve_offsets(self, specs, rate_rps, offsets, seed):
+        """Arrival offsets for an open-loop drive: the seeded Poisson
+        schedule, or explicit ``offsets`` validated loudly — both drive
+        loops only ever inspect the HEAD of the pending deque, so an
+        out-of-order arrival would be admitted late with its queue-wait
+        charged against the wrong interval."""
+        from perceiver_io_tpu.obs.loadgen import arrival_schedule
+
+        if offsets is None:
+            if rate_rps is None or rate_rps <= 0:
+                raise ValueError("run_open needs rate_rps > 0 (or explicit offsets)")
+            return arrival_schedule(len(specs), rate_rps, seed=seed)
+        if len(offsets) != len(specs):
+            raise ValueError(f"{len(offsets)} offsets for {len(specs)} requests")
+        if any(b < a for a, b in zip(offsets, offsets[1:])):
+            raise ValueError("run_open offsets must be non-decreasing (arrival order)")
+        return offsets
+
     def run_open(self, specs, *, rate_rps: Optional[float] = None,
                  offsets: Optional[List[float]] = None,
                  deadline_s: Optional[float] = None,
@@ -650,15 +668,8 @@ class RequestFrontEnd:
         request is served before the next arrival iff the worker would
         start it first. Under a ``ManualClock`` the whole overload run is
         wall-clock-free; under a real clock it paces with ``sleep``."""
-        from perceiver_io_tpu.obs.loadgen import arrival_schedule
-
         specs = list(specs)
-        if offsets is None:
-            if rate_rps is None or rate_rps <= 0:
-                raise ValueError("run_open needs rate_rps > 0 (or explicit offsets)")
-            offsets = arrival_schedule(len(specs), rate_rps, seed=seed)
-        if len(offsets) != len(specs):
-            raise ValueError(f"{len(offsets)} offsets for {len(specs)} requests")
+        offsets = self._resolve_offsets(specs, rate_rps, offsets, seed)
         t0 = float(self._clock())
         pending = deque(zip(specs, offsets))
         out: List[FrontEndRecord] = []

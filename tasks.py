@@ -194,7 +194,10 @@ def perf(args):
     obs_diff classifies MFU/goodput/step-p99/SLO drift against it under
     declared tolerances (stale = not comparable ≠ regression) — and
     then the serving-load smoke gate (``tools/loadgen.py --smoke``:
-    closed-loop load telemetry + flight recorder + LOAD floors), and
+    closed-loop load telemetry + flight recorder + LOAD floors), then the
+    spec-decode smoke (``tools/spec_smoke.py``: speculative draft/verify
+    token-exactness + rng-chain alignment + acceptance sanity on the tiny
+    gate model), and
     finally the serve-chaos smoke (``tools/chaos.py --scenarios
     serve_kill_mid_decode``: a mid-decode kill through the hardened front
     end with the clean-books audit). Extra args go to tools/graphcheck.py
@@ -219,6 +222,10 @@ def perf(args):
     # audits, a planted mid-decode kill inside a live batch, engine gauges
     # on /metrics, and the engine throughput/p99-TPOT ledger floors
     run(sys.executable, "tools/loadgen.py", "--smoke", "--engine")
+    # spec-decode smoke leg (Specline): greedy token-exactness + rng-chain
+    # alignment + acceptance-rate sanity of the speculative draft/verify
+    # pair on the tiny gate model (tools/spec_smoke.py)
+    run(sys.executable, "tools/spec_smoke.py")
     # serve-chaos smoke leg: kill a request mid-decode through the hardened
     # front end and audit the books (the full serve_* family runs under
     # `tasks.py chaos`; this pins the books invariant in perf CI)

@@ -385,3 +385,63 @@ def test_smoke_fit_event_stream_validates(tmp_path):
     assert all(e["schema_version"] == EVENT_SCHEMA_VERSION for e in events)
     kinds = {e["event"] for e in events}
     assert {"fit_start", "log", "compile", "span", "fit_end"} <= kinds
+
+
+def test_speculative_event_fields_and_artifacts_pinned(tmp_path):
+    """The Specline vocabulary (ISSUE 14): ``acceptance_rate`` and
+    ``tokens_per_step`` are OPTIONAL request-row fields VALIDATED when
+    present (numeric — a malformed value is a problem, absence is not:
+    mirroring ``batch_size_at_decode``), the ``speculative`` feature stands
+    measured in the ledger with its tokens-per-step floor, and the
+    committed BENCH_extra round's ``decode_spec`` entry records a
+    serial-step multiple above 1.0 (the acceptance criterion)."""
+    from perceiver_io_tpu.analysis.ledger import feature_state, load_ledger
+    from perceiver_io_tpu.obs.events import (
+        _OPTIONAL_FIELD_TYPES,
+        _REQUIRED_FIELDS,
+        EVENT_SCHEMA_VERSION,
+        validate_events,
+    )
+
+    for field in ("acceptance_rate", "tokens_per_step", "batch_size_at_decode"):
+        assert field in _OPTIONAL_FIELD_TYPES["request"], field
+        assert field not in _REQUIRED_FIELDS["request"], field
+
+    def write_stream(rows):
+        path = tmp_path / "events.jsonl"
+        with open(path, "w") as f:
+            for row in rows:
+                f.write(json.dumps({"ts": 1.0, "schema_version": EVENT_SCHEMA_VERSION, **row}) + "\n")
+        return str(path)
+
+    req = {"event": "request", "request_id": "r", "batch": 1, "prompt_len": 8,
+           "ttft_s": 0.0, "tokens_out": 6, "outcome": "ok"}
+    good = write_stream(
+        [
+            {**req, "acceptance_rate": 0.45, "tokens_per_step": 2.2},
+            req,  # rows WITHOUT the fields stay valid (older streams)
+        ]
+    )
+    warnings_out = []
+    assert validate_events(good, strict_spans=False, warnings_out=warnings_out) == []
+    assert warnings_out == []
+    bad = write_stream([{**req, "acceptance_rate": "high", "tokens_per_step": None}])
+    problems = validate_events(bad, strict_spans=False)
+    assert any("acceptance_rate" in p for p in problems), problems
+    assert any("tokens_per_step" in p for p in problems), problems
+    # bool is an int subclass — it must NOT pass the numeric check
+    booly = write_stream([{**req, "acceptance_rate": True, "tokens_per_step": False}])
+    problems = validate_events(booly, strict_spans=False)
+    assert any("acceptance_rate" in p for p in problems), problems
+    assert any("tokens_per_step" in p for p in problems), problems
+
+    ledger = load_ledger(CONTRACTS)
+    assert feature_state(ledger, "speculative") == "measured"
+    assert "spec_tokens_per_step" in ledger["floors"]
+
+    rounds = _rounds("BENCH_extra_r*.json")
+    latest = json.load(open(rounds[max(rounds)]))
+    spec = latest["decode_spec"]
+    assert spec["tokens_per_step"] > 1.0, spec
+    assert 0.0 <= spec["acceptance_rate"] <= 1.0, spec
+    assert spec.get("token_exact") is True, spec

@@ -327,6 +327,47 @@ def test_bench_floor_failure_detected(tmp_path):
     assert any("no artifact matches" in f for f in failures)
 
 
+def test_floor_match_clause_selects_latest_matching_round(tmp_path):
+    """Mode-aware floors: one artifact family holds rounds of several modes
+    (LOAD_r01 sequential-closed, r02 engine-closed, r03 engine-open) —
+    a floor's ``match`` clause must pin it to the latest round of ITS mode,
+    not whatever mode committed last. ``"*"`` means present-and-non-null."""
+    import json as _json
+
+    for n, doc in (
+        (1, {"mode": "closed", "summary": {"v": 10.0}}),
+        (2, {"mode": "closed", "summary": {"v": 9.0, "engine": {"slots": 8}}}),
+        (3, {"mode": "open", "summary": {"v": 3.0, "engine": {"slots": 8}}}),
+    ):
+        (tmp_path / f"LOAD_r{n:02d}.json").write_text(_json.dumps(doc))
+    ledger = {
+        "schema_version": 1,
+        "features": {},
+        "floors": {
+            "closed_engine": {"artifact": "LOAD_r*.json", "key": "summary.v", "min": 5.0,
+                              "match": {"mode": "closed", "summary.engine": "*"}},
+            "open_rate": {"artifact": "LOAD_r*.json", "key": "summary.v", "min": 5.0,
+                          "match": {"mode": "open"}},
+            "any_latest": {"artifact": "LOAD_r*.json", "key": "summary.v", "min": 5.0},
+            "no_such_mode": {"artifact": "LOAD_r*.json", "key": "summary.v", "min": 0.0,
+                             "match": {"mode": "chaotic"}},
+        },
+    }
+    failures = L.check_bench_floors(ledger, str(tmp_path))
+    # closed_engine reads r02 (9.0 >= 5.0) even though r03 committed later
+    assert not any(f.startswith("closed_engine") for f in failures), failures
+    # open_rate reads r03 (3.0 < 5.0) and names the round it read
+    assert any(f.startswith("open_rate") and "LOAD_r03" in f for f in failures), failures
+    # an unmatched floor keeps plain latest-round-wins (r03: 3.0 < 5.0)
+    assert any(f.startswith("any_latest") and "LOAD_r03" in f for f in failures), failures
+    # a clause nothing satisfies is a loud gap, not a silent pass
+    assert any(f.startswith("no_such_mode") and "no artifact" in f for f in failures), failures
+    # the committed ledger's LOAD floors carry the clauses this test pins
+    committed = L.load_ledger(CONTRACTS)
+    assert committed["floors"]["engine_open_achieved_rps"]["match"]["mode"] == "open"
+    assert committed["floors"]["engine_throughput_tok_s"]["match"]["mode"] == "closed"
+
+
 # --------------------------------------------------------- bench.py telemetry
 
 

@@ -126,3 +126,45 @@ def test_generate_and_beam_run_with_int8_cache(csm):
         cache_dtype=jnp.int8,
     )
     assert ((np.asarray(seqs) >= 0) & (np.asarray(seqs) < config.vocab_size)).all()
+
+
+def test_int8_graduation_ledger_and_numerics_gate(csm):
+    """The ISSUE 14 graduation satellite: ``int8_cache``/``int8_weights``
+    stand MEASURED in the committed ledger (citing the BENCH_extra_r5
+    floors), and the PR-9 decode-health probes are the numerics safety
+    gate — a decode over BOTH int8 stores with probes compiled in must
+    report a zero non-finite-logit fraction and finite entropy on every
+    token (quantization buys bandwidth, never silent numeric damage)."""
+    import os
+
+    from perceiver_io_tpu.analysis.ledger import feature_state, load_ledger
+    from perceiver_io_tpu.generation import GenerationConfig, make_decode_fns
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ledger = load_ledger(os.path.join(repo, "contracts"))
+    assert feature_state(ledger, "int8_cache") == "measured"
+    assert feature_state(ledger, "int8_weights") == "measured"
+    # the graduations cite floors that must actually exist in the ledger
+    floors = ledger.get("floors", {})
+    assert "decode_b8_int8_vs_baseline" in floors
+    assert "int8_full_vs_baseline" in floors
+
+    model, params, config = csm
+    prompt = jnp.asarray(
+        np.random.default_rng(5).integers(
+            0, config.vocab_size, size=(BATCH_SIZE, NUM_PREFIX + 2)
+        )
+    )
+    prefill, step = make_decode_fns(
+        model, NUM_LATENTS, GenerationConfig(max_new_tokens=6),
+        cache_dtype=jnp.int8, weight_dtype=jnp.int8, probes=True,
+    )
+    _, state = prefill(params, prompt, None, jax.random.PRNGKey(1))
+    healths = [state["probe"]]
+    for _ in range(5):
+        state, _ = step(state)
+        healths.append(state["probe"])
+    for h in healths:
+        assert float(h["nonfinite_logit_frac"]) == 0.0, h
+        assert np.isfinite(float(h["logit_entropy"])), h
+        assert 0.0 <= float(h["kv_cache_frac"]) <= 1.0, h

@@ -1,0 +1,473 @@
+"""Specline tests (ISSUE 14): speculative self-drafting decode is
+TOKEN-EXACT vs the sequential ``make_decode_fns`` path for greedy (bit-exact
+streams, rng chain aligned at every span boundary) and distribution-faithful
++ deterministic for temperature sampling, across k ∈ {1, 2, 4} and drafter
+depths; the drafter's prefill caches are the flagship caches' PREFIX (shared
+trunk weights); the ``decode_spec`` graph contains no kv-axis concatenate
+and exactly ONE flagship span-append per cache per step; the engine's
+speculative slot mode serves ragged batches token-exactly with clean books,
+mid-span kill semantics, and acceptance telemetry on every request event."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from perceiver_io_tpu.generation import (
+    GenerationConfig,
+    make_decode_fns,
+    make_drafter,
+    make_speculative_decode_fns,
+)
+from perceiver_io_tpu.models.text import CausalLanguageModel, CausalLanguageModelConfig
+
+VOCAB = 64
+NUM_LATENTS = 4
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    config = CausalLanguageModelConfig(
+        vocab_size=VOCAB, max_seq_len=32, max_latents=16, num_channels=32,
+        num_heads=4, num_self_attention_layers=3,
+        num_self_attention_rotary_layers=-1, cross_attention_dropout=0.5,
+        output_norm=True,
+    )
+    model = CausalLanguageModel(config)
+    ids = np.random.default_rng(3).integers(0, VOCAB, size=(1, 12))
+    params = model.init(jax.random.PRNGKey(2), jnp.asarray(ids), prefix_len=8)
+    return model, params
+
+
+def prompt(seq_len=12, seed=3):
+    return jnp.asarray(np.random.default_rng(seed).integers(0, VOCAB, size=(1, seq_len)))
+
+
+def _sequential(model, params, ids, cfg, seed=7, extra=0):
+    """Reference stream + the rng chain state after each token (the chain
+    runs ``extra`` tokens past the budget so span-boundary states that
+    overshoot the budget stay comparable)."""
+    run_cfg = dataclasses.replace(cfg, max_new_tokens=cfg.max_new_tokens + extra)
+    prefill, step = make_decode_fns(model, NUM_LATENTS, run_cfg)
+    tok, state = prefill(params, ids, None, jax.random.PRNGKey(seed))
+    out, rngs = [int(tok[0])], [np.asarray(state["rng"])]
+    for _ in range(run_cfg.max_new_tokens - 1):
+        state, tok = step(state)
+        out.append(int(tok[0]))
+        rngs.append(np.asarray(state["rng"]))
+    return out, rngs
+
+
+def _speculative(model, params, ids, cfg, k, depth, seed=7, **kw):
+    """Drive the speculative pair to the budget; returns (stream, list of
+    (emitted_count, rng_state) at every span boundary, spans, accepted)."""
+    prefill, step = make_speculative_decode_fns(
+        model, NUM_LATENTS, cfg, k=k, draft_depth=depth, **kw
+    )
+    tok, state = prefill(params, ids, None, jax.random.PRNGKey(seed))
+    out = [int(tok[0])]
+    boundaries, spans, accepted = [], 0, 0
+    while len(out) < cfg.max_new_tokens:
+        state, toks, m = step(state)
+        m0 = int(m[0])
+        spans += 1
+        accepted += m0 - 1
+        out.extend(int(t) for t in np.asarray(toks[0, :m0]))
+        boundaries.append((len(out), np.asarray(state["rng"])))
+    return out, boundaries, spans, accepted
+
+
+# ------------------------------------------------------------ token exactness
+
+
+@pytest.mark.parametrize("k,depth", [(1, 1), (2, 1), (4, 1), (2, 2)])
+def test_speculative_greedy_bit_exact_and_chain_aligned(model_and_params, k, depth):
+    """The ISSUE 14 acceptance pin: greedy speculative decode emits EXACTLY
+    the sequential stream, and the rng chain state at every span boundary
+    equals the sequential chain after the same number of emitted tokens
+    (one split per emitted token — seeds reproduce, and a speculative →
+    sequential handoff would continue the same stream)."""
+    model, params = model_and_params
+    ids = prompt()
+    cfg = GenerationConfig(max_new_tokens=10)
+    seq, rngs = _sequential(model, params, ids, cfg, extra=k)
+    out, boundaries, spans, accepted = _speculative(model, params, ids, cfg, k, depth)
+    assert out[: cfg.max_new_tokens] == seq[: cfg.max_new_tokens], (out, seq)
+    for emitted, rng_state in boundaries:
+        np.testing.assert_array_equal(rng_state, rngs[emitted - 1])
+    assert spans >= 1 and 0 <= accepted <= spans * k
+
+
+def test_speculative_temperature_deterministic_and_chain_aligned(model_and_params):
+    """Temperature sampling is distribution-faithful, not stream-identical —
+    what IS pinned: same seed twice gives the same stream, every token is a
+    valid id, and the rng chain stays aligned with the sequential path at
+    every span boundary (the property that makes seeds reproduce)."""
+    model, params = model_and_params
+    ids = prompt()
+    cfg = GenerationConfig(max_new_tokens=10, do_sample=True, temperature=0.8, top_k=10)
+    _, rngs = _sequential(model, params, ids, cfg, seed=9, extra=3)
+    out1, b1, *_ = _speculative(model, params, ids, cfg, 2, 1, seed=9)
+    out2, b2, *_ = _speculative(model, params, ids, cfg, 2, 1, seed=9)
+    assert out1 == out2
+    assert all(0 <= t < VOCAB for t in out1)
+    for emitted, rng_state in b1:
+        np.testing.assert_array_equal(rng_state, rngs[emitted - 1])
+
+
+def test_speculative_int8_stores_token_exact_greedy(model_and_params):
+    """The quantization levers compose: int8 cache + int8 weights under the
+    speculative pair reproduce the int8 sequential stream exactly (greedy)."""
+    model, params = model_and_params
+    ids = prompt()
+    cfg = GenerationConfig(max_new_tokens=8)
+    kw = dict(cache_dtype=jnp.int8, weight_dtype=jnp.int8)
+    prefill, step = make_decode_fns(model, NUM_LATENTS, cfg, **kw)
+    tok, state = prefill(params, ids, None, jax.random.PRNGKey(7))
+    seq = [int(tok[0])]
+    for _ in range(cfg.max_new_tokens - 1):
+        state, tok = step(state)
+        seq.append(int(tok[0]))
+    out, *_ = _speculative(model, params, ids, cfg, 2, 2, **kw)
+    assert out[: len(seq)] == seq
+
+
+def test_speculative_eos_stream_exact(model_and_params):
+    """EOS mid-stream: the speculative stream freezes to PAD exactly where
+    the sequential stream does (the done flag latches per EMITTED token)."""
+    model, params = model_and_params
+    ids = prompt()
+    base, _ = _sequential(model, params, ids, GenerationConfig(max_new_tokens=10))
+    eos = next(t for t in base[1:] if t != base[0])
+    cfg = GenerationConfig(max_new_tokens=10, eos_token_id=int(eos), pad_token_id=63)
+    seq, _ = _sequential(model, params, ids, cfg)
+    out, *_ = _speculative(model, params, ids, cfg, 3, 1)
+    assert out[: len(seq)] == seq
+    assert eos in seq and seq[seq.index(eos) + 1 :] == [63] * (9 - seq.index(eos))
+
+
+# ------------------------------------------------------------------- drafter
+
+
+def test_drafter_caches_are_flagship_prefix(model_and_params):
+    """The shared-weights claim that makes the spec prefill free: a drafter
+    built from the flagship's own weights, run over the same prompt with
+    FRESH caches, populates exactly the flagship prefill caches' prefix
+    (CA + SA layers 0..depth-1) — so reusing them is not an approximation."""
+    from perceiver_io_tpu.core.attention import prefill_mode
+    from perceiver_io_tpu.core.modules import CausalSequenceModel
+    from perceiver_io_tpu.generation import drafter_decode_params
+
+    model, params = model_and_params
+    ids = prompt()
+    depth = 2
+    drafter = make_drafter(model, depth)
+    dparams = drafter_decode_params(params, depth)
+    flag_cache = CausalSequenceModel.init_cache(
+        model.config, 1, ca_capacity=20, sa_capacity=12
+    )
+    draft_cache = CausalSequenceModel.init_cache(
+        drafter.config, 1, ca_capacity=20, sa_capacity=12
+    )
+    with prefill_mode():
+        flag_out = model.apply(params, ids, prefix_len=8, kv_cache=flag_cache)
+        draft_out = drafter.apply(dparams, ids, prefix_len=8, kv_cache=draft_cache)
+    assert len(draft_out.kv_cache) == 1 + depth
+    for got, want in zip(draft_out.kv_cache, flag_out.kv_cache[: 1 + depth]):
+        np.testing.assert_array_equal(np.asarray(got.k), np.asarray(want.k))
+        np.testing.assert_array_equal(np.asarray(got.v), np.asarray(want.v))
+
+
+def test_make_drafter_rejects_bad_depth(model_and_params):
+    model, _ = model_and_params
+    for depth in (0, 3, 7):  # the fixture flagship has 3 SA layers
+        with pytest.raises(ValueError, match=r"draft_depth must be in \[1..2\]"):
+            make_drafter(model, depth)
+
+
+def test_speculative_validations(model_and_params):
+    """Loud geometry contracts: the pair serves batch 1, and the window must
+    never slide mid-decode (the beam_search precedent)."""
+    model, params = model_and_params
+    ids = prompt()
+    prefill, _ = make_speculative_decode_fns(
+        model, NUM_LATENTS, GenerationConfig(max_new_tokens=4), k=2
+    )
+    with pytest.raises(ValueError, match="batch 1"):
+        prefill(params, jnp.concatenate([ids, ids]), None, None)
+    prefill2, _ = make_speculative_decode_fns(
+        model, 8, GenerationConfig(max_new_tokens=12), k=2
+    )
+    with pytest.raises(ValueError, match="does not slide the window"):
+        prefill2(params, ids, None, None)
+
+
+# ------------------------------------------------------------- the graph pins
+
+
+def _spec_target():
+    from perceiver_io_tpu.analysis.flagship import build_targets
+
+    return build_targets("micro", targets=("decode_spec",))["decode_spec"]
+
+
+def test_decode_spec_graph_no_kv_concat_one_verify_append():
+    """The ISSUE 14 graph pin: the speculative step's traced graph contains
+    NO concatenate over a kv-capacity axis (rollback is a length-counter
+    adjustment, not a concat), and the verify scope appends each flagship
+    cache exactly ONCE (one k + one v dynamic_update_slice per cache — a
+    per-token verify loop would multiply these; one flagship forward per
+    draft span is the whole point)."""
+    from perceiver_io_tpu.analysis import graph as G
+
+    t = _spec_target()
+    closed = G.trace(t.fn, *t.args)
+    caches = t.args[0]["cache"]
+    forbidden_axes = {c.capacity for c in caches}
+    verify_appends = 0
+    for op in G.iter_ops(closed):
+        if op.primitive == "concatenate" and op.outvars:
+            axis = int(op.params.get("dimension", -1))
+            shape = op.outvars[0].shape
+            assert not (
+                0 <= axis < len(shape) and shape[axis] in forbidden_axes
+            ), f"kv-axis concatenate crept into decode_spec: {shape} axis {axis} @ {op.scope}"
+        if op.primitive == "dynamic_update_slice" and "verify" in op.scope:
+            verify_appends += 1
+    assert verify_appends == 2 * len(caches), (
+        f"{verify_appends} verify-scope cache writes for {len(caches)} caches — "
+        f"one flagship forward per span writes exactly {2 * len(caches)} "
+        "(k + v per cache); more means the verify re-entered a per-token loop"
+    )
+
+
+def test_decode_spec_contract_committed_and_green():
+    """The 8th flagship program is under contract and the live graph matches
+    it (the same check ``tasks.py perf`` runs)."""
+    import os
+
+    from perceiver_io_tpu.analysis.fingerprint import PROGRAMS, check_contracts
+
+    assert "decode_spec" in PROGRAMS
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    result = check_contracts(os.path.join(repo, "contracts"), programs=("decode_spec",))
+    assert result["status"] == "passed", result["programs"]["decode_spec"]
+
+
+# ------------------------------------------------------------------ the engine
+
+
+@pytest.fixture(scope="module")
+def engine_model_and_params():
+    # max_latents 16 >= max_sa_tokens so the spec engine's no-slide
+    # validation holds with the budgets the workload draws
+    config = CausalLanguageModelConfig(
+        vocab_size=VOCAB, max_seq_len=24, max_latents=16, num_channels=32,
+        num_heads=4, num_self_attention_layers=2, cross_attention_dropout=0.5,
+    )
+    model = CausalLanguageModel(config)
+    ids = np.random.default_rng(0).integers(0, VOCAB, size=(1, 12))
+    params = model.init(jax.random.PRNGKey(0), jnp.asarray(ids), prefix_len=8)
+    return model, params
+
+
+def _spec_engine(model, params, base_config=None, **kw):
+    from perceiver_io_tpu.serving import EngineConfig, EngineFrontEnd
+
+    return EngineFrontEnd(
+        model, params, num_latents=NUM_LATENTS, base_config=base_config,
+        engine_config=EngineConfig(slots=4, page_size=8, max_ca_tokens=24,
+                                   max_sa_tokens=12, spec_k=2, spec_depth=1),
+        **kw,
+    )
+
+
+def _sequential_tokens(model, params, spec, base_config=None):
+    cfg = dataclasses.replace(
+        base_config or GenerationConfig(), max_new_tokens=spec.max_new_tokens
+    )
+    prefill, step = make_decode_fns(model, NUM_LATENTS, cfg)
+    tok, state = prefill(
+        params, jnp.asarray(spec.input_ids), None, jax.random.PRNGKey(spec.rng_seed)
+    )
+    out = [int(tok[0])]
+    for _ in range(spec.max_new_tokens - 1):
+        state, tok = step(state)
+        out.append(int(tok[0]))
+    return out
+
+
+def test_spec_engine_ragged_greedy_token_exact(engine_model_and_params):
+    """Ragged engine batches (mixed prompt lengths AND budgets, slots
+    joining/retiring mid-flight) through the SPECULATIVE slot mode produce
+    exactly the sequential streams; books and page books balance."""
+    from perceiver_io_tpu.obs.loadgen import WorkloadSpec
+
+    model, params = engine_model_and_params
+    specs = WorkloadSpec(seed=13, prompt_lens=(8, 12), max_new_tokens=(4, 8)).draw(8, VOCAB)
+    fe = _spec_engine(model, params)
+    recs = fe.run_closed(specs, concurrency=8)
+    assert all(r.outcome == "ok" for r in recs), [vars(r) for r in recs]
+    assert fe.books()["balanced"] and fe.audit() == []
+    assert fe.ca_alloc.pages_used == 0 and fe.sa_alloc.pages_used == 0
+    for spec in specs:
+        want = _sequential_tokens(model, params, spec)
+        got = fe.served_tokens[spec.index]
+        assert got == want, (spec.index, got, want)
+
+
+def test_spec_engine_open_loop_token_exact(engine_model_and_params):
+    """The open-loop engine drive (the LOAD_r03 leg): Poisson arrivals
+    through the speculative batched path, every stream still sequential-
+    exact, books clean."""
+    from perceiver_io_tpu.obs.loadgen import WorkloadSpec
+
+    model, params = engine_model_and_params
+    wspec = WorkloadSpec(seed=5, prompt_lens=(10,), max_new_tokens=(6,))
+    fe = _spec_engine(model, params)
+    recs = fe.run_open(wspec.draw(8, VOCAB), rate_rps=200.0)
+    assert all(r.outcome == "ok" for r in recs)
+    assert fe.books()["balanced"] and fe.audit() == []
+    for spec in wspec.draw(8, VOCAB):
+        assert fe.served_tokens[spec.index] == _sequential_tokens(model, params, spec)
+
+
+def test_spec_engine_eos_matches_sequential(engine_model_and_params):
+    """EOS retires a speculative slot at the same token the sequential path
+    stops at — span tokens past the EOS are dropped, never served."""
+    from perceiver_io_tpu.obs.loadgen import WorkloadSpec
+
+    model, params = engine_model_and_params
+    specs = WorkloadSpec(seed=5, prompt_lens=(10,), max_new_tokens=(8,)).draw(4, VOCAB)
+    seq0 = _sequential_tokens(model, params, specs[0])
+    eos = next(t for t in seq0[1:] if t != seq0[0])
+    base = GenerationConfig(eos_token_id=int(eos))
+    fe = _spec_engine(model, params, base_config=base)
+    recs = fe.run_closed(specs, concurrency=4)
+    assert fe.books()["balanced"] and all(r.outcome == "ok" for r in recs)
+    hit = [r for r in recs if r.tokens_out < r.max_new_tokens]
+    assert hit, "no request terminated at EOS — the pin is vacuous"
+    for spec in specs:
+        want = _sequential_tokens(model, params, spec, base_config=base)
+        got = fe.served_tokens[spec.index]
+        assert got == want[: len(got)]
+        if len(got) < spec.max_new_tokens:
+            assert got[-1] == int(eos)
+
+
+def test_spec_engine_kill_mid_span_books_clean(engine_model_and_params, tmp_path):
+    """A kill landing MID-SPAN (the per-token seam fires for every emitted
+    token of a speculative step): the slot retires at the killed token,
+    span remainder dropped, books + pages exact — the chaos scenario
+    ``serve_spec_kill_mid_span`` certifies the same under the flight
+    recorder."""
+    from perceiver_io_tpu.obs.events import EventLog
+    from perceiver_io_tpu.obs.loadgen import WorkloadSpec
+    from perceiver_io_tpu.serving import FaultInjector
+
+    model, params = engine_model_and_params
+    events = EventLog(str(tmp_path), main_process=True)
+    injector = FaultInjector().kill_at(1, 2)
+    fe = _spec_engine(model, params, events=events, injector=injector)
+    specs = WorkloadSpec(seed=6, prompt_lens=(10,), max_new_tokens=(6,)).draw(3, VOCAB)
+    recs = fe.run_closed(specs, concurrency=3)
+    books = fe.books()
+    assert books["error"] == 1 and books["ok"] == 2 and books["balanced"], books
+    dead = next(r for r in recs if r.outcome == "error")
+    assert dead.index == 1 and dead.tokens_out == 3, vars(dead)
+    assert len(fe.served_tokens[1]) == 3  # nothing past the kill was served
+    assert fe.ca_alloc.pages_used == 0 and fe.sa_alloc.pages_used == 0
+    # survivors still sequential-exact
+    for spec in (specs[0], specs[2]):
+        assert fe.served_tokens[spec.index] == _sequential_tokens(model, params, spec)
+
+
+def test_spec_engine_events_carry_acceptance_telemetry(engine_model_and_params, tmp_path):
+    """The measurement satellite: speculative request rows carry
+    ``acceptance_rate``/``tokens_per_step`` (validated as OPTIONAL numeric
+    fields — zero problems, zero forward-compat warnings), and the
+    registry's spec histograms accumulate per-request samples."""
+    from perceiver_io_tpu.obs.events import EventLog, merged_events, validate_events
+    from perceiver_io_tpu.obs.loadgen import WorkloadSpec
+
+    model, params = engine_model_and_params
+    events = EventLog(str(tmp_path), main_process=True)
+    fe = _spec_engine(model, params, events=events)
+    fe.run_closed(WorkloadSpec(seed=4, prompt_lens=(10,), max_new_tokens=(6,)).draw(5, VOCAB),
+                  concurrency=5)
+    warnings_out = []
+    assert validate_events(str(tmp_path), warnings_out=warnings_out) == []
+    assert warnings_out == []
+    rows = [e for e in merged_events(str(tmp_path)) if e.get("event") == "request"]
+    assert len(rows) == 5
+    for row in rows:
+        assert 0.0 <= row["acceptance_rate"] <= 1.0, row
+        assert row["tokens_per_step"] >= 1.0, row
+    snap = fe.registry.snapshot()
+    assert snap["histograms"]["spec_acceptance_rate"]["n"] == 5
+    assert snap["histograms"]["spec_tokens_per_step"]["n"] == 5
+
+
+def test_spec_engine_prefill_filled_budget_rides_no_span(engine_model_and_params, tmp_path):
+    """A request whose budget the PREFILL token already fills
+    (max_new_tokens == 1) retires before the batched step: it must not ride
+    a draft/verify span that can emit nothing — a phantom span would record
+    tokens_per_step == 0 and never-emitted 'accepted' drafts into the
+    acceptance telemetry. Its row carries NO acceptance fields (zero spans
+    ridden is the honest accounting); full-budget neighbours in the same
+    run still do."""
+    from perceiver_io_tpu.obs.events import EventLog, merged_events, validate_events
+    from perceiver_io_tpu.obs.loadgen import WorkloadSpec
+
+    model, params = engine_model_and_params
+    events = EventLog(str(tmp_path), main_process=True)
+    fe = _spec_engine(model, params, events=events)
+    specs = WorkloadSpec(seed=9, prompt_lens=(10,), max_new_tokens=(1, 6)).draw(6, VOCAB)
+    assert {s.max_new_tokens for s in specs} == {1, 6}, "mix must draw both buckets"
+    recs = fe.run_closed(specs, concurrency=6)
+    assert all(r.outcome == "ok" for r in recs)
+    assert fe.books()["balanced"] and fe.audit() == []
+    for spec in specs:
+        assert fe.served_tokens[spec.index] == _sequential_tokens(model, params, spec)
+    warnings_out = []
+    assert validate_events(str(tmp_path), warnings_out=warnings_out) == []
+    assert warnings_out == []
+    rows = [e for e in merged_events(str(tmp_path)) if e.get("event") == "request"]
+    for row in rows:
+        if row["tokens_out"] == 1:
+            assert "acceptance_rate" not in row and "tokens_per_step" not in row, row
+        else:
+            assert row["tokens_per_step"] >= 1.0, row
+    snap = fe.registry.snapshot()
+    n_spanned = sum(1 for s in specs if s.max_new_tokens > 1)
+    assert snap["histograms"]["spec_tokens_per_step"]["n"] == n_spanned
+
+
+def test_spec_engine_open_loop_rejects_unsorted_offsets(engine_model_and_params):
+    """Explicit out-of-order arrival offsets fail loudly: both open-loop
+    drive loops only inspect the head of the pending deque, so an earlier
+    arrival queued behind a later one would be admitted late with its
+    queue-wait charged against the wrong interval."""
+    from perceiver_io_tpu.obs.loadgen import WorkloadSpec
+
+    model, params = engine_model_and_params
+    fe = _spec_engine(model, params)
+    specs = WorkloadSpec(seed=3, prompt_lens=(10,), max_new_tokens=(4,)).draw(2, VOCAB)
+    with pytest.raises(ValueError, match="non-decreasing"):
+        fe.run_open(specs, offsets=[5.0, 1.0])
+
+
+def test_spec_engine_rejects_sliding_window_geometry(engine_model_and_params):
+    """The construction-time no-slide contract: a speculative engine whose
+    per-slot ceilings could outgrow the model windows fails loudly."""
+    from perceiver_io_tpu.serving import EngineConfig, EngineFrontEnd
+
+    model, params = engine_model_and_params
+    with pytest.raises(ValueError, match="never slides the window"):
+        EngineFrontEnd(
+            model, params, num_latents=NUM_LATENTS,
+            engine_config=EngineConfig(slots=2, page_size=8, max_ca_tokens=24,
+                                       max_sa_tokens=24, spec_k=2),
+        )

@@ -73,6 +73,13 @@ exactly one terminal outcome, zero leaked worker slots):
                               breaker (shed ``breaker_open``, one
                               ``breaker`` dump); the RetryPolicy-spaced
                               half-open probe closes it on the manual clock.
+- ``serve_spec_kill_mid_span`` — Specline: a kill lands MID-SPAN inside the
+                              speculative engine (a verify step emits
+                              m ∈ [1, k+1] tokens; the per-token seam fires
+                              for each): the slot retires at the killed
+                              token, span remainder dropped, pages freed,
+                              books balanced, acceptance telemetry on every
+                              event row, one dump names the dead span.
 
 ``--scenarios`` accepts fnmatch globs: ``--scenarios 'serve_*'`` runs the
 serving family standalone, ``--scenarios 'elastic_*,preempt'`` composes.
@@ -996,6 +1003,67 @@ def scenario_serve_engine_pages(tmp):
     )
 
 
+def scenario_serve_spec_kill_mid_span(tmp):
+    """Specline: a request dies MID-SPAN inside the speculative engine —
+    a verify step emits m ∈ [1, k+1] tokens and streams each through the
+    per-token seam, so the kill takes effect at its exact token index even
+    when that index lands inside a span: the slot retires ``error`` there,
+    the span's remaining tokens are dropped (never served), pages return,
+    books balance, every request row carries acceptance telemetry, and one
+    flight dump names the dead request's span."""
+    from perceiver_io_tpu.serving import EngineConfig, EngineFrontEnd, FaultInjector
+
+    model, params = _serving_model()
+    recorder, clock, run_dir = _serve_env(tmp, "serve_spec_kill")
+    injector = FaultInjector(clock=clock).kill_at(3, 2)
+    fe = EngineFrontEnd(
+        model, params, num_latents=4,
+        # max_sa_tokens == the gate model's max_latents: the speculative
+        # no-slide contract, validated at construction
+        engine_config=EngineConfig(slots=4, page_size=8, max_ca_tokens=24,
+                                   max_sa_tokens=8, spec_k=2, spec_depth=1),
+        events=recorder, clock=clock, sleep=clock.sleep, injector=injector,
+    )
+    recs = fe.run_closed(_serve_spec().draw(8, 64), concurrency=4)
+    books = _audit_serving(fe, run_dir, "serve_spec_kill_mid_span")
+    assert [r.outcome for r in recs].count("error") == 1 and books["error"] == 1
+    assert books["admitted"] == 8 and books["ok"] == 7, books
+    dead = next(r for r in recs if r.outcome == "error")
+    assert dead.index == 3 and 0 < dead.tokens_out < dead.max_new_tokens, vars(dead)
+    # the kill's token index is exact: tokens 0..2 served, nothing after
+    assert dead.tokens_out == 3 and len(fe.served_tokens[3]) == 3
+    assert fe.ca_alloc.pages_used == 0 and fe.sa_alloc.pages_used == 0
+    assert fe.ca_alloc.audit() == [] and fe.sa_alloc.audit() == []
+    rows = [e for e in _stream(run_dir) if e.get("event") == "request"]
+    assert len(rows) == 8
+    # the measurement satellite holds under chaos: every row carries the
+    # acceptance pair, and the spec step really batched multiple tokens
+    assert all(isinstance(e.get("acceptance_rate"), (int, float)) for e in rows)
+    assert all(e.get("tokens_per_step", 0) >= 1.0 for e in rows)
+    assert any(e["tokens_per_step"] > 1.0 for e in rows), (
+        "no request emitted more than one token per verify step — the "
+        "mid-SPAN property is vacuous"
+    )
+    dumps = recorder.dumps
+    assert len(dumps) == 1 and "flight-error" in os.path.basename(dumps[0]), dumps
+    with open(dumps[0]) as f:
+        dump = json.load(f)
+    err_rows = [e for e in rows if e.get("outcome") == "error"]
+    assert len(err_rows) == 1
+    assert dump["trigger_span_id"] == err_rows[0]["span_id"], (
+        "flight dump does not name the dead request's span"
+    )
+    ok_rows = [e for e in rows if e.get("outcome") == "ok"]
+    assert all(e["tokens_out"] == 4 for e in ok_rows), ok_rows
+    tps = [e["tokens_per_step"] for e in ok_rows]
+    print(
+        f"chaos: serve_spec_kill_mid_span ok — request 3 killed at token 3 "
+        f"mid-span (k=2 spec engine, tokens/step up to {max(tps):.2f}), span "
+        "remainder dropped, slot + pages freed, books balanced "
+        "(7 ok / 1 error), acceptance telemetry on all 8 rows, 1 dump names the span"
+    )
+
+
 SCENARIOS = {
     "preempt": scenario_preempt,
     "preempt_mesh": scenario_preempt_mesh,
@@ -1014,6 +1082,7 @@ SCENARIOS = {
     "serve_breaker": scenario_serve_breaker,
     "serve_engine_kill_mid_decode": scenario_serve_engine_kill_mid_decode,
     "serve_engine_pages": scenario_serve_engine_pages,
+    "serve_spec_kill_mid_span": scenario_serve_spec_kill_mid_span,
 }
 
 

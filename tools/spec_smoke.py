@@ -1,0 +1,152 @@
+"""Spec-decode smoke — the ``tasks.py perf`` speculative leg (ISSUE 14).
+
+A CI-fast certification of the speculative draft/verify pair on the tiny
+gate model (the same ``build_workload`` geometry ``tasks.py load`` and the
+``serve_*`` chaos scenarios run, loaded from tools/loadgen.py so the gates
+cannot desynchronize):
+
+1. **token-exactness** — the greedy speculative stream is bit-exact to the
+   sequential ``make_decode_fns`` stream for k ∈ {1, 2}, and the rng chain
+   state at every span boundary equals the sequential chain after the same
+   emitted-token count (seeds reproduce);
+2. **acceptance-rate sanity** — acceptance lands in [0, 1], the serial-step
+   multiple (tokens per verify step) is >= 1.0, and at least one span
+   emitted more than one token OR the drafter disagreed at least once (a
+   vacuous run — zero spans — fails);
+3. **temperature determinism** — same seed twice gives the same sampled
+   stream through the speculative path.
+
+Exit codes: 0 clean, 1 failure, 3 internal error.
+
+    python tools/spec_smoke.py            # the gate
+    python tools/spec_smoke.py --tokens 16 --k 4 --depth 1
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _gate_model():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "loadgen_cli", os.path.join(_REPO, "tools", "loadgen.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.build_workload()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    # the gate model's latent window is 8 with 4 initial latents — budget 4
+    # is the largest no-slide speculative budget it admits
+    p.add_argument("--tokens", type=int, default=4, help="decode budget per stream")
+    p.add_argument("--k", type=int, default=None,
+                   help="single k to check (default: both 1 and 2)")
+    p.add_argument("--depth", type=int, default=1, help="drafter depth")
+    args = p.parse_args(argv)
+
+    try:
+        import jax
+        import jax.numpy as jnp  # noqa: F401
+        import numpy as np
+
+        from perceiver_io_tpu.generation import (
+            GenerationConfig,
+            make_decode_fns,
+            make_speculative_decode_fns,
+        )
+
+        model, params, config = _gate_model()
+        num_latents = 4
+        n_new = args.tokens
+        prompt = jnp.asarray(
+            np.random.default_rng(3).integers(0, config.vocab_size, size=(1, 12))
+        )
+        problems = []
+
+        def sequential(cfg, seed, extra=0):
+            import dataclasses
+
+            run_cfg = dataclasses.replace(cfg, max_new_tokens=cfg.max_new_tokens + extra)
+            prefill, step = make_decode_fns(model, num_latents, run_cfg)
+            tok, state = prefill(params, prompt, None, jax.random.PRNGKey(seed))
+            out, rngs = [int(tok[0])], [np.asarray(state["rng"])]
+            for _ in range(run_cfg.max_new_tokens - 1):
+                state, tok = step(state)
+                out.append(int(tok[0]))
+                rngs.append(np.asarray(state["rng"]))
+            return out, rngs
+
+        def speculative(cfg, k, seed):
+            prefill, step = make_speculative_decode_fns(
+                model, num_latents, cfg, k=k, draft_depth=args.depth
+            )
+            tok, state = prefill(params, prompt, None, jax.random.PRNGKey(seed))
+            out, bounds, spans, accepted = [int(tok[0])], [], 0, 0
+            while len(out) < cfg.max_new_tokens:
+                state, toks, m = step(state)
+                m0 = int(m[0])
+                spans += 1
+                accepted += m0 - 1
+                out.extend(int(t) for t in np.asarray(toks[0, :m0]))
+                bounds.append((len(out), np.asarray(state["rng"])))
+            return out, bounds, spans, accepted
+
+        cfg = GenerationConfig(max_new_tokens=n_new)
+        ks = [args.k] if args.k is not None else [1, 2]
+        for k in ks:
+            seq, rngs = sequential(cfg, seed=7, extra=k)
+            out, bounds, spans, accepted = speculative(cfg, k, seed=7)
+            if out[:n_new] != seq[:n_new]:
+                problems.append(f"k={k}: greedy stream diverged: {out[:n_new]} vs {seq[:n_new]}")
+            for emitted, rng_state in bounds:
+                if not (rng_state == rngs[emitted - 1]).all():
+                    problems.append(f"k={k}: rng chain misaligned after {emitted} tokens")
+                    break
+            rate = accepted / max(spans * k, 1)
+            tps = (n_new - 1) / max(spans, 1)
+            if not 0.0 <= rate <= 1.0:
+                problems.append(f"k={k}: acceptance rate {rate} outside [0, 1]")
+            if tps < 1.0:
+                problems.append(f"k={k}: tokens_per_step {tps} < 1.0")
+            if spans == 0:
+                problems.append(f"k={k}: zero verify spans — the check is vacuous")
+            print(f"spec_smoke: k={k} depth={args.depth}: token-exact, "
+                  f"acceptance={rate:.2f}, tokens_per_step={tps:.2f} ({spans} spans)")
+
+        cfg_t = GenerationConfig(
+            max_new_tokens=n_new, do_sample=True, temperature=0.8, top_k=10
+        )
+        s1, *_ = speculative(cfg_t, 2, seed=9)
+        s2, *_ = speculative(cfg_t, 2, seed=9)
+        if s1 != s2:
+            problems.append(f"temperature sampling nondeterministic: {s1} vs {s2}")
+        else:
+            print("spec_smoke: temperature same-seed streams identical")
+
+        if problems:
+            print("spec_smoke: FAILED:")
+            for pb in problems:
+                print(f"  - {pb}")
+            return 1
+        print("spec_smoke: OK")
+        return 0
+    except Exception as e:  # noqa: BLE001 — CI must see crash != verdict
+        import traceback
+
+        traceback.print_exc()
+        print(f"spec_smoke: internal error: {e}", file=sys.stderr)
+        return 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())

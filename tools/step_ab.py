@@ -26,6 +26,16 @@ which is the same-process A/B the kernel's docs/performance.md entry cites:
     python tools/step_ab.py --variants host+bf16m host+bf16m+twoseg
 
     python tools/step_ab.py [--batch-size 4] [--steps 20] [--microbatch 2]
+
+Since round 14 (Specline) the harness also takes DECODE variants, so the
+standing TPU A/B instruction in ROADMAP item 3 covers the speculative
+ladder with the same interleaved same-process discipline: ``decode`` runs
+the sequential host-driven pair (``generation.make_decode_fns``) and
+``spec{K}x{D}`` (e.g. ``spec4x6``) the speculative pair with K draft
+tokens per span and a depth-D self-drafter — batch 1, prompt sized for
+the no-slide window, tok/s measured over the same paired-chain slope:
+
+    python tools/step_ab.py --variants decode spec4x6 spec4x2
 """
 
 from __future__ import annotations
@@ -117,9 +127,66 @@ def main():
 
         return lambda k: float(run(state, batch, k))
 
+    import re as _re
+
+    def build_decode(variant):
+        """DECODE-family variants (round 14): ``decode`` = the sequential
+        host-driven pair, ``spec{K}x{D}`` = the speculative draft/verify
+        pair. run(k) decodes >= k tokens from a fresh prefill; the prefill
+        (and the spec path's over-shoot tail) cancels in the paired-chain
+        slope exactly like decode_ab's prompt pass."""
+        from perceiver_io_tpu.generation import (
+            GenerationConfig,
+            make_decode_fns,
+            make_speculative_decode_fns,
+        )
+
+        m = _re.fullmatch(r"spec(\d+)x(\d+)", variant)
+        budget = n_long + (int(m.group(1)) + 1 if m else 0)
+        prompt_len = args.seq_len - budget
+        num_latents = args.latents - budget
+        config = flagship_config(args.seq_len, args.latents)
+        model = CausalLanguageModel(config, dtype=jnp.bfloat16)
+        # per-variant FIXED seed (not the shared mutated generator): the
+        # prompt — and with it a spec variant's acceptance rate — must not
+        # depend on which other variants ran first in --variants
+        prompt = jnp.asarray(
+            np.random.default_rng(7).integers(0, config.vocab_size, size=(1, prompt_len))
+        )
+        params = model.init(
+            jax.random.PRNGKey(0), prompt[:, : num_latents + 1], prefix_len=1
+        )
+        gcfg = GenerationConfig(max_new_tokens=budget)
+        if m:
+            prefill, step = make_speculative_decode_fns(
+                model, num_latents, gcfg,
+                k=int(m.group(1)), draft_depth=int(m.group(2)),
+            )
+
+            def run(k):
+                _, state = prefill(params, prompt, None, jax.random.PRNGKey(11))
+                emitted, toks = 1, None
+                while emitted < k:
+                    state, toks, mm = step(state)
+                    emitted += int(mm[0])
+                return float(state["token"][0])
+        else:
+            prefill, step = make_decode_fns(model, num_latents, gcfg)
+
+            def run(k):
+                _, state = prefill(params, prompt, None, jax.random.PRNGKey(11))
+                for _ in range(k - 1):
+                    state, tok = step(state)
+                return float(state["token"][0])
+
+        return run
+
     from perceiver_io_tpu.ops.flash_attention import fast_kernels
 
     n_short, n_long = 2, 2 + args.steps
+    decode_family = {
+        v for v in args.variants if v == "decode" or _re.fullmatch(r"spec\d+x\d+", v)
+    }
     runs = {}
     for name in args.variants:
         # kernel features are read at TRACE time: build AND compile each
@@ -128,7 +195,7 @@ def main():
         # flag silently measures the other kernel)
         feats = frozenset({"twoseg"}) if "twoseg" in name.split("+") else frozenset()
         with fast_kernels(feats):
-            runs[name] = build(name)
+            runs[name] = build_decode(name) if name in decode_family else build(name)
             t0 = time.perf_counter()
             runs[name](n_short)
             runs[name](n_long)
@@ -141,7 +208,10 @@ def main():
         if med is None:
             print(f"{v:<16}  all slope estimates non-positive (tunnel stall?) — rerun")
             continue
-        print(f"{v:<16} {med * 1e3:8.3f} {b * n / med:12.0f}")
+        # decode-family variants are batch-1 token loops: tok/s = 1/slope;
+        # train variants keep the b*n tokens-per-step convention
+        tok_s = (1 / med) if v in decode_family else (b * n / med)
+        print(f"{v:<16} {med * 1e3:8.3f} {tok_s:12.0f}")
 
 
 if __name__ == "__main__":
