@@ -470,6 +470,26 @@ def _decode_step_body(model, mcfg, config, step_params, carry, pad_slots, pos_sh
     return carry_out, sampled, decode_health(out.logits[:, -1], out.kv_cache[0], ca_start)
 
 
+def advance_rng_chain(rng: jax.Array, n_tokens: int) -> jax.Array:
+    """The sequential rng chain's state after ``n_tokens`` emitted tokens.
+
+    Every decode path advances the chain exactly ONE split per emitted
+    token — ``rng, step_key = jax.random.split(rng)`` in the prefill's
+    first sample, :func:`generate`'s fused scan, the host-driven
+    :func:`make_decode_fns` step, the paged engine's per-slot chains and
+    the speculative accept — so the chain position IS the emitted-token
+    count. That alignment is what makes preempted requests resumable
+    token-exactly: replaying a prefill over ``prompt + emitted_prefix``
+    with ``advance_rng_chain(PRNGKey(seed), len(emitted_prefix))`` hands
+    the prefill's internal split exactly the key the uninterrupted run
+    would have drawn for the next token (``serving.engine`` eviction
+    resume and journal recovery ride this seam —
+    docs/robustness.md#engine-eviction-and-recovery)."""
+    for _ in range(int(n_tokens)):
+        rng, _ = jax.random.split(rng)
+    return rng
+
+
 def _sample_per_slot(logits: jnp.ndarray, rngs: jnp.ndarray, config: GenerationConfig) -> jnp.ndarray:
     """Per-slot sampling with per-slot key chains: each decode slot draws
     exactly what a batch-1 :func:`_sample` call with its key would draw —

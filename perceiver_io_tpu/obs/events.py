@@ -358,6 +358,16 @@ _REQUIRED_FIELDS: Dict[str, tuple] = {
     "serve.breaker": ("state", "prev", "reason"),
     "serve.retry": ("attempt", "delay_s"),
     "serve.drain": ("books",),
+    # Evictline (serving/engine.py + serving/journal.py, docs/robustness.md
+    # #engine-eviction-and-recovery). Vocabulary note: `serve.preempt`
+    # (below, in KNOWN_EVENT_KINDS) is the SIGTERM/drain signal — the whole
+    # PROCESS winding down; these three are per-REQUEST preemption: a slot
+    # evicted under page pressure (its pages reclaimed, the request parked
+    # resumable), a parked request resumed by token-exact prefill replay,
+    # and a journaled request re-admitted into a fresh engine after a crash.
+    "serve.evict": ("request_index", "tokens_out", "pages_freed"),
+    "serve.resume": ("request_index", "tokens_out"),
+    "serve.recover": ("request_index", "tokens_resumed"),
 }
 
 # OPTIONAL fields validated WHEN PRESENT (type-checked, never required —
@@ -371,6 +381,15 @@ _OPTIONAL_FIELD_TYPES: Dict[str, Dict[str, tuple]] = {
         "batch_size_at_decode": (int, float),
         "acceptance_rate": (int, float),
         "tokens_per_step": (int, float),
+    },
+    # Evictline: the engine leg of tools/loadgen.py stamps its eviction
+    # behavior into the load.summary row (and the LOAD_r* artifact body) —
+    # optional so pre-Evictline streams/artifacts stay valid, type-checked
+    # when present so a regression in the counters cannot sail through
+    "load.summary": {
+        "evictions": (int, float),
+        "resumes": (int, float),
+        "parked_depth_peak": (int, float),
     },
 }
 

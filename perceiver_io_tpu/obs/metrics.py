@@ -104,24 +104,46 @@ class Counter:
 
 
 class Gauge:
-    """Last-write-wins scalar (queue depth, inflight requests, ...)."""
+    """Last-write-wins scalar (queue depth, inflight requests, ...).
+
+    :attr:`peak` keeps the high-water mark across every write — the
+    "what did it reach" question a scrape-cadence consumer cannot answer
+    from :attr:`value` alone (a depth spike between scrapes is invisible).
+    The engine's ``serve_parked_depth`` gauge reads it into the LOAD
+    artifact's ``parked_depth_peak``; ``None`` until the first write."""
 
     def __init__(self, name: str, help: str = ""):
         self.name, self.help = name, help
         self._value = 0.0
+        self._peak = None
         self._lock = threading.Lock()
 
     def set(self, v: float) -> None:
         with self._lock:
             self._value = float(v)
+            self._peak = self._value if self._peak is None else max(self._peak, self._value)
 
     def add(self, n: float) -> None:
         with self._lock:
             self._value += float(n)
+            self._peak = self._value if self._peak is None else max(self._peak, self._value)
 
     @property
     def value(self) -> float:
         return self._value
+
+    @property
+    def peak(self):
+        """High-water mark over every write (None before the first)."""
+        return self._peak
+
+    def reset_peak(self) -> None:
+        """Restart the high-water mark at the CURRENT value — the
+        measured-window boundary seam (tools/loadgen.py resets after its
+        warmup leg so the committed peak covers only the measured run).
+        A gauge never written stays peak-less."""
+        with self._lock:
+            self._peak = None if self._peak is None else self._value
 
 
 class Histogram:

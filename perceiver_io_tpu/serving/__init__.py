@@ -22,10 +22,15 @@ from perceiver_io_tpu.serving.breaker import (  # noqa: F401
     CircuitBreaker,
 )
 from perceiver_io_tpu.serving.faultinject import (  # noqa: F401
+    EngineCrash,
     FaultInjector,
     InjectedFault,
     ManualClock,
     poison_params,
+)
+from perceiver_io_tpu.serving.journal import (  # noqa: F401
+    JOURNAL_KINDS,
+    RequestJournal,
 )
 from perceiver_io_tpu.serving.engine import (  # noqa: F401
     EngineConfig,
@@ -47,7 +52,10 @@ from perceiver_io_tpu.serving.pages import (  # noqa: F401
 
 __all__ = [
     "EngineConfig",
+    "EngineCrash",
     "EngineFrontEnd",
+    "JOURNAL_KINDS",
+    "RequestJournal",
     "PageAllocator",
     "PageGrant",
     "PageStats",

@@ -28,7 +28,22 @@ them through ONE compiled batched step:
   field; ``engine_batch_fill_frac`` / ``engine_kv_pages_used`` gauges in
   the shared registry (rendered by ``tools/obs_report.py``); mid-decode
   kill/cancel/deadline land as terminal outcomes with the slot AND its
-  pages freed — ``tools/chaos.py serve_engine_*`` certifies books + pages.
+  pages freed — ``tools/chaos.py serve_engine_*`` certifies books + pages;
+- **page-pressure eviction + crash recovery** (Evictline,
+  docs/robustness.md#engine-eviction-and-recovery): with
+  ``EngineConfig(eviction=True)`` a queued request that fits the pool but
+  not the free list reclaims pages from the least-progressed in-flight
+  slot — the victim is PARKED (prompt, served tokens, rng position kept)
+  and later resumed **token-exactly** by replaying the existing prefill
+  program over ``prompt + emitted prefix`` with the latent count grown by
+  one per emitted token and the rng chain advanced one split per emitted
+  token (``generation.advance_rng_chain``); the books identity extends to
+  ``submitted == terminal + queued + in_flight + parked``. A
+  ``serving.journal.RequestJournal`` makes the same replay survive the
+  ENGINE's death: :meth:`EngineFrontEnd.recover` on a fresh engine
+  re-admits every journaled non-terminal request and resumes it from its
+  journaled progress — ``tools/chaos.py serve_evict_storm`` /
+  ``serve_crash_recover`` certify both.
 """
 
 from __future__ import annotations
@@ -39,7 +54,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from perceiver_io_tpu.serving.frontend import RequestFrontEnd, _Ticket
+from perceiver_io_tpu.serving.frontend import FrontEndRecord, RequestFrontEnd, _Ticket
 from perceiver_io_tpu.serving.pages import PageAllocator
 
 
@@ -69,6 +84,15 @@ class EngineConfig:
     # slots of slack for the transient pre-rollback span.
     spec_k: int = 0
     spec_depth: int = 1
+    # Evictline page-pressure preemption: when a queued request COULD fit
+    # the pool but the free list is short, reclaim pages from the least-
+    # progressed in-flight slot (parked resumable; resumed token-exactly by
+    # prefill replay) instead of holding the queue. Requires the no-slide
+    # window geometry (max_ca_tokens <= model max_seq_len, max_sa_tokens <=
+    # model max_latents — validated loudly at construction): the replay
+    # prefill reconstructs the victim's latent set as prompt-tail latents,
+    # which a slid window cannot express.
+    eviction: bool = False
 
 
 class EngineFrontEnd(RequestFrontEnd):
@@ -103,6 +127,23 @@ class EngineFrontEnd(RequestFrontEnd):
                 f"max_ca_tokens <= max_seq_len ({ec.max_ca_tokens} vs "
                 f"{mcfg.max_seq_len}) and max_sa_tokens <= max_latents "
                 f"({ec.max_sa_tokens} vs {mcfg.max_latents})"
+            )
+        if (ec.eviction or self.journal is not None) and (
+            ec.max_ca_tokens > mcfg.max_seq_len or ec.max_sa_tokens > mcfg.max_latents
+        ):
+            # same no-slide contract as the speculative mode, for a
+            # different reason: resume-by-prefill-replay rebuilds a parked
+            # slot's latents as the last (num_latents + emitted) positions
+            # of prompt + prefix — a window that slid mid-stream has
+            # dropped latents the replay geometry cannot express. A journal
+            # demands it too: its whole purpose is token-exact crash
+            # recovery, which runs the same replay (:meth:`recover`)
+            raise ValueError(
+                "eviction and journal recovery resume by prefill replay and "
+                "never slide the window: need max_ca_tokens <= max_seq_len "
+                f"({ec.max_ca_tokens} vs {mcfg.max_seq_len}) and "
+                f"max_sa_tokens <= max_latents ({ec.max_sa_tokens} vs "
+                f"{mcfg.max_latents})"
             )
         self._ca_pages_per_slot = -(-(ec.max_ca_tokens + self._spec_slack) // ps)
         self._sa_pages_per_slot = -(-(ec.max_sa_tokens + self._spec_slack) // ps)
@@ -168,7 +209,7 @@ class EngineFrontEnd(RequestFrontEnd):
                 make_paged_step_fn(model, self._gen_config, self.weight_dtype),
                 "engine_decode_step",
             )
-        self._prefill_fns: Dict[int, object] = {}
+        self._prefill_fns: Dict[tuple, object] = {}
         self._join_fn = self._tracker.wrap(
             jax.jit(_join_state, donate_argnums=0), "engine_join"
         )
@@ -176,6 +217,9 @@ class EngineFrontEnd(RequestFrontEnd):
             jax.jit(_retire_state, donate_argnums=0), "engine_retire"
         )
         self._slots: List[Optional[_EngineSlot]] = [None] * s
+        # Evictline: self._parked (inherited — books()/audit() close over
+        # it) holds page-evicted slots parked resumable, FIFO: resume order
+        # is admission order, the oldest preempted work re-enters first
         self._engine_steps = 0
         self._fill_sum = 0  # sum of active-slot counts over steps
         # request index -> decoded token ids (the streaming surface a real
@@ -191,6 +235,12 @@ class EngineFrontEnd(RequestFrontEnd):
         self._m_fill = r.gauge("engine_batch_fill_frac")
         self._m_pages = r.gauge("engine_kv_pages_used")
         self._m_pages_frac = r.gauge("engine_kv_pages_frac")
+        # Evictline counters + the parked-depth gauge (its .peak high-water
+        # mark feeds the LOAD artifact's parked_depth_peak)
+        self._m_evictions = r.counter("serve_evictions_total")
+        self._m_resumes = r.counter("serve_resumes_total")
+        self._m_recovered = r.counter("serve_recovered_total")
+        self._m_parked = r.gauge("serve_parked_depth")
         if self._spec:
             # per-request drafter quality, recorded at retire: the A/B
             # inputs the graduation ledger and docs/performance.md cite
@@ -228,8 +278,24 @@ class EngineFrontEnd(RequestFrontEnd):
 
     # -- join ----------------------------------------------------------------
 
-    def _prefill_for(self, max_new: int):
-        if max_new not in self._prefill_fns:
+    # resume replay can hit a distinct (remaining, num_latents + n) point
+    # per eviction progress mark — LRU-bound the program cache so a
+    # long-lived engine under sustained pressure cannot grow it without
+    # limit (an evicted entry re-compiles on next use; compile events
+    # surface through the tracker either way)
+    _PREFILL_CACHE_MAX = 64
+
+    def _prefill_for(self, max_new: int, num_latents: Optional[int] = None):
+        """The committed prefill program for one decode budget. ``num_latents``
+        (default: the engine's) is the resume-replay seam: a parked request
+        with ``n`` emitted tokens replays over ``prompt + prefix`` with
+        ``num_latents + n`` latents — the SAME traced prefill, one latent
+        per emitted token grown, so the replayed state IS the uninterrupted
+        slot's (no new program family; recompiles surface as compile
+        events through the tracker like any other geometry)."""
+        num_latents = self.num_latents if num_latents is None else int(num_latents)
+        key = (max_new, num_latents)
+        if key not in self._prefill_fns:
             import dataclasses as _dc
 
             from perceiver_io_tpu.generation import make_decode_fns
@@ -237,11 +303,16 @@ class EngineFrontEnd(RequestFrontEnd):
             cfg = _dc.replace(self._gen_config, max_new_tokens=max_new)
             kwargs = {} if self.cache_dtype is None else {"cache_dtype": self.cache_dtype}
             prefill, _ = make_decode_fns(
-                self.model, self.num_latents, cfg,
+                self.model, num_latents, cfg,
                 weight_dtype=self.weight_dtype, **kwargs,
             )
-            self._prefill_fns[max_new] = self._tracker.wrap(prefill, "engine_prefill")
-        return self._prefill_fns[max_new]
+            while len(self._prefill_fns) >= self._PREFILL_CACHE_MAX:
+                self._prefill_fns.pop(next(iter(self._prefill_fns)))
+            self._prefill_fns[key] = self._tracker.wrap(prefill, "engine_prefill")
+        else:
+            # LRU touch: re-insertion keeps hot geometries at the tail
+            self._prefill_fns[key] = self._prefill_fns.pop(key)
+        return self._prefill_fns[key]
 
     def _try_join(self, ticket: _Ticket, slot_id: int) -> bool:
         """Prefill the ticket's request and land it in ``slot_id``. Returns
@@ -309,6 +380,8 @@ class EngineFrontEnd(RequestFrontEnd):
         slot.tokens_out = 1
         slot.first_token = first
         self.served_tokens[rec.index] = [first]
+        if self.journal is not None:
+            self.journal.append("progress", rec.index, tokens=[first])
         self._state = self._join_fn(
             self._state,
             jnp.int32(slot_id),
@@ -420,14 +493,367 @@ class EngineFrontEnd(RequestFrontEnd):
         self._retire_books(slot, outcome, emit=True)
         self._busy_until = float(self._clock())
 
+    # -- eviction / park / resume (Evictline) --------------------------------
+
+    def _select_victim(self) -> Optional[int]:
+        """The least-progress/lowest-priority victim: fewest tokens emitted,
+        ties broken toward the latest-admitted request (highest index) — the
+        request that loses the least replay work and jumped the line last.
+        Slots already terminal (outcome set) or budget-complete are never
+        victims: their pages come back at the next sweep for free."""
+        cands = [
+            (s.tokens_out, -s.ticket.record.index, slot_id)
+            for slot_id, s in enumerate(self._slots)
+            if s is not None and s.outcome is None
+            and s.tokens_out < s.ticket.record.max_new_tokens
+        ]
+        return min(cands)[2] if cands else None
+
+    def _evict_slot(self, slot_id: int) -> None:
+        """Preempt one in-flight slot: pages reclaimed, device slot released,
+        the request PARKED resumable (prompt + served prefix + rng position
+        — all it needs is already in ``served_tokens`` and its spec). NOT a
+        terminal transition: the books identity moves it from in_flight to
+        parked and :meth:`_try_resume` finishes the job later."""
+        slot = self._slots[slot_id]
+        self._slots[slot_id] = None
+        self._in_flight -= 1
+        pages_freed = slot.ca_grant.n_pages + slot.sa_grant.n_pages
+        self.ca_alloc.free(slot.ca_grant)
+        self.sa_alloc.free(slot.sa_grant)
+        slot.ca_grant = slot.sa_grant = None
+        self._state = self._retire_fn(self._state, self._jnp.int32(slot_id))
+        slot.slot_id = -1
+        slot.evictions += 1
+        self._n_evictions += 1
+        self._m_evictions.inc()
+        rec = slot.ticket.record
+        span_id = None
+        if slot.span is not None:
+            # the preempted SEGMENT's span closes here (slot lifetimes
+            # overlap and a parked request may outlive many segments);
+            # resume opens a fresh span under the same request_id
+            slot.span.set("outcome", "evicted")
+            slot.span.set("tokens_out", slot.tokens_out)
+            span_id = slot.span.span_id
+            self._tracer.record(slot.span)
+            self._tracer.flush()
+        slot.span = None
+        self._parked.append(slot)
+        self._m_parked.set(len(self._parked))
+        if self.journal is not None:
+            self.journal.append("evict", rec.index, tokens_out=slot.tokens_out)
+        if self.events is not None:
+            row = dict(request_index=rec.index, tokens_out=slot.tokens_out,
+                       pages_freed=pages_freed)
+            if span_id is not None:
+                row["span_id"] = span_id
+            self.events.emit("serve.evict", **row)
+
+    def _evict_for(self, ticket: _Ticket) -> bool:
+        """Reclaim pages for a queued request that fits the pool but not the
+        free list: evict least-progress victims until it fits (True) or no
+        victim remains (False — pure backpressure, exactly the pre-Evictline
+        behavior). Admission already shed can-never-fit requests, so when
+        every slot is evictable this always terminates in a fit."""
+        if not self.engine_config.eviction:
+            return False
+        rec = ticket.record
+        ca_tokens = rec.prompt_len + rec.max_new_tokens + self._spec_slack
+        sa_tokens = self.num_latents + rec.max_new_tokens + self._spec_slack
+        while not (
+            self.ca_alloc.can_fit_now(ca_tokens)
+            and self.sa_alloc.can_fit_now(sa_tokens)
+        ):
+            victim = self._select_victim()
+            if victim is None:
+                return False
+            self._evict_slot(victim)
+        return True
+
+    def _park_terminal(self, slot: "_EngineSlot", outcome: str) -> None:
+        """A parked request reaching a terminal outcome WITHOUT re-entering a
+        slot (cancelled while parked, deadline expired while parked): books
+        close through the same retire path, no pages involved."""
+        rec = slot.ticket.record
+        rec.tokens_out = slot.tokens_out
+        self._retire_books(slot, outcome, emit=True)
+
+    def _try_resume(self, slot: "_EngineSlot", slot_id: int) -> bool:
+        """Resume one parked request into ``slot_id`` by prefill replay:
+        prefill over ``prompt + the n served tokens`` with ``num_latents +
+        n`` latents (one latent per emitted token — the uninterrupted
+        slot's exact latent set) and the rng chain advanced n splits
+        (``generation.advance_rng_chain``), so the replayed prefill's own
+        sample IS token n of the uninterrupted stream and every subsequent
+        batched step matches token-exactly. Returns False only when pages
+        are short RIGHT NOW (the request stays parked); a replay failure
+        books a terminal ``error`` exactly like a join failure."""
+        import jax
+
+        jnp = self._jnp
+        rec = slot.ticket.record
+        idx = rec.index
+        n = slot.tokens_out
+        remaining = rec.max_new_tokens - n
+        # page demand is the ORIGINAL join's: the replay's CA stream is
+        # prompt + n + remaining = prompt + budget, and its SA stream is
+        # (num_latents + n) + remaining = num_latents + budget
+        ca_tokens = rec.prompt_len + rec.max_new_tokens + self._spec_slack
+        sa_tokens = self.num_latents + rec.max_new_tokens + self._spec_slack
+        ca_grant = self.ca_alloc.alloc_tokens(ca_tokens)
+        if ca_grant is None:
+            return False
+        sa_grant = self.sa_alloc.alloc_tokens(sa_tokens)
+        if sa_grant is None:
+            self.ca_alloc.free(ca_grant)
+            return False
+        slot.ca_grant, slot.sa_grant = ca_grant, sa_grant
+        emitted = self.served_tokens[idx]
+        replay_ids = np.concatenate(
+            [np.asarray(slot.ticket.spec.input_ids, np.int32),
+             np.asarray([emitted], np.int32)],
+            axis=1,
+        )
+        if self.events is not None and self._tracer is not None:
+            from perceiver_io_tpu.obs.trace import Span
+
+            slot.span = Span(name="request", parent_id=None,
+                             attrs={"request_id": slot.request_id})
+        compiles0 = self._tracker.total_compiles
+        try:
+            if self._injector is not None:
+                self._injector.before_attempt(idx)
+            from perceiver_io_tpu.generation import advance_rng_chain
+
+            prefill = self._prefill_for(remaining, num_latents=self.num_latents + n)
+            serve_params = (
+                self._injector.params_for(idx, self.params)
+                if self._injector is not None
+                else self.params
+            )
+            rng = advance_rng_chain(jax.random.PRNGKey(int(slot.ticket.spec.rng_seed)), n)
+            token, pstate = prefill(serve_params, jnp.asarray(replay_ids), None, rng)
+            first = int(token[0])
+        except Exception as e:  # noqa: BLE001 — books close, pages return
+            self.ca_alloc.free(ca_grant)
+            self.sa_alloc.free(sa_grant)
+            slot.ca_grant = slot.sa_grant = None
+            rec.error = repr(e)
+            rec.attempts += 1
+            self._park_terminal(slot, "error")
+            return True  # reached a terminal outcome
+        rec.attempts += 1
+        slot.compiled = slot.compiled or self._tracker.total_compiles > compiles0
+        slot.tokens_out = n + 1
+        slot.slot_id = slot_id
+        emitted.append(first)
+        self._state = self._join_fn(
+            self._state,
+            jnp.int32(slot_id),
+            jnp.asarray(ca_grant.pages, jnp.int32),
+            jnp.asarray(sa_grant.pages, jnp.int32),
+            pstate["cache"],
+            (token[0].astype(jnp.int32), pstate["rng"],
+             pstate["done"][0], pstate["pad_slots"][0], pstate["pos_shift"][0]),
+        )
+        self._slots[slot_id] = slot
+        self._in_flight += 1
+        self._n_resumes += 1
+        self._m_resumes.inc()
+        if self.journal is not None:
+            self.journal.append("resume", idx, tokens_out=n)
+            self.journal.append("progress", idx, tokens=[first])
+        if self.events is not None:
+            row = dict(request_index=idx, tokens_out=n)
+            if slot.span is not None:
+                row["span_id"] = slot.span.span_id
+            self.events.emit("serve.resume", **row)
+        # the per-token seam fires for the replayed prefill's sample exactly
+        # like a join's token 0 (injector / cancel / deadline)
+        self._token_seam(slot, slot.tokens_out - 1)
+        return True
+
+    def _resume_parked(self) -> None:
+        """Fill free slots from the parked queue FIRST (admission order —
+        preempted work re-enters ahead of new joins), on NATURAL page
+        availability only: a resume never evicts, which is what bounds the
+        evict/resume interplay (every segment between preemptions emits at
+        least one token, so total remaining work strictly shrinks)."""
+        if not self._parked:
+            return
+        for slot_id, occupant in enumerate(self._slots):
+            if occupant is not None:
+                continue
+            while self._parked:
+                slot = self._parked[0]
+                now = float(self._clock())
+                if slot.ticket.cancelled:
+                    self._parked.pop(0)
+                    self._m_parked.set(len(self._parked))
+                    self._park_terminal(slot, "cancelled")
+                    continue
+                if (slot.ticket.deadline_at is not None
+                        and now > slot.ticket.deadline_at):
+                    self._parked.pop(0)
+                    self._m_parked.set(len(self._parked))
+                    self._park_terminal(slot, "timeout")
+                    continue
+                if not self._try_resume(slot, slot_id):
+                    return  # pages short: the parked head waits (FIFO)
+                self._parked.pop(0)
+                self._m_parked.set(len(self._parked))
+                break  # slot filled (or the head reached terminal) — next slot
+            if not self._parked:
+                return
+
+    # -- crash recovery (Evictline) ------------------------------------------
+
+    def recover(self, journal) -> dict:
+        """Re-admit a dead engine's non-terminal requests from its
+        write-ahead journal (``serving.journal.RequestJournal`` or a path)
+        into THIS fresh engine, and adopt the journal so both incarnations'
+        records share one file — the cross-restart books close over it.
+
+        Every journaled ``submitted`` without a ``terminal`` comes back:
+        requests with journaled progress are PARKED (prompt + progress
+        tokens + implied rng position — exactly an evicted slot's state,
+        so the standard :meth:`_try_resume` prefill replay finishes them
+        token-exactly); progress-less ones re-enter the queue and join
+        normally. Load-dependent admission checks (queue depth, deadline
+        projection, breaker) don't re-run — the dead engine already
+        admitted these — but the PAGE-FIT check does: a request THIS
+        engine's pool/window can never fit (the geometry shrank across the
+        restart) is booked ``shed kv_pages_exhausted`` instead of
+        busy-spinning the drive loops forever. Deadlines RESTART from
+        recovery time (the journal records the relative budget; the wall
+        time lost to the crash is the operator's fault, not the
+        request's). A journaled stream already at budget (or ending in
+        eos) crashed in the emit-to-retire window: it is booked terminal
+        ``ok`` here, nothing left to decode. Emits one span-attributed
+        ``serve.recover`` event per request; returns a summary dict."""
+        from perceiver_io_tpu.serving.journal import RequestJournal
+
+        ec, mcfg = self.engine_config, self.model.config
+        if ec.max_ca_tokens > mcfg.max_seq_len or ec.max_sa_tokens > mcfg.max_latents:
+            # the construction-time no-slide check only fires when a journal
+            # (or eviction) was configured — recover() can adopt a journal
+            # onto any engine, so the replay's geometry contract re-checks
+            raise ValueError(
+                "journal recovery resumes by prefill replay and never "
+                "slides the window: need max_ca_tokens <= max_seq_len "
+                f"({ec.max_ca_tokens} vs {mcfg.max_seq_len}) and "
+                f"max_sa_tokens <= max_latents ({ec.max_sa_tokens} vs "
+                f"{mcfg.max_latents})"
+            )
+        if not isinstance(journal, RequestJournal):
+            journal = RequestJournal(journal)
+        self.journal = journal
+        now = float(self._clock())
+        eos = self._gen_config.eos_token_id
+        n = done_already = shed = 0
+        for entry in journal.pending():
+            spec = entry.spec()
+            rec = FrontEndRecord(
+                index=entry.index,
+                prompt_len=int(entry.prompt_len),
+                max_new_tokens=int(entry.max_new_tokens),
+                batch=1,
+            )
+            rec.queue_wait_s = 0.0
+            self.records.append(rec)
+            self._n["submitted"] += 1
+            self._m_submitted.inc()
+            verdict = self._page_fit_check(spec, None)
+            if verdict is not None:
+                # the dead engine admitted this, but THIS engine's geometry
+                # cannot ever fit it (the pool/window shrank across the
+                # restart): booking it shed closes its books — re-queueing
+                # it would busy-spin the drive loops forever on a request
+                # no allocation can satisfy
+                reason, detail = verdict
+                rec.outcome, rec.shed_reason = "shed", reason
+                self._n["shed"] += 1
+                self._m_shed.inc()
+                journal.append("terminal", entry.index, outcome="shed",
+                               shed_reason=reason)
+                self._emit_frontend_request(rec, shed_reason=reason,
+                                            queue_depth=len(self._queue),
+                                            **detail)
+                shed += 1
+                continue
+            self._n["admitted"] += 1
+            self._m_admitted.inc()
+            ticket = _Ticket(
+                spec=spec, record=rec, arrival_s=now,
+                deadline_at=(
+                    None if entry.deadline_s is None
+                    else now + float(entry.deadline_s)
+                ),
+            )
+            tokens = [int(t) for t in entry.tokens]
+            slot = None
+            if tokens:
+                slot = _EngineSlot(ticket=ticket, slot_id=-1,
+                                   ca_grant=None, sa_grant=None)
+                slot.tokens_out = len(tokens)
+                self.served_tokens[entry.index] = tokens
+            self._n_recovered += 1
+            self._m_recovered.inc()
+            journal.append("recovered", entry.index, tokens_resumed=len(tokens))
+            if self.events is not None:
+                row = dict(request_index=entry.index, tokens_resumed=len(tokens))
+                if self._tracer is not None:
+                    # the recover span carries the SAME request_id the
+                    # request's later resume span / terminal row will (the
+                    # parked slot mints it); a progress-less re-queue has
+                    # no slot yet, so its span keys on request_index alone
+                    # — the durable cross-restart identity either way
+                    rid = (slot.request_id if slot is not None
+                           else self._trace_mod.new_span_id())
+                    with self._tracer.span(
+                        "request", request_id=rid, request_index=entry.index
+                    ) as sp:
+                        sp.set("outcome", "recovered")
+                        sp.set("tokens_resumed", len(tokens))
+                    self._tracer.flush()  # span row BEFORE the recover row
+                    row["span_id"] = sp.span_id
+                self.events.emit("serve.recover", **row)
+            if slot is not None:
+                if len(tokens) >= rec.max_new_tokens or (
+                    eos is not None and tokens[-1] == eos
+                ):
+                    # crashed between the last emit and its retire: the
+                    # stream is complete — close the books, skip the replay
+                    self._park_terminal(slot, "ok")
+                    done_already += 1
+                else:
+                    self._parked.append(slot)
+            else:
+                self._queue.append(ticket)
+                self._set_queue_gauge()
+            n += 1
+        self._m_parked.set(len(self._parked))
+        return {
+            "recovered": n,
+            "parked": len(self._parked),
+            "queued": len(self._queue),
+            "already_complete": done_already,
+            "shed": shed,
+        }
+
     # -- the engine loop -----------------------------------------------------
 
     def _active_ids(self) -> List[int]:
         return [i for i, s in enumerate(self._slots) if s is not None]
 
     def _fill_slots(self) -> None:
-        """Batched prefill admission: join queued requests into every free
-        slot (page backpressure stops the fill, never sheds)."""
+        """Batched prefill admission: resume parked requests first (natural
+        page availability), then join queued requests into every free slot.
+        Page backpressure stops the fill — with ``eviction`` enabled a
+        blocked queue head may first reclaim pages from the least-progressed
+        slot (:meth:`_evict_for`); it never sheds."""
+        self._resume_parked()
         for slot_id, occupant in enumerate(self._slots):
             if occupant is not None:
                 continue
@@ -453,7 +879,11 @@ class EngineFrontEnd(RequestFrontEnd):
                                                 queue_expired=True)
                     continue
                 if not self._try_join(ticket, slot_id):
-                    return  # pages short: backpressure, keep the queue
+                    # pages short RIGHT NOW: page-pressure eviction (when
+                    # enabled) reclaims from the least-progressed slot so
+                    # the queue head proceeds; otherwise backpressure
+                    if not self._evict_for(ticket) or not self._try_join(ticket, slot_id):
+                        return  # keep the queue; pages will come back
                 break  # joined (or terminally booked) — next slot
         self._update_gauges()
 
@@ -463,6 +893,7 @@ class EngineFrontEnd(RequestFrontEnd):
         stats = self.ca_alloc.stats()
         self._m_pages.set(stats.pages_used + self.sa_alloc.stats().pages_used)
         self._m_pages_frac.set(stats.used_frac)
+        self._m_parked.set(len(self._parked))
 
     def _sweep_terminal(self) -> None:
         """Retire slots whose outcome is ALREADY terminal (a kill at token
@@ -520,10 +951,12 @@ class EngineFrontEnd(RequestFrontEnd):
                 slot.spec_accepted += span - 1
             per_tok = dt / max(n_emit, 1)
             finished = False
+            emitted_now: List[int] = []
             for j in range(n_emit):
                 tok = int(tokens[slot_id, j])
                 slot.tokens_out += 1
                 self.served_tokens[rec.index].append(tok)
+                emitted_now.append(tok)
                 slot.hist.record(per_tok)
                 slot.step_times.append(per_tok)
                 slot.batch_sizes.append(batch_size)
@@ -537,6 +970,12 @@ class EngineFrontEnd(RequestFrontEnd):
                 if eos is not None and tok == eos:
                     finished = True
                     break
+            if self.journal is not None and emitted_now:
+                # one progress record per slot per step (not per token):
+                # delivery stays at-least-once — tokens emitted after the
+                # last append a crash tore off are re-derived token-exactly
+                # by the recovery replay (serving.journal module docstring)
+                self.journal.append("progress", rec.index, tokens=emitted_now)
             if slot.tokens_out >= rec.max_new_tokens:
                 finished = True
             if slot.outcome is not None:
@@ -546,11 +985,19 @@ class EngineFrontEnd(RequestFrontEnd):
         self._update_gauges()
 
     def cancel(self, request_index: int) -> bool:
-        """Cancel a queued request or one live in a decode SLOT — the slot
+        """Cancel a queued request, one live in a decode SLOT — the slot
         retires ``cancelled`` at its next token boundary (the same
-        between-tokens seam the sequential path uses)."""
+        between-tokens seam the sequential path uses) — or a PARKED
+        (page-evicted / journal-recovered) request, which books terminal
+        ``cancelled`` when the resume loop next reaches it instead of
+        burning a replay for a caller who hung up."""
         for slot in self._slots:
             if slot is not None and slot.ticket.record.index == request_index:
+                slot.ticket.cancelled = True
+                return True
+        for slot in self._parked:
+            if (slot.ticket.record.index == request_index
+                    and not slot.ticket.cancelled):
                 slot.ticket.cancelled = True
                 return True
         return super().cancel(request_index)
@@ -570,7 +1017,9 @@ class EngineFrontEnd(RequestFrontEnd):
         terminal0 = sum(self._n[o] for o in
                         ("ok", "error", "timeout", "cancelled"))
         done = 0
-        while self._queue or self._active_ids():
+        # parked counts as live work: a recovered engine may start with
+        # NOTHING queued or in a slot — everything it owes is parked
+        while self._queue or self._active_ids() or self._parked:
             self._check_guard()
             self._fill_slots()
             self._engine_step()
@@ -597,10 +1046,10 @@ class EngineFrontEnd(RequestFrontEnd):
                 out.append(self.submit(pending.popleft(), deadline_s=deadline_s))
 
         admit()
-        while self._queue or pending or self._active_ids():
+        while self._queue or pending or self._active_ids() or self._parked:
             self._check_guard()
             admit()
-            if not (self._queue or self._active_ids()):
+            if not (self._queue or self._active_ids() or self._parked):
                 continue
             self._fill_slots()
             self._engine_step()
@@ -626,13 +1075,13 @@ class EngineFrontEnd(RequestFrontEnd):
         t0 = float(self._clock())
         pending = _deque(zip(specs, offsets))
         out = []
-        while pending or self._queue or self._active_ids():
+        while pending or self._queue or self._active_ids() or self._parked:
             self._check_guard()
             # admit every arrival whose time has passed on the clock
             while pending and t0 + pending[0][1] <= float(self._clock()):
                 spec, off = pending.popleft()
                 out.append(self.submit(spec, arrival_s=t0 + off, deadline_s=deadline_s))
-            if not (self._queue or self._active_ids()):
+            if not (self._queue or self._active_ids() or self._parked):
                 if pending:  # idle: jump to the next arrival
                     spec, off = pending.popleft()
                     self._advance_to(t0 + off)
@@ -663,6 +1112,11 @@ class _EngineSlot:
     compiled: bool = False
     first_token: Optional[int] = None
     outcome: Optional[str] = None  # set mid-decode by the token seam
+    # Evictline: how many times this request was page-evicted (parked and
+    # later resumed by prefill replay); 0 for a request that never left its
+    # slot. Rides the slot object THROUGH the parked queue — a parked
+    # request IS its slot record minus the device slot and the grants.
+    evictions: int = 0
     # speculative slot mode: verify spans this slot rode and raw accepted
     # draft tokens across them (pre-budget-clip — drafter quality, not
     # serving accounting)

@@ -41,6 +41,17 @@ class InjectedFault(RuntimeError):
     unless the scenario injects a transient type on purpose)."""
 
 
+class EngineCrash(BaseException):
+    """The "process died" failure class (Evictline crash recovery,
+    docs/robustness.md#engine-eviction-and-recovery): deliberately NOT an
+    ``Exception`` so no serving seam books it — the engine's per-token seam
+    and terminal accounting catch ``Exception`` only, so a planted crash
+    propagates straight out of the drive loop exactly like a SIGKILL'd
+    process would vanish: in-flight slots stay occupied, no terminal
+    records are written, and only the write-ahead request journal
+    (``serving.journal``) survives for ``EngineFrontEnd.recover``."""
+
+
 class ManualClock:
     """A monotonic clock that only moves when told to — the wall-clock-free
     substrate of the serving chaos scenarios.
@@ -134,6 +145,21 @@ class FaultInjector:
             )
         )
         return self
+
+    def crash_at(self, request_index: int, token_index: int) -> "FaultInjector":
+        """Tear the whole ENGINE down (not just the request) after token
+        ``token_index`` of request ``request_index`` streams: raises
+        :class:`EngineCrash`, a ``BaseException`` no accounting seam
+        catches — the mid-decode death the journal-backed
+        ``EngineFrontEnd.recover`` path is certified against
+        (``tools/chaos.py serve_crash_recover``)."""
+        return self.kill_at(
+            request_index, token_index,
+            exc=lambda: EngineCrash(
+                f"injected engine crash at request {request_index} "
+                f"token {token_index}"
+            ),
+        )
 
     def stall_at(self, request_index: Optional[int], token_index: int,
                  seconds: float) -> "FaultInjector":

@@ -56,6 +56,7 @@ reproducible simulation under a ``ManualClock``.
 
 from __future__ import annotations
 
+import os
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -173,6 +174,12 @@ class RequestFrontEnd:
         the object has ``advance_to`` the run loops step it (simulation);
         otherwise they pace with ``sleep`` (real time).
     :param injector: optional ``serving.faultinject.FaultInjector``.
+    :param journal: optional write-ahead request journal
+        (``serving.journal.RequestJournal`` or a path) — every submission
+        is journaled BEFORE admission runs and every terminal outcome
+        after, so ``EngineFrontEnd.recover`` on a fresh engine can re-admit
+        whatever a dead one still owed
+        (docs/robustness.md#engine-eviction-and-recovery).
     """
 
     def __init__(
@@ -190,9 +197,15 @@ class RequestFrontEnd:
         clock: Callable[[], float] = time.monotonic,
         sleep: Callable[[float], None] = time.sleep,
         injector=None,
+        journal=None,
     ):
         from perceiver_io_tpu.obs.metrics import MetricsRegistry
 
+        if isinstance(journal, (str, os.PathLike)):
+            from perceiver_io_tpu.serving.journal import RequestJournal
+
+            journal = RequestJournal(journal)
+        self.journal = journal
         self.model, self.params = model, params
         self.num_latents = num_latents
         self.base_config = base_config
@@ -214,6 +227,13 @@ class RequestFrontEnd:
         self._est_service = float(self.config.est_service_s)
         self._n = {k: 0 for k in ("submitted", "admitted", *TERMINAL_OUTCOMES)}
         self._in_flight = 0
+        # Evictline preemption state (populated only by the engine subclass;
+        # carried here so books()/audit() speak ONE identity for both front
+        # ends — the sequential path simply always shows parked == 0)
+        self._parked: List = []
+        self._n_evictions = 0
+        self._n_resumes = 0
+        self._n_recovered = 0
         self._active: Optional[_Ticket] = None
         self._draining = False
         self._guard: Optional[PreemptionGuard] = None
@@ -326,6 +346,20 @@ class RequestFrontEnd:
         self.records.append(rec)
         self._n["submitted"] += 1
         self._m_submitted.inc()
+        if self.journal is not None:
+            # WRITE-AHEAD, before any admission verdict: the full request
+            # identity, so a fresh engine can reconstruct the spec verbatim
+            # (serving.journal — a shed below still writes its terminal row)
+            import numpy as _np
+
+            self.journal.append(
+                "submitted", rec.index,
+                prompt_len=rec.prompt_len,
+                max_new_tokens=rec.max_new_tokens,
+                input_ids=_np.asarray(spec.input_ids).tolist(),
+                rng_seed=int(spec.rng_seed),
+                deadline_s=None if deadline_s is None else float(deadline_s),
+            )
         reason, detail = None, {}
         if self._draining:
             reason = "draining"
@@ -357,12 +391,20 @@ class RequestFrontEnd:
             rec.outcome, rec.shed_reason = "shed", reason
             self._n["shed"] += 1
             self._m_shed.inc()
+            if self.journal is not None:
+                # sheds close their journal entry here (they never reach
+                # _finish): the write-ahead submitted row above must not
+                # read as "owed" to a recovering engine
+                self.journal.append("terminal", rec.index, outcome="shed",
+                                    shed_reason=reason)
             self._emit_frontend_request(rec, shed_reason=reason,
                                         queue_depth=len(self._queue), **detail)
             return rec
         rec.probe = probe
         self._n["admitted"] += 1
         self._m_admitted.inc()
+        if self.journal is not None:
+            self.journal.append("admitted", rec.index)
         self._queue.append(_Ticket(
             spec=spec, record=rec, arrival_s=now, probe=probe,
             probe_cycle=self.breaker.cycle if probe else None,
@@ -395,6 +437,12 @@ class RequestFrontEnd:
         rec = ticket.record
         rec.outcome = outcome
         self._n[outcome] += 1
+        if self.journal is not None:
+            # exactly one terminal journal record per finished request —
+            # every served path (engine retire, queue cancel/expiry, the
+            # sequential worker) funnels through here
+            self.journal.append("terminal", rec.index, outcome=outcome,
+                                tokens_out=rec.tokens_out)
         if self.breaker is None:
             return
         if ticket.probe:
@@ -714,22 +762,32 @@ class RequestFrontEnd:
 
     def books(self) -> dict:
         """The accounting audit surface: per-outcome terminal counts plus
-        live queue/slot state. ``balanced`` is the clean-books invariant —
-        ``submitted == terminal + queued + in_flight`` AND ``admitted``
-        equals its own terminal/live decomposition; a leaked slot or a
-        double-counted outcome breaks it immediately."""
+        live queue/slot state. ``balanced`` is the clean-books invariant,
+        extended by Evictline with the parked (page-evicted, resumable)
+        population — ``submitted == terminal + queued + in_flight + parked``
+        AND ``admitted`` equals its own terminal/live decomposition; a
+        leaked slot or a double-counted outcome breaks it immediately. The
+        sequential front end never parks, so its identity degenerates to
+        the pre-Evictline one. ``evictions``/``resumes``/``recovered`` are
+        the preemption/recovery odometers (an evicted-then-resumed request
+        is still ONE submission — these count transitions, not requests)."""
         b = dict(self._n)
         b["queued"] = len(self._queue)
         b["in_flight"] = self._in_flight
+        b["parked"] = len(self._parked)
         b["terminal"] = sum(self._n[o] for o in TERMINAL_OUTCOMES)
         b["max_queue_depth"] = self.max_queue_depth
         b["draining"] = self._draining
+        b["evictions"] = self._n_evictions
+        b["resumes"] = self._n_resumes
+        b["recovered"] = self._n_recovered
+        live = b["queued"] + b["in_flight"] + b["parked"]
         admitted_terminal = sum(
             self._n[o] for o in ("ok", "error", "timeout", "cancelled")
         )
         b["balanced"] = (
-            b["submitted"] == b["terminal"] + b["queued"] + b["in_flight"]
-            and b["admitted"] == admitted_terminal + b["queued"] + b["in_flight"]
+            b["submitted"] == b["terminal"] + live
+            and b["admitted"] == admitted_terminal + live
             and b["submitted"] == b["admitted"] + b["shed"]
         )
         if self.breaker is not None:
@@ -747,6 +805,10 @@ class RequestFrontEnd:
             problems.append(f"leaked in-flight slots: {self._in_flight}")
         if expect_drained and b["queued"] != 0:
             problems.append(f"{b['queued']} requests still queued")
+        if expect_drained and b["parked"] != 0:
+            # a parked request after drain is a leak: it owes tokens and no
+            # loop is left to resume it
+            problems.append(f"{b['parked']} evicted requests still parked")
         return problems
 
     def health(self) -> dict:

@@ -73,6 +73,10 @@ class PageAllocator:
         self._free: List[int] = list(range(num_pages - 1, 0, -1))
         self._grants: Dict[int, dict] = {}
         self._next_grant = 0
+        # rejected operations (double free, drifted grant): every rejection
+        # is RECORDED here as well as raised, so a caller that swallowed the
+        # exception still leaves an auditable trail — audit() reports them
+        self._violations: List[str] = []
 
     # -- capacity questions --------------------------------------------------
 
@@ -119,13 +123,28 @@ class PageAllocator:
         return PageGrant(gid, tuple(pages), int(n_tokens), self.page_size)
 
     def free(self, grant: "PageGrant") -> None:
-        """Return a grant's pages to the free list (LIFO). Double-free is an
-        error — the books invariant's page-level analog."""
-        entry = self._grants.pop(grant.grant_id, None)
+        """Return a grant's pages to the free list (LIFO). A double free (or
+        a grant whose pages drifted from the books) is REJECTED — raised AND
+        recorded as an :meth:`audit` violation, never a silent free-list
+        corruption: the free list is untouched, the books keep their state,
+        and the incident stays visible even to a caller that swallowed the
+        exception."""
+        entry = self._grants.get(grant.grant_id)
         if entry is None:
+            self._violations.append(
+                f"double free rejected: grant {grant.grant_id} "
+                f"(pages {list(grant.pages)}) is not live"
+            )
             raise ValueError(f"grant {grant.grant_id} is not live (double free?)")
         if entry["pages"] != list(grant.pages):
+            # books keep the grant (the LIVE entry is authoritative); the
+            # drifted handle's free is refused wholesale
+            self._violations.append(
+                f"drifted free rejected: grant {grant.grant_id} claims pages "
+                f"{list(grant.pages)}, books say {entry['pages']}"
+            )
             raise ValueError(f"grant {grant.grant_id} pages drifted from the books")
+        del self._grants[grant.grant_id]
         # freed most-recent-first so reuse order is deterministic
         self._free.extend(reversed(entry["pages"]))
 
@@ -144,8 +163,10 @@ class PageAllocator:
 
     def audit(self) -> List[str]:
         """Invariant problems (empty = clean): every page is either free or
-        owned by exactly one live grant, scratch is never owned."""
-        problems: List[str] = []
+        owned by exactly one live grant, scratch is never owned — plus the
+        rejected-operation history (a double free that was raised AND
+        swallowed upstream still shows up here)."""
+        problems: List[str] = list(self._violations)
         owned: Dict[int, int] = {}
         for gid, g in self._grants.items():
             for p in g["pages"]:

@@ -143,10 +143,15 @@ def chaos(args):
     8->4, elastic_grow 4->8, flat_to_mesh, mesh_to_flat — kill and resume
     run on different virtual-device topologies, trajectory must match
     <= 1e-6 with a span-attributed resume.reshard event and a clean
-    graphlint pass on the new mesh), and the five SERVING scenarios
+    graphlint pass on the new mesh), and the SERVING scenarios
     (serve_overload / serve_kill_mid_decode / serve_deadline / serve_drain
-    / serve_breaker — the Shedline front end under injected failures, clean
-    books certified, docs/robustness.md#serving-hardening). Extra args go
+    / serve_breaker / the engine + speculative kill scenarios — the
+    Shedline front end and Pageline engine under injected failures, clean
+    books certified, docs/robustness.md#serving-hardening — plus the
+    Evictline pair: serve_evict_storm, page-pressure preemption with
+    token-exact resume, and serve_crash_recover, a journal-backed engine
+    restart with books balanced across it,
+    docs/robustness.md#engine-eviction-and-recovery). Extra args go
     to tools/chaos.py; ``--scenarios`` takes names or fnmatch globs
     (e.g. ``--scenarios 'serve_*'``)."""
     run(sys.executable, "tools/chaos.py", *args.rest)
@@ -199,9 +204,11 @@ def perf(args):
     token-exactness + rng-chain alignment + acceptance sanity on the tiny
     gate model), and
     finally the serve-chaos smoke (``tools/chaos.py --scenarios
-    serve_kill_mid_decode``: a mid-decode kill through the hardened front
-    end with the clean-books audit). Extra args go to tools/graphcheck.py
-    (e.g. ``--programs train_flat,decode``)."""
+    serve_kill_mid_decode,serve_crash_recover --smoke``: a mid-decode kill
+    through the hardened front end with the clean-books audit, plus an
+    engine crash recovered token-exactly from the write-ahead journal with
+    books balanced across the restart). Extra args go to
+    tools/graphcheck.py (e.g. ``--programs train_flat,decode``)."""
     run(sys.executable, "tools/graphcheck.py", *args.rest)
     run(sys.executable, "tools/graphlint.py", "--fail-on", "error")
     # trace-only on purpose: graphcheck just compiled the same five
@@ -227,9 +234,12 @@ def perf(args):
     # pair on the tiny gate model (tools/spec_smoke.py)
     run(sys.executable, "tools/spec_smoke.py")
     # serve-chaos smoke leg: kill a request mid-decode through the hardened
-    # front end and audit the books (the full serve_* family runs under
-    # `tasks.py chaos`; this pins the books invariant in perf CI)
-    run(sys.executable, "tools/chaos.py", "--scenarios", "serve_kill_mid_decode")
+    # front end and audit the books, then tear the ENGINE down mid-decode
+    # and recover it token-exactly from the write-ahead journal (Evictline;
+    # --smoke keeps the recovery leg greedy-only/CI-fast — the full serve_*
+    # family incl. serve_evict_storm runs under `tasks.py chaos`)
+    run(sys.executable, "tools/chaos.py", "--scenarios",
+        "serve_kill_mid_decode,serve_crash_recover", "--smoke")
 
 
 def main(argv=None):

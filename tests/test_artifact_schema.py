@@ -445,3 +445,86 @@ def test_speculative_event_fields_and_artifacts_pinned(tmp_path):
     assert spec["tokens_per_step"] > 1.0, spec
     assert 0.0 <= spec["acceptance_rate"] <= 1.0, spec
     assert spec.get("token_exact") is True, spec
+
+
+def test_evictline_event_vocabulary_pinned(tmp_path):
+    """The Evictline vocabulary (ISSUE 15): ``serve.evict`` /
+    ``serve.resume`` / ``serve.recover`` are KNOWN kinds with
+    required-field enforcement, kept DISTINCT from ``serve.preempt`` (the
+    SIGTERM/drain signal — whole-process wind-down; the three new kinds are
+    per-REQUEST preemption: page-evicted, replay-resumed, journal-
+    recovered), and the engine leg's eviction telemetry on ``load.summary``
+    (``evictions`` / ``resumes`` / ``parked_depth_peak``) is OPTIONAL and
+    numeric-validated when present — missing fields on the new kinds fail
+    hard, an unknown sibling kind only warns (forward compatibility)."""
+    from perceiver_io_tpu.obs.events import (
+        _OPTIONAL_FIELD_TYPES,
+        _REQUIRED_FIELDS,
+        EVENT_SCHEMA_VERSION,
+        KNOWN_EVENT_KINDS,
+        validate_events,
+    )
+
+    # the whole preemption vocabulary, pinned as a SET so the two meanings
+    # (process drain vs per-request eviction) can't blur: serve.preempt
+    # stays a known kind with NO required fields (it predates the table),
+    # the three Evictline kinds carry their consumed schemas
+    for kind in ("serve.preempt", "serve.evict", "serve.resume", "serve.recover"):
+        assert kind in KNOWN_EVENT_KINDS, kind
+    assert "serve.preempt" not in _REQUIRED_FIELDS  # the drain signal, unchanged
+    assert set(_REQUIRED_FIELDS["serve.evict"]) == {
+        "request_index", "tokens_out", "pages_freed"
+    }
+    assert set(_REQUIRED_FIELDS["serve.resume"]) == {"request_index", "tokens_out"}
+    assert set(_REQUIRED_FIELDS["serve.recover"]) == {"request_index", "tokens_resumed"}
+    for field in ("evictions", "resumes", "parked_depth_peak"):
+        assert field in _OPTIONAL_FIELD_TYPES["load.summary"], field
+        assert field not in _REQUIRED_FIELDS["load.summary"], field
+
+    def write_stream(rows):
+        path = tmp_path / "events.jsonl"
+        with open(path, "w") as f:
+            for row in rows:
+                f.write(json.dumps({"ts": 1.0, "schema_version": EVENT_SCHEMA_VERSION, **row}) + "\n")
+        return str(path)
+
+    summary = {"event": "load.summary", "mode": "closed", "n_requests": 8,
+               "achieved_rps": 100.0}
+    good = write_stream(
+        [
+            {"event": "serve.evict", "request_index": 3, "tokens_out": 2,
+             "pages_freed": 3},
+            {"event": "serve.resume", "request_index": 3, "tokens_out": 2},
+            {"event": "serve.recover", "request_index": 3, "tokens_resumed": 2},
+            {**summary, "evictions": 6, "resumes": 6, "parked_depth_peak": 2},
+            summary,  # pre-Evictline summaries (no counters) stay valid
+        ]
+    )
+    warnings_out = []
+    assert validate_events(good, strict_spans=False, warnings_out=warnings_out) == []
+    assert warnings_out == []
+
+    # missing required fields on the new kinds: hard failures
+    bad = write_stream([
+        {"event": "serve.evict", "request_index": 3},
+        {"event": "serve.resume", "tokens_out": 2},
+        {"event": "serve.recover", "request_index": 3},
+    ])
+    problems = validate_events(bad, strict_spans=False)
+    assert any("[serve.evict]: missing field 'tokens_out'" in p for p in problems)
+    assert any("[serve.evict]: missing field 'pages_freed'" in p for p in problems)
+    assert any("[serve.resume]: missing field 'request_index'" in p for p in problems)
+    assert any("[serve.recover]: missing field 'tokens_resumed'" in p for p in problems)
+
+    # malformed optional counters: problems; an unknown sibling kind from a
+    # NEWER library: a warning, never a problem (forward compatibility)
+    odd = write_stream([
+        {**summary, "evictions": "many", "parked_depth_peak": True},
+        {"event": "serve.evict2", "request_index": 1},
+    ])
+    warnings_out = []
+    problems = validate_events(odd, strict_spans=False, warnings_out=warnings_out)
+    assert any("evictions" in p for p in problems), problems
+    assert any("parked_depth_peak" in p for p in problems), problems
+    assert not any("serve.evict2" in p for p in problems), problems
+    assert len(warnings_out) == 1 and "serve.evict2" in warnings_out[0]

@@ -1,0 +1,615 @@
+"""Evictline tests (ISSUE 15): page-pressure preemption with token-exact
+resume and journal-backed engine crash recovery. The eviction seam parks an
+in-flight slot (pages reclaimed) and resumes it by replaying the existing
+prefill program over prompt + emitted prefix with the rng chain advanced one
+split per emitted token — pinned token-exact vs the uninterrupted sequential
+path, greedy AND temperature. The write-ahead request journal
+(``serving.journal``) survives an injected ``EngineCrash`` and a fresh
+engine's ``recover()`` re-admits every non-terminal request with the
+combined books balancing across the restart. Satellites: ``PageAllocator``
+double-free/drift hardening and fragmentation edge cases, the extended
+books identity (``submitted == terminal + queued + in_flight + parked``),
+and the ``Gauge.peak`` high-water mark the LOAD artifact reads."""
+
+import dataclasses
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from perceiver_io_tpu.generation import GenerationConfig, advance_rng_chain, make_decode_fns
+from perceiver_io_tpu.models.text import CausalLanguageModel, CausalLanguageModelConfig
+from perceiver_io_tpu.obs.loadgen import WorkloadSpec
+from perceiver_io_tpu.serving import (
+    EngineConfig,
+    EngineCrash,
+    EngineFrontEnd,
+    FaultInjector,
+    PageAllocator,
+    RequestJournal,
+)
+
+NUM_LATENTS = 4
+VOCAB = 64
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    config = CausalLanguageModelConfig(
+        vocab_size=VOCAB, max_seq_len=24, max_latents=8, num_channels=32,
+        num_heads=4, num_self_attention_layers=2, cross_attention_dropout=0.5,
+    )
+    model = CausalLanguageModel(config)
+    ids = np.random.default_rng(0).integers(0, VOCAB, size=(1, 12))
+    params = model.init(jax.random.PRNGKey(0), jnp.asarray(ids), prefix_len=8)
+    return model, params
+
+
+def _engine(model, params, base_config=None, *, headroom=1.0, eviction=False, **kw):
+    # budgets <= 4 keep sa_tokens (num_latents + budget) within the gate
+    # model's max_latents=8 — the no-slide bound eviction mode validates
+    return EngineFrontEnd(
+        model, params, num_latents=NUM_LATENTS, base_config=base_config,
+        engine_config=EngineConfig(slots=4, page_size=8, max_ca_tokens=16,
+                                   max_sa_tokens=8, pool_headroom=headroom,
+                                   eviction=eviction),
+        **kw,
+    )
+
+
+def _specs(n, seed=13):
+    return WorkloadSpec(seed=seed, prompt_lens=(8, 12), max_new_tokens=(3, 4)).draw(n, VOCAB)
+
+
+def _sequential_tokens(model, params, spec, base_config=None):
+    cfg = dataclasses.replace(
+        base_config or GenerationConfig(), max_new_tokens=spec.max_new_tokens
+    )
+    prefill, step = make_decode_fns(model, NUM_LATENTS, cfg)
+    tok, state = prefill(
+        params, jnp.asarray(spec.input_ids), None, jax.random.PRNGKey(spec.rng_seed)
+    )
+    out = [int(tok[0])]
+    for _ in range(spec.max_new_tokens - 1):
+        state, tok = step(state)
+        out.append(int(tok[0]))
+    return out
+
+
+_SAMPLERS = {
+    "greedy": lambda: GenerationConfig(),
+    "temperature": lambda: GenerationConfig(do_sample=True, temperature=0.8, top_k=10),
+}
+
+
+# ------------------------------------------------------- rng-chain alignment
+
+
+def test_advance_rng_chain_matches_manual_splits():
+    """The resume seam's whole correctness argument in one pin: the chain
+    position IS the emitted-token count — advancing n splits reproduces the
+    key the uninterrupted run would hold before token n+1."""
+    key = jax.random.PRNGKey(123)
+    manual = key
+    for n in range(6):
+        assert np.array_equal(np.asarray(advance_rng_chain(key, n)), np.asarray(manual))
+        manual, _ = jax.random.split(manual)
+    assert np.array_equal(np.asarray(advance_rng_chain(key, 0)), np.asarray(key))
+
+
+# ------------------------------------------- eviction with token-exact resume
+
+
+@pytest.mark.parametrize("sampling", ["greedy", "temperature"])
+def test_eviction_resume_token_exact(model_and_params, sampling):
+    """A half-size page pool forces real evictions; every request still
+    serves ``ok`` with ZERO sheds and every stream — the evicted-and-
+    resumed ones included — equals the uninterrupted sequential reference
+    exactly. The extended books identity closes and the pages come back."""
+    model, params = model_and_params
+    base = _SAMPLERS[sampling]()
+    fe = _engine(model, params, base, headroom=0.5, eviction=True)
+    specs = _specs(8)
+    recs = fe.run_closed(specs, concurrency=8)
+    books = fe.books()
+    assert books["evictions"] >= 1, "pool never pressured — the test is vacuous"
+    assert books["evictions"] == books["resumes"], books
+    assert books["ok"] == 8 and books["shed"] == 0 and books["balanced"], books
+    assert all(r.outcome == "ok" for r in recs)
+    assert fe.audit() == []
+    assert fe.ca_alloc.pages_used == 0 and fe.sa_alloc.pages_used == 0
+    assert fe.ca_alloc.audit() == [] and fe.sa_alloc.audit() == []
+    for spec in specs:
+        want = _sequential_tokens(model, params, spec, base)
+        assert fe.served_tokens[spec.index] == want, (
+            f"request {spec.index} ({sampling}): {fe.served_tokens[spec.index]} != {want}"
+        )
+
+
+def test_eviction_disabled_is_pure_backpressure(model_and_params):
+    """The same starved pool WITHOUT eviction: everything still serves (the
+    pre-Evictline backpressure behavior), but nothing is ever preempted —
+    the flag is the only difference."""
+    model, params = model_and_params
+    fe = _engine(model, params, headroom=0.5, eviction=False)
+    recs = fe.run_closed(_specs(8), concurrency=8)
+    books = fe.books()
+    assert books["evictions"] == 0 and books["resumes"] == 0, books
+    assert books["ok"] == 8 and books["balanced"], books
+
+
+def test_eviction_requires_no_slide_geometry(model_and_params):
+    """Eviction mode validates the no-slide window bound loudly at
+    construction: the replay prefill rebuilds the victim's latents as
+    prompt-tail latents, which a slid window cannot express."""
+    model, params = model_and_params
+    with pytest.raises(ValueError, match="never slide the window"):
+        EngineFrontEnd(
+            model, params, num_latents=NUM_LATENTS,
+            engine_config=EngineConfig(slots=4, page_size=8, max_ca_tokens=16,
+                                       max_sa_tokens=16, eviction=True),
+        )
+
+
+def test_parked_population_in_books_identity(model_and_params):
+    """Mid-run, an evicted request sits in ``parked`` and the identity
+    ``submitted == terminal + queued + in_flight + parked`` holds at every
+    engine-step boundary (sampled via a step hook), not only after drain."""
+    model, params = model_and_params
+    fe = _engine(model, params, headroom=0.5, eviction=True)
+    seen_parked = []
+    orig = fe._engine_step
+
+    def stepped():
+        orig()
+        b = fe.books()
+        assert b["balanced"], b
+        seen_parked.append(b["parked"])
+
+    fe._engine_step = stepped
+    fe.run_closed(_specs(8), concurrency=8)
+    assert max(seen_parked) >= 1, "no request was ever observed parked"
+    assert fe.books()["parked"] == 0  # drained clean
+    # the parked-depth gauge's high-water mark saw it too (the LOAD
+    # artifact's parked_depth_peak reads this)
+    assert fe.registry.gauge("serve_parked_depth").peak >= 1
+
+
+# ------------------------------------------------------------ crash recovery
+
+
+@pytest.mark.parametrize("sampling", ["greedy", "temperature"])
+def test_crash_recovery_token_exact_books_balanced(model_and_params, tmp_path, sampling):
+    """The engine dies mid-decode (injected ``EngineCrash`` — a
+    BaseException no accounting seam books, so in-flight slots freeze and
+    no terminal records land); a second engine recovers every non-terminal
+    request from the write-ahead journal and serves it token-exactly. The
+    journal's cross-incarnation books balance: submitted == terminal."""
+    model, params = model_and_params
+    base = _SAMPLERS[sampling]()
+    jpath = str(tmp_path / f"journal_{sampling}.jsonl")
+    specs = _specs(6)
+    fe1 = _engine(model, params, base, journal=jpath,
+                  injector=FaultInjector().crash_at(2, 1))
+    with pytest.raises(EngineCrash):
+        fe1.run_closed(specs, concurrency=6)
+    books1 = fe1.books()
+    assert books1["terminal"] < books1["submitted"], books1
+
+    journal = RequestJournal(jpath)
+    owed = journal.pending()
+    assert len(owed) == books1["submitted"] - books1["terminal"]
+    assert any(e.tokens for e in owed), "nothing crashed mid-decode — vacuous"
+
+    fe2 = _engine(model, params, base)
+    info = fe2.recover(journal)
+    assert info["recovered"] == len(owed)
+    assert info["parked"] >= 1
+    fe2.pump()
+    books2 = fe2.books()
+    assert books2["balanced"] and books2["parked"] == 0, books2
+    assert books2["recovered"] == len(owed), books2
+    assert fe2.audit() == []
+    jb = journal.books()
+    assert jb["balanced"] and jb["pending"] == 0, jb
+    assert jb["submitted"] == 6 and jb["outcomes"] == {"ok": 6}, jb
+    assert journal.audit() == []
+    served = dict(fe1.served_tokens)
+    served.update(fe2.served_tokens)
+    for spec in specs:
+        want = _sequential_tokens(model, params, spec, base)
+        assert served.get(spec.index) == want, (
+            f"request {spec.index} ({sampling}): {served.get(spec.index)} != {want}"
+        )
+
+
+def test_recover_books_complete_stream_without_replay(model_and_params, tmp_path):
+    """A journal whose progress already covers the full budget (the crash
+    landed in the emit-to-retire window) books terminal ``ok`` at recover
+    time — nothing is re-decoded, nothing is parked."""
+    model, params = model_and_params
+    jpath = str(tmp_path / "journal_done.jsonl")
+    spec = _specs(1)[0]
+    j = RequestJournal(jpath)
+    j.append("submitted", spec.index, prompt_len=spec.prompt_len,
+             max_new_tokens=spec.max_new_tokens,
+             input_ids=np.asarray(spec.input_ids).tolist(),
+             rng_seed=spec.rng_seed, deadline_s=None)
+    j.append("admitted", spec.index)
+    full = _sequential_tokens(model, params, spec)
+    j.append("progress", spec.index, tokens=full)
+    fe = _engine(model, params)
+    info = fe.recover(j)
+    assert info == {"recovered": 1, "parked": 0, "queued": 0,
+                    "already_complete": 1, "shed": 0}
+    books = fe.books()
+    assert books["ok"] == 1 and books["balanced"], books
+    assert j.books()["balanced"]
+    assert fe.served_tokens[spec.index] == full
+
+
+def test_cancel_reaches_parked_request(model_and_params):
+    """Review fix: ``fe.cancel()`` on a page-evicted (parked) request marks
+    its ticket so the resume loop books terminal ``cancelled`` instead of
+    burning a prefill replay for a caller who already hung up."""
+    model, params = model_and_params
+    fe = _engine(model, params, headroom=0.5, eviction=True)
+    cancelled = []
+    orig = fe._engine_step
+
+    def stepped():
+        orig()
+        if not cancelled and fe._parked:
+            idx = fe._parked[0].ticket.record.index
+            assert fe.cancel(idx) is True
+            cancelled.append(idx)
+
+    fe._engine_step = stepped
+    recs = fe.run_closed(_specs(8), concurrency=8)
+    assert cancelled, "no request was ever parked — the test is vacuous"
+    books = fe.books()
+    assert books["balanced"] and books["parked"] == 0, books
+    assert books["cancelled"] == 1 and books["ok"] == 7, books
+    rec = next(r for r in recs if r.index == cancelled[0])
+    assert rec.outcome == "cancelled"
+    assert fe.audit(expect_drained=True) == []
+
+
+def test_journal_requires_no_slide_geometry(model_and_params, tmp_path):
+    """Review fix: a journal demands the no-slide replay geometry exactly
+    like eviction mode — its whole purpose is token-exact crash recovery,
+    which runs the same prefill replay. Loud at construction when
+    ``journal=`` is passed, and again at ``recover()``, which can adopt a
+    journal onto an engine built without one."""
+    model, params = model_and_params
+    sliding = EngineConfig(slots=2, page_size=8, max_ca_tokens=32, max_sa_tokens=8)
+    with pytest.raises(ValueError, match="never slide"):
+        EngineFrontEnd(model, params, num_latents=NUM_LATENTS,
+                       engine_config=sliding, journal=str(tmp_path / "j.jsonl"))
+    fe = EngineFrontEnd(model, params, num_latents=NUM_LATENTS,
+                        engine_config=sliding)
+    with pytest.raises(ValueError, match="never slide"):
+        fe.recover(str(tmp_path / "j2.jsonl"))
+
+
+def test_recover_skips_torn_submitted_entry(model_and_params, tmp_path):
+    """Review fix: an entry whose ``submitted`` record was torn away
+    mid-file (its progress rows intact) has no spec identity to rebuild —
+    ``pending()`` excludes it so ``recover()`` re-admits the INTACT
+    requests instead of dying on the broken one, and the loss surfaces as
+    a journal audit problem."""
+    model, params = model_and_params
+    jpath = str(tmp_path / "torn.jsonl")
+    specs = _specs(2)
+    j = RequestJournal(jpath)
+    for spec in specs:
+        j.append("submitted", spec.index, prompt_len=spec.prompt_len,
+                 max_new_tokens=spec.max_new_tokens,
+                 input_ids=np.asarray(spec.input_ids).tolist(),
+                 rng_seed=spec.rng_seed, deadline_s=None)
+        j.append("admitted", spec.index)
+    j.append("progress", specs[1].index, tokens=[5])
+    with open(jpath) as f:
+        lines = f.readlines()
+    lines[0] = lines[0][: len(lines[0]) // 2] + "\n"  # tear spec 0's identity
+    with open(jpath, "w") as f:
+        f.writelines(lines)
+    j2 = RequestJournal(jpath)
+    assert [e.index for e in j2.pending()] == [specs[1].index]
+    assert any("without a parseable submitted record" in p for p in j2.audit())
+    fe = _engine(model, params)
+    info = fe.recover(j2)
+    assert info["recovered"] == 1 and info["parked"] == 1, info
+    fe.pump()
+    books = fe.books()
+    assert books["ok"] == 1 and books["balanced"], books
+
+
+def test_recover_sheds_unfit_request_instead_of_spinning(model_and_params, tmp_path):
+    """Review fix: a journaled request THIS engine's window can never fit
+    (the geometry shrank across the restart) is booked ``shed
+    kv_pages_exhausted`` at recover time — re-queueing it would busy-spin
+    the drive loops forever on a request no allocation can satisfy."""
+    jpath = str(tmp_path / "journal.jsonl")
+    model, params = model_and_params
+    j = RequestJournal(jpath)
+    # prompt 14 + budget 4 = 18 CA tokens: fits the dead engine's
+    # max_ca_tokens=24 geometry, NOT this engine's 16
+    j.append("submitted", 999, prompt_len=14, max_new_tokens=4,
+             input_ids=[list(range(14))], rng_seed=7, deadline_s=None)
+    j.append("admitted", 999)
+    spec_ok = _specs(1)[0]
+    j.append("submitted", spec_ok.index, prompt_len=spec_ok.prompt_len,
+             max_new_tokens=spec_ok.max_new_tokens,
+             input_ids=np.asarray(spec_ok.input_ids).tolist(),
+             rng_seed=spec_ok.rng_seed, deadline_s=None)
+    j.append("admitted", spec_ok.index)
+    fe = _engine(model, params)
+    info = fe.recover(j)
+    assert info["shed"] == 1 and info["recovered"] == 1, info
+    fe.pump()
+    books = fe.books()
+    assert books["balanced"] and books["shed"] == 1 and books["ok"] == 1, books
+    jb = j.books()
+    assert jb["balanced"] and jb["outcomes"] == {"shed": 1, "ok": 1}, jb
+    shed_rec = next(r for r in fe.records if r.index == 999)
+    assert shed_rec.outcome == "shed" and shed_rec.shed_reason == "kv_pages_exhausted"
+
+
+def test_prefill_program_cache_is_bounded(model_and_params, monkeypatch):
+    """Review fix: resume replay can hit a distinct (remaining, latents)
+    point per eviction progress mark — the program cache is LRU-bounded so
+    a long-lived engine cannot grow it without limit."""
+    model, params = model_and_params
+    fe = _engine(model, params)
+    monkeypatch.setattr(type(fe), "_PREFILL_CACHE_MAX", 2)
+    fe._prefill_fns.clear()
+    a = fe._prefill_for(2)
+    fe._prefill_for(3)
+    assert fe._prefill_for(2) is a  # hit, LRU-touched to the tail
+    fe._prefill_for(4)  # evicts (3, num_latents) — the least recent
+    assert len(fe._prefill_fns) == 2
+    assert (2, NUM_LATENTS) in fe._prefill_fns and (4, NUM_LATENTS) in fe._prefill_fns
+
+
+def test_recover_span_carries_request_identity(model_and_params, tmp_path):
+    """Review fix: the ``serve.recover`` span of a mid-decode recovered
+    request carries the SAME ``request_id`` its terminal ``request`` row
+    will (the parked slot mints it before the span opens) plus the durable
+    ``request_index`` — a post-mortem joins the recover event to the
+    request's subsequent lifecycle instead of finding two unrelated ids."""
+    import json
+
+    from perceiver_io_tpu.obs.events import EventLog
+
+    model, params = model_and_params
+    jpath = str(tmp_path / "journal.jsonl")
+    specs = _specs(4)
+    fe1 = _engine(model, params, journal=jpath,
+                  injector=FaultInjector().crash_at(1, 1))
+    with pytest.raises(EngineCrash):
+        fe1.run_closed(specs, concurrency=4)
+    run_dir = str(tmp_path / "run")
+    events = EventLog(run_dir, main_process=True)
+    fe2 = _engine(model, params, events=events)
+    fe2.recover(jpath)
+    fe2.pump()
+    events.close()
+    with open(os.path.join(run_dir, "events.jsonl")) as f:
+        rows = [json.loads(l) for l in f if l.strip()]
+    spans = {r["span_id"]: r for r in rows if r.get("event") == "span"}
+    recovers = [r for r in rows if r.get("event") == "serve.recover"
+                and r.get("tokens_resumed", 0) > 0]
+    resumes = {r.get("request_index"): r for r in rows
+               if r.get("event") == "serve.resume"}
+    request_ids = {r.get("request_id") for r in rows if r.get("event") == "request"}
+    assert recovers, "nothing recovered mid-decode — the test is vacuous"
+    for rec_row in recovers:
+        span = spans[rec_row["span_id"]]
+        idx = rec_row["request_index"]
+        assert span["attrs"].get("request_index") == idx, span
+        rid = span["attrs"].get("request_id")
+        # the SAME identity rides the resume segment's span and the
+        # terminal request row — one request_id across the whole lifecycle
+        resume_span = spans[resumes[idx]["span_id"]]
+        assert resume_span["attrs"].get("request_id") == rid, (span, resume_span)
+        assert rid in request_ids, (rid, request_ids)
+
+
+# ------------------------------------------------------------- journal unit
+
+
+def test_journal_replay_books_and_torn_lines(tmp_path):
+    """Replay folds progress records in order, ``pending`` is
+    submitted-minus-terminal, books balance only when every submission
+    terminated, a torn tail is tolerated on read, and a torn MID-file line
+    is an audit problem, not a reader crash (the events.jsonl hygiene)."""
+    jpath = str(tmp_path / "j.jsonl")
+    j = RequestJournal(jpath)
+    j.append("submitted", 0, prompt_len=4, max_new_tokens=3,
+             input_ids=[[1, 2, 3, 4]], rng_seed=7, deadline_s=None)
+    j.append("admitted", 0)
+    j.append("progress", 0, tokens=[5])
+    j.append("progress", 0, tokens=[6, 7])
+    j.append("submitted", 1, prompt_len=4, max_new_tokens=2,
+             input_ids=[[1, 2, 3, 4]], rng_seed=8, deadline_s=1.5)
+    state = j.replay()
+    assert state[0].tokens == [5, 6, 7] and state[1].tokens == []
+    assert [e.index for e in j.pending()] == [0, 1]
+    b = j.books()
+    assert b["submitted"] == 2 and b["terminal"] == 0 and not b["balanced"]
+    assert len(j.audit()) == 2  # two submitted-but-never-terminal problems
+    j.append("terminal", 0, outcome="ok", tokens_out=3)
+    j.append("terminal", 1, outcome="cancelled", tokens_out=0)
+    b = j.books()
+    assert b["balanced"] and b["outcomes"] == {"ok": 1, "cancelled": 1}
+    assert j.audit() == []
+    # the reconstructed spec round-trips the submission verbatim
+    spec = j.replay()[1].spec()
+    assert (spec.index, spec.prompt_len, spec.max_new_tokens, spec.rng_seed) == (1, 4, 2, 8)
+    assert spec.input_ids.tolist() == [[1, 2, 3, 4]]
+    # torn tail (the crash): tolerated by the reader, invisible to books
+    with open(jpath, "a") as f:
+        f.write('{"kind": "progress", "index": 0, "tok')
+    assert j.books()["balanced"]
+    # torn MID-file: still read around, but audit names the line
+    lines = open(jpath).read().splitlines()
+    lines.insert(2, '{"torn mid-file')
+    with open(jpath, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    assert j.books()["balanced"]  # reader survives
+    assert any("unparseable mid-file" in p for p in j.audit())
+
+
+def test_journal_rejects_unknown_kind_and_double_terminal(tmp_path):
+    j = RequestJournal(str(tmp_path / "j2.jsonl"))
+    with pytest.raises(ValueError, match="unknown journal record kind"):
+        j.append("vanished", 0)
+    j.append("submitted", 0, prompt_len=2, max_new_tokens=1,
+             input_ids=[[1, 2]], rng_seed=1, deadline_s=None)
+    j.append("terminal", 0, outcome="ok", tokens_out=1)
+    j.append("terminal", 0, outcome="ok", tokens_out=1)
+    assert any("2 terminal records" in p for p in j.audit())
+    # a terminal with no submission is a books problem too
+    j.append("terminal", 9, outcome="error")
+    assert any("terminal without a submitted record" in p for p in j.audit())
+
+
+# ------------------------------------------------- PageAllocator hardening
+
+
+def test_allocator_double_free_rejected_with_audit_trail():
+    """A double free raises AND leaves an audit entry — never silent
+    free-list corruption: the free list and books are untouched, and a
+    caller that swallowed the exception still can't hide the incident."""
+    a = PageAllocator(num_pages=6, page_size=4)
+    g = a.alloc_tokens(8)
+    a.free(g)
+    free_before = a.pages_free
+    with pytest.raises(ValueError, match="double free"):
+        a.free(g)
+    assert a.pages_free == free_before  # free list NOT corrupted
+    assert any("double free rejected" in p for p in a.audit())
+    # page-ownership invariants still hold alongside the recorded violation
+    assert not any("owned by grants" in p or "leaked" in p for p in a.audit())
+
+
+def test_allocator_drifted_grant_rejected():
+    """A grant handle whose pages disagree with the live books is refused
+    wholesale (the books are authoritative) and recorded."""
+    import dataclasses as _dc
+
+    a = PageAllocator(num_pages=6, page_size=4)
+    g = a.alloc_tokens(8)
+    forged = _dc.replace(g, pages=(4,))
+    with pytest.raises(ValueError, match="drifted"):
+        a.free(forged)
+    assert any("drifted free rejected" in p for p in a.audit())
+    a.free(g)  # the honest handle still frees cleanly
+    assert a.pages_used == 0
+
+
+def test_allocator_audit_positive_and_negative():
+    """audit() is empty for a clean allocator through a full alloc/free
+    cycle, and names each planted corruption class."""
+    a = PageAllocator(num_pages=8, page_size=2)
+    grants = [a.alloc_tokens(3) for _ in range(3)]
+    assert a.audit() == []
+    for g in grants:
+        a.free(g)
+    assert a.audit() == [] and a.pages_used == 0
+    # planted corruption (white-box): one page owned twice
+    b = PageAllocator(num_pages=8, page_size=2)
+    g1, g2 = b.alloc_tokens(2), b.alloc_tokens(2)
+    b._grants[g2.grant_id]["pages"] = list(g1.pages)
+    problems = b.audit()
+    assert any("owned by grants" in p for p in problems)
+    assert any("leaked" in p for p in problems)  # g2's real page now unowned
+
+
+def test_allocator_fragmentation_edge_cases():
+    """Fragmentation accounting at the edges: an exact page-boundary grant
+    has zero slack, n_tokens=0 is a loud error (a zero-page grant would be
+    unfreeable), and a grant over ``num_allocatable`` is ``None`` from an
+    EMPTY pool (can_ever_fit False — the admission shed test)."""
+    a = PageAllocator(num_pages=5, page_size=4)  # 4 allocatable
+    exact = a.alloc_tokens(8)  # exactly 2 pages
+    assert exact.n_pages == 2 and exact.frag_tokens == 0
+    ragged = a.alloc_tokens(5)  # 2 pages, 3 slack
+    assert ragged.n_pages == 2 and ragged.frag_tokens == 3
+    st = a.stats()
+    assert st.internal_frag_tokens == 3 and st.tokens_reserved == 13
+    with pytest.raises(ValueError, match="n_tokens >= 1"):
+        a.alloc_tokens(0)
+    a.free(exact)
+    a.free(ragged)
+    # over the whole pool: never fits, alloc answers None (not an exception)
+    assert not a.can_ever_fit(4 * 4 + 1)
+    assert a.alloc_tokens(4 * 4 + 1) is None
+    assert a.pages_used == 0 and a.audit() == []
+    # exactly the whole pool: fits an empty pool
+    whole = a.alloc_tokens(16)
+    assert whole is not None and whole.n_pages == 4
+    assert not a.can_fit_now(1)
+    a.free(whole)
+
+
+# --------------------------------------------------------------- gauge peak
+
+
+def test_gauge_peak_high_water_mark():
+    """``Gauge.peak`` keeps the max over every write — the between-scrapes
+    spike ``value`` alone cannot answer; None before the first write."""
+    from perceiver_io_tpu.obs.metrics import Gauge
+
+    g = Gauge("depth")
+    assert g.peak is None
+    g.set(2.0)
+    g.set(5.0)
+    g.set(1.0)
+    assert g.value == 1.0 and g.peak == 5.0
+    g.add(7.0)
+    assert g.value == 8.0 and g.peak == 8.0
+    # the measured-window boundary seam: reset_peak restarts the mark at
+    # the CURRENT value (loadgen's warmup churn stops contaminating the
+    # committed parked_depth_peak); a never-written gauge stays peak-less
+    g.set(3.0)
+    g.reset_peak()
+    assert g.peak == 3.0
+    g.set(4.0)
+    assert g.peak == 4.0
+    g2 = Gauge("untouched")
+    g2.reset_peak()
+    assert g2.peak is None
+
+
+# ------------------------------------------------ journal survives frontend
+
+
+def test_frontend_journals_submit_shed_and_terminal(model_and_params, tmp_path):
+    """The write-ahead discipline on the engine front end: submitted lands
+    BEFORE admission (a shed still closes its entry with a terminal
+    record), served requests close through _finish — the journal balances
+    whenever the books do."""
+    from perceiver_io_tpu.obs.loadgen import RequestSpec
+
+    model, params = model_and_params
+    jpath = str(tmp_path / "fe.jsonl")
+    fe = _engine(model, params, journal=jpath)
+    specs = _specs(3)
+    # an impossible request: sheds kv_pages_exhausted at admission
+    rng = np.random.default_rng(3)
+    impossible = RequestSpec(index=99, prompt_len=20, max_new_tokens=16,
+                             input_ids=rng.integers(0, VOCAB, size=(1, 20)),
+                             rng_seed=7)
+    fe.run_closed(list(specs) + [impossible], concurrency=4)
+    j = RequestJournal(jpath)
+    jb = j.books()
+    assert jb["submitted"] == 4 and jb["balanced"], jb
+    assert jb["outcomes"] == {"ok": 3, "shed": 1}, jb
+    assert j.audit() == []
+    shed_row = [r for r in j.rows()
+                if r["kind"] == "terminal" and r["index"] == 99]
+    assert shed_row[0]["shed_reason"] == "kv_pages_exhausted"

@@ -80,9 +80,31 @@ exactly one terminal outcome, zero leaked worker slots):
                               token, span remainder dropped, pages freed,
                               books balanced, acceptance telemetry on every
                               event row, one dump names the dead span.
+- ``serve_evict_storm``     — Evictline: a page pool sized BELOW the live
+                              demand forces real page-pressure evictions;
+                              every fit-able request still reaches ``ok``
+                              (zero ``kv_pages_exhausted`` sheds), resumed
+                              streams are token-exact vs the uninterrupted
+                              sequential reference (greedy AND temperature),
+                              the extended books identity (``submitted ==
+                              terminal + queued + in_flight + parked``)
+                              closes, and every ``serve.evict``/
+                              ``serve.resume`` event is span-attributed.
+- ``serve_crash_recover``   — Evictline: the ENGINE dies mid-decode (an
+                              injected ``EngineCrash`` no accounting seam
+                              catches — the SIGKILL analog); a second
+                              engine recovers every non-terminal request
+                              from the write-ahead journal and serves it
+                              token-exactly; the combined books balance
+                              ACROSS the restart (journal ``submitted ==
+                              terminal``), span-attributed
+                              ``serve.recover`` events name each
+                              re-admission.
 
 ``--scenarios`` accepts fnmatch globs: ``--scenarios 'serve_*'`` runs the
 serving family standalone, ``--scenarios 'elastic_*,preempt'`` composes.
+``--smoke`` shrinks the Evictline scenarios (greedy-only, fewer requests)
+for the ``tasks.py perf`` CI leg; assertions are identical.
 
 Every injection is count-/step-deterministic (no wall-clock, no randomness
 outside seeded generators), so failures reproduce exactly.
@@ -206,17 +228,24 @@ def _events(run_dir, kind):
 
 
 def _assert_span_attributed(run_dir):
-    """Spanline contract (ISSUE 8): every fault.*/resume event in a chaos
-    run must carry a span_id whose span row is in the same stream — an
-    incident nobody can attribute to its step is an incident half-logged."""
+    """Spanline contract (ISSUE 8, extended by Evictline): every
+    fault.*/resume — and every per-request preemption event
+    (``serve.evict``/``serve.resume``/``serve.recover``) — in a chaos run
+    must carry a span_id whose span row is in the same stream: an incident
+    nobody can attribute to its step/request is an incident half-logged.
+    Accepts both layouts (training runs log under ``logs/``, serving
+    scenarios at the run dir root)."""
     path = os.path.join(run_dir, "logs", "events.jsonl")
+    if not os.path.exists(path):
+        path = os.path.join(run_dir, "events.jsonl")
     with open(path) as f:
         rows = [json.loads(l) for l in f if l.strip()]
     span_ids = {r.get("span_id") for r in rows if r.get("event") == "span"}
     audited = [
         r for r in rows
         if r.get("event", "").startswith("fault.")
-        or r.get("event") in ("resume", "resume.reshard", "probe.blast")
+        or r.get("event") in ("resume", "resume.reshard", "probe.blast",
+                              "serve.evict", "serve.resume", "serve.recover")
     ]
     for r in audited:
         assert r.get("span_id") in span_ids, (
@@ -1064,6 +1093,220 @@ def scenario_serve_spec_kill_mid_span(tmp):
     )
 
 
+# ---------------------------------------------------------------------------
+# Evictline scenarios: page-pressure eviction with token-exact resume, and
+# journal-backed engine crash recovery (docs/robustness.md
+# #engine-eviction-and-recovery)
+# ---------------------------------------------------------------------------
+
+# set by --smoke: the Evictline scenarios shrink to their CI-fast shape
+# (greedy-only, fewer requests) with IDENTICAL assertions
+SMOKE = False
+
+
+def _evict_gen_configs():
+    """(tag, GenerationConfig) pairs the Evictline scenarios certify
+    token-exactness under — greedy AND temperature sampling (the rng-chain
+    alignment claim is vacuous under argmax alone); --smoke keeps greedy."""
+    from perceiver_io_tpu.generation import GenerationConfig
+
+    configs = [("greedy", GenerationConfig())]
+    if not SMOKE:
+        configs.append(
+            ("temperature", GenerationConfig(do_sample=True, temperature=0.8, top_k=10))
+        )
+    return configs
+
+
+def _sequential_reference(model, params, spec, base_config):
+    """The uninterrupted stream: the spec decoded alone through the
+    contiguous host-driven pair with its pinned rng chain — what an
+    evicted/recovered request's served tokens must equal exactly."""
+    import dataclasses as _dc
+
+    import jax
+    import jax.numpy as jnp
+
+    from perceiver_io_tpu.generation import make_decode_fns
+
+    cfg = _dc.replace(base_config, max_new_tokens=spec.max_new_tokens)
+    prefill, step = make_decode_fns(model, 4, cfg)
+    tok, state = prefill(
+        params, jnp.asarray(spec.input_ids), None, jax.random.PRNGKey(spec.rng_seed)
+    )
+    out = [int(tok[0])]
+    for _ in range(spec.max_new_tokens - 1):
+        state, tok = step(state)
+        out.append(int(tok[0]))
+    return out
+
+
+def _evict_workload(n):
+    """Mixed-geometry specs under the no-slide eviction bound of the gate
+    model (max_latents 8, num_latents 4 => budgets <= 4)."""
+    from perceiver_io_tpu.obs.loadgen import WorkloadSpec
+
+    return WorkloadSpec(seed=13, prompt_lens=(8, 12), max_new_tokens=(3, 4)).draw(n, 64)
+
+
+def scenario_serve_evict_storm(tmp):
+    """Evictline page-pressure preemption: a pool sized at half the slot
+    demand (pool_headroom 0.5) forces real evictions — yet every fit-able
+    request reaches ``ok`` with ZERO ``kv_pages_exhausted`` sheds (the
+    pre-Evictline behavior this scenario exists to retire), each resumed
+    stream is token-exact vs the uninterrupted sequential reference
+    (greedy and temperature — the rng chain advanced one split per emitted
+    token), the extended books identity closes, pages come back exact, and
+    every ``serve.evict``/``serve.resume`` event resolves to an in-stream
+    span."""
+    from perceiver_io_tpu.serving import EngineConfig, EngineFrontEnd
+
+    model, params = _serving_model()
+    n = 6 if SMOKE else 8
+    for tag, base in _evict_gen_configs():
+        recorder, clock, run_dir = _serve_env(tmp, f"serve_evict_storm_{tag}")
+        fe = EngineFrontEnd(
+            model, params, num_latents=4, base_config=base,
+            engine_config=EngineConfig(slots=4, page_size=8, max_ca_tokens=16,
+                                       max_sa_tokens=8, pool_headroom=0.5,
+                                       eviction=True),
+            events=recorder, clock=clock, sleep=clock.sleep,
+        )
+        specs = _evict_workload(n)
+        recs = fe.run_closed(specs, concurrency=n)
+        books = _audit_serving(fe, run_dir, f"serve_evict_storm_{tag}")
+        # the storm was real: page pressure preempted in-flight work...
+        assert books["evictions"] >= 1 and books["resumes"] >= 1, books
+        assert books["evictions"] == books["resumes"], books
+        # ...and STILL nothing shed and everything served: ok_rate 1.0
+        assert books["ok"] == n and books["shed"] == 0, books
+        assert all(r.outcome == "ok" for r in recs), [vars(r) for r in recs]
+        assert books["parked"] == 0 and books["in_flight"] == 0, books
+        stream = _stream(run_dir)
+        shed_rows = [e for e in stream if e.get("event") == "request"
+                     and e.get("outcome") == "shed"]
+        assert not shed_rows, f"fit-able requests shed under eviction: {shed_rows}"
+        # token-exactness: every served stream equals the uninterrupted
+        # reference — the evicted-and-resumed ones prove the replay seam
+        for spec in specs:
+            want = _sequential_reference(model, params, spec, base)
+            got = fe.served_tokens[spec.index]
+            assert got == want, (
+                f"serve_evict_storm[{tag}] request {spec.index}: "
+                f"engine {got} != sequential {want}"
+            )
+        # page-exact books after the storm
+        assert fe.ca_alloc.pages_used == 0 and fe.sa_alloc.pages_used == 0
+        assert fe.ca_alloc.audit() == [] and fe.sa_alloc.audit() == []
+        evicts = [e for e in stream if e.get("event") == "serve.evict"]
+        resumes = [e for e in stream if e.get("event") == "serve.resume"]
+        assert len(evicts) == books["evictions"], (len(evicts), books["evictions"])
+        assert len(resumes) == books["resumes"], (len(resumes), books["resumes"])
+        assert all(e.get("pages_freed", 0) > 0 for e in evicts), evicts
+        n_attr = _assert_span_attributed(run_dir)
+        # the parked-depth gauge saw the storm (its peak feeds loadgen)
+        assert fe.registry.gauge("serve_parked_depth").peak >= 1
+        print(
+            f"chaos: serve_evict_storm[{tag}] ok — {books['evictions']} "
+            f"evictions / {books['resumes']} resumes under a half-size pool, "
+            f"{n}/{n} served ok (0 sheds), all streams token-exact, "
+            f"{n_attr} evict/resume events span-attributed"
+        )
+
+
+def scenario_serve_crash_recover(tmp):
+    """Evictline crash recovery: the engine is torn down mid-decode by an
+    injected ``EngineCrash`` (a BaseException no accounting seam catches —
+    in-flight slots freeze, no terminal records land, exactly a SIGKILL);
+    a SECOND engine recovers from the write-ahead journal, re-admits every
+    non-terminal request (mid-decode ones parked with their served prefix,
+    unjoined ones re-queued) and serves them token-exactly vs the
+    uninterrupted reference (greedy and temperature). The combined books
+    balance ACROSS the restart — journal ``submitted == terminal`` with
+    every outcome accounted once — and each re-admission lands a
+    span-attributed ``serve.recover`` event."""
+    from perceiver_io_tpu.serving import (
+        EngineConfig,
+        EngineCrash,
+        EngineFrontEnd,
+        FaultInjector,
+        RequestJournal,
+    )
+
+    model, params = _serving_model()
+    n = 4 if SMOKE else 6
+    for tag, base in _evict_gen_configs():
+        recorder, clock, run_dir = _serve_env(tmp, f"serve_crash_recover_{tag}")
+        jpath = os.path.join(run_dir, "journal.jsonl")
+        specs = _evict_workload(n)
+        engine_cfg = EngineConfig(slots=4, page_size=8, max_ca_tokens=16,
+                                  max_sa_tokens=8)
+        injector = FaultInjector(clock=clock).crash_at(2, 1)
+        fe1 = EngineFrontEnd(
+            model, params, num_latents=4, base_config=base,
+            engine_config=engine_cfg, events=recorder, clock=clock,
+            sleep=clock.sleep, injector=injector, journal=jpath,
+        )
+        crashed = False
+        try:
+            fe1.run_closed(specs, concurrency=n)
+        except EngineCrash:
+            crashed = True
+        assert crashed, "injected EngineCrash did not propagate (a seam ate it)"
+        books1 = fe1.books()
+        assert books1["terminal"] < books1["submitted"], (
+            f"crash left nothing owed — the recovery is vacuous: {books1}"
+        )
+        # the second incarnation: fresh engine, same event stream, same
+        # journal file — recover() re-admits everything still owed
+        fe2 = EngineFrontEnd(
+            model, params, num_latents=4, base_config=base,
+            engine_config=engine_cfg, events=recorder, clock=clock,
+            sleep=clock.sleep,
+        )
+        journal = RequestJournal(jpath)
+        owed = len(journal.pending())
+        assert owed == books1["submitted"] - books1["terminal"], (owed, books1)
+        info = fe2.recover(journal)
+        assert info["recovered"] == owed, (info, owed)
+        assert info["parked"] >= 1, (
+            f"no request recovered MID-decode (all prompt-only): {info} — "
+            "the token-exact replay claim is vacuous"
+        )
+        fe2.pump()
+        books2 = _audit_serving(fe2, run_dir, f"serve_crash_recover_{tag}")
+        assert books2["recovered"] == owed and books2["parked"] == 0, books2
+        # combined books balance ACROSS the restart: every submitted index
+        # reached exactly one terminal outcome, in one incarnation or the other
+        jb = journal.books()
+        assert jb["balanced"] and jb["submitted"] == n, jb
+        assert jb["pending"] == 0 and jb["outcomes"] == {"ok": n}, jb
+        assert journal.audit() == [], journal.audit()
+        # token-exact across the restart: served streams (second engine's
+        # replay included) equal the uninterrupted reference
+        served = dict(fe1.served_tokens)
+        served.update(fe2.served_tokens)
+        for spec in specs:
+            want = _sequential_reference(model, params, spec, base)
+            got = served.get(spec.index)
+            assert got == want, (
+                f"serve_crash_recover[{tag}] request {spec.index}: "
+                f"recovered {got} != uninterrupted {want}"
+            )
+        stream = _stream(run_dir)
+        recovers = [e for e in stream if e.get("event") == "serve.recover"]
+        assert len(recovers) == owed, (len(recovers), owed)
+        n_attr = _assert_span_attributed(run_dir)
+        assert fe2.ca_alloc.pages_used == 0 and fe2.sa_alloc.pages_used == 0
+        print(
+            f"chaos: serve_crash_recover[{tag}] ok — engine crashed with "
+            f"{owed} requests owed ({info['parked']} mid-decode), second "
+            f"engine recovered all {owed} from the journal, books balanced "
+            f"across the restart ({n}/{n} ok), streams token-exact, "
+            f"{n_attr} events span-attributed"
+        )
+
+
 SCENARIOS = {
     "preempt": scenario_preempt,
     "preempt_mesh": scenario_preempt_mesh,
@@ -1083,6 +1326,8 @@ SCENARIOS = {
     "serve_engine_kill_mid_decode": scenario_serve_engine_kill_mid_decode,
     "serve_engine_pages": scenario_serve_engine_pages,
     "serve_spec_kill_mid_span": scenario_serve_spec_kill_mid_span,
+    "serve_evict_storm": scenario_serve_evict_storm,
+    "serve_crash_recover": scenario_serve_crash_recover,
 }
 
 
@@ -1128,6 +1373,11 @@ def main(argv=None) -> int:
     )
     parser.add_argument("--tmp", default=None, help="scratch dir (default: mkdtemp)")
     parser.add_argument(
+        "--smoke", action="store_true",
+        help="CI-fast Evictline scenarios (greedy-only, fewer requests; "
+        "same assertions) — the tasks.py perf serve-chaos leg",
+    )
+    parser.add_argument(
         "--phase",
         default=None,
         choices=("kill", "resume"),
@@ -1135,6 +1385,8 @@ def main(argv=None) -> int:
         "respawns each half with its own virtual-device count)",
     )
     args = parser.parse_args(argv)
+    global SMOKE
+    SMOKE = bool(args.smoke)
     # each comma token is a literal name or an fnmatch glob; a token that
     # matches nothing is a usage error (a typo'd selector silently running
     # zero scenarios would read as a green gate)

@@ -306,6 +306,13 @@ def run_engine_gate(args) -> int:
        (``engine_batch_fill_frac`` / ``engine_kv_pages_used``);
     5. the summary diffs clean against itself and the LOAD floors hold —
        including the engine throughput floor and p99-TPOT ceiling.
+
+    The summary (and so the ``load.summary`` event and the LOAD artifact
+    body) carries the Evictline counters — ``evictions`` / ``resumes`` /
+    ``parked_depth_peak`` (the ``serve_parked_depth`` gauge's high-water
+    mark) — as optional validated fields, so eviction behavior lands under
+    the standing comparability-diffed gate
+    (docs/robustness.md#engine-eviction-and-recovery).
     """
     import time as _time
 
@@ -388,6 +395,9 @@ def run_engine_gate(args) -> int:
         # percentiles and engine figures cover only measured traffic
         registry.histogram("generate_tpot_s").reset()
         warm_steps, warm_fill = fe._engine_steps, fe._fill_sum
+        warm_books = fe.books()
+        warm_evictions, warm_resumes = warm_books["evictions"], warm_books["resumes"]
+        registry.gauge("serve_parked_depth").reset_peak()
         with ObsServer(registry=registry, run_dir=out_dir, health=fe.health) as server:
             t0 = _time.perf_counter()
             if args.mode == "open":
@@ -448,6 +458,21 @@ def run_engine_gate(args) -> int:
                 (fe._fill_sum - warm_fill) / (steps * engine_cfg.slots), 6
             ) if steps else 0.0,
         }
+        # Evictline telemetry on the load.summary row AND the LOAD artifact
+        # body — optional validated fields (obs.events._OPTIONAL_FIELD_TYPES:
+        # pre-Evictline artifacts stay valid, a non-numeric regression here
+        # fails validation), so eviction behavior rides the standing
+        # comparability-diffed gate. Zero under the default full-headroom
+        # pool; a committed run with a tight pool records its real churn —
+        # delta-based at the measured-window boundary like decode_steps/
+        # batch_fill above (the odometers are lifetime counters and the
+        # parked-depth peak resets after warmup), so warmup churn never
+        # contaminates the committed figures.
+        fe_books = fe.books()
+        parked_peak = fe.registry.gauge("serve_parked_depth").peak
+        summary["evictions"] = fe_books["evictions"] - warm_evictions
+        summary["resumes"] = fe_books["resumes"] - warm_resumes
+        summary["parked_depth_peak"] = 0 if parked_peak is None else int(parked_peak)
         if events is not None:
             events.emit("load.summary", **summary)
             registry.maybe_emit(events, min_interval_s=0.0)
@@ -509,7 +534,8 @@ def run_engine_gate(args) -> int:
             problems.append("engine request rows missing queue_wait_s")
 
         for key in ("achieved_rps", "throughput_tok_s", "error_rate", "ttft_s",
-                    "queue_wait_s", "tpot_s", "breakdown_ms"):
+                    "queue_wait_s", "tpot_s", "breakdown_ms",
+                    "evictions", "resumes", "parked_depth_peak"):
             if key not in summary:
                 problems.append(f"engine summary missing {key!r}")
 
