@@ -1396,7 +1396,8 @@ def make_instrumented_generate_fn(
     m_kv_frac = registry.gauge("generate_kv_cache_frac") if probes else None
     tracer = obs_trace.Tracer(events, flush_every=64) if events is not None else None
 
-    def fn(params, input_ids, pad_mask=None, rng=None, queue_wait_s=None, arrival_ts=None):
+    def fn(params, input_ids, pad_mask=None, rng=None, queue_wait_s=None, arrival_ts=None,
+           tenant=None):
         b, prompt_len = input_ids.shape
         compiles_before = tracker.total_compiles
         request_id = obs_trace.new_span_id()
@@ -1456,6 +1457,8 @@ def make_instrumented_generate_fn(
                 sp.set("tokens_out", len(toks))
                 if queue_wait_s is not None:
                     sp.set("queue_wait_s", round(queue_wait_s, 6))
+                if tenant is not None:
+                    sp.set("tenant", str(tenant))
         elapsed = time.perf_counter() - t_all0
         decode_s = max(elapsed - ttft, 0.0)
         tokens_out = len(toks)
@@ -1536,6 +1539,10 @@ def make_instrumented_generate_fn(
                 row.pop("queue_wait_s", None)  # no admission accounting upstream
             elif arrival_ts is not None:
                 row["arrival_ts"] = round(float(arrival_ts), 6)
+            if tenant is not None:
+                # multi-tenant identity (Simline, docs/serving.md#multi-
+                # tenant-telemetry): optional validated string field
+                row["tenant"] = str(tenant)
             if hist.n and hist.n < 5:
                 row["tpot_low_n"] = True
             if err is not None:

@@ -368,6 +368,13 @@ _REQUIRED_FIELDS: Dict[str, tuple] = {
     "serve.evict": ("request_index", "tokens_out", "pages_freed"),
     "serve.resume": ("request_index", "tokens_out"),
     "serve.recover": ("request_index", "tokens_resumed"),
+    # Simline (serving/sim.py, docs/observability.md#sim-artifacts): one
+    # summary row per discrete-event simulation run — the SIM_r* artifact
+    # body's load-bearing fields (per-tenant detail rides `tenants`)
+    "sim.summary": (
+        "n_requests", "n_tenants", "offered_rps", "achieved_rps",
+        "fairness_jain", "max_starvation_age_s",
+    ),
 }
 
 # OPTIONAL fields validated WHEN PRESENT (type-checked, never required —
@@ -381,6 +388,10 @@ _OPTIONAL_FIELD_TYPES: Dict[str, Dict[str, tuple]] = {
         "batch_size_at_decode": (int, float),
         "acceptance_rate": (int, float),
         "tokens_per_step": (int, float),
+        # Simline: the submitting tenant's identity (multi-tenant serving;
+        # docs/serving.md#multi-tenant-telemetry) — optional so
+        # single-tenant streams stay valid, a string when present
+        "tenant": (str,),
     },
     # Evictline: the engine leg of tools/loadgen.py stamps its eviction
     # behavior into the load.summary row (and the LOAD_r* artifact body) —
@@ -391,6 +402,10 @@ _OPTIONAL_FIELD_TYPES: Dict[str, Dict[str, tuple]] = {
         "resumes": (int, float),
         "parked_depth_peak": (int, float),
     },
+    # Simline tenant identity on the per-request preemption audit trail
+    "serve.evict": {"tenant": (str,)},
+    "serve.resume": {"tenant": (str,)},
+    "serve.recover": {"tenant": (str,)},
 }
 
 # the closed terminal-outcome vocabulary of `request` rows (the serving
@@ -482,13 +497,15 @@ def validate_events(
             for field, types in _OPTIONAL_FIELD_TYPES.get(kind, {}).items():
                 # bool is an int subclass — "numeric" here means a real
                 # measurement, so True/False fail like any other non-number
+                # (and fail string-typed fields like tenant outright)
                 if field in row and (
                     isinstance(row[field], bool)
                     or not isinstance(row[field], types)
                 ):
+                    want = "numeric" if int in types or float in types else "a string"
                     problems.append(
                         f"{name}:{i + 1} [{kind}]: optional field {field!r} "
-                        f"must be numeric when present, got {row[field]!r}"
+                        f"must be {want} when present, got {row[field]!r}"
                     )
             if kind == "request" and "outcome" in row:
                 # outcome is validated against the CLOSED vocabulary: a

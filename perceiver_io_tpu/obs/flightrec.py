@@ -55,10 +55,24 @@ DEFAULT_CAPACITY = 512
 
 @dataclass
 class SLOBounds:
-    """Declared per-request bounds; ``None`` disables that trigger."""
+    """Declared per-request bounds; ``None`` disables that trigger.
+
+    ``tenants`` maps tenant name → that tenant's own bounds: a
+    tenant-stamped ``request`` row is judged against ITS tenant's bounds
+    (falling back to these defaults for unlisted tenants), so a relaxed
+    batch tenant cannot trip the latency-sensitive tenant's trigger and
+    vice versa (docs/serving.md#multi-tenant-telemetry)."""
 
     ttft_s: Optional[float] = None
     tpot_p99_s: Optional[float] = None
+    tenants: Optional[Dict[str, "SLOBounds"]] = None
+
+    def for_tenant(self, tenant) -> "SLOBounds":
+        """The bounds governing one tenant's rows (self when the row has no
+        tenant or no per-tenant override exists)."""
+        if tenant is None or not self.tenants:
+            return self
+        return self.tenants.get(str(tenant), self)
 
 
 class FlightRecorder:
@@ -153,18 +167,19 @@ class FlightRecorder:
                 # queue-expired) is an incident worth a frozen ring; a
                 # "shed" or "cancelled" outcome is a policy decision, not one
                 return "timeout"
+            bounds = self.slo.for_tenant(row.get("tenant"))
             ttft = row.get("ttft_s")
             if (
-                self.slo.ttft_s is not None
+                bounds.ttft_s is not None
                 and isinstance(ttft, (int, float))
-                and ttft > self.slo.ttft_s
+                and ttft > bounds.ttft_s
             ):
                 return "slo_ttft"
             tpot99 = row.get("tpot_p99_s")
             if (
-                self.slo.tpot_p99_s is not None
+                bounds.tpot_p99_s is not None
                 and isinstance(tpot99, (int, float))
-                and tpot99 > self.slo.tpot_p99_s
+                and tpot99 > bounds.tpot_p99_s
             ):
                 return "slo_tpot"
         elif event == "probe.blast":

@@ -130,13 +130,19 @@ class WorkloadSpec:
 
 @dataclass(frozen=True)
 class RequestSpec:
-    """One drawn request (host-side; ``input_ids`` is a numpy array)."""
+    """One drawn request (host-side; ``input_ids`` is a numpy array).
+    ``tenant`` is the optional multi-tenant identity (Simline,
+    docs/serving.md#multi-tenant-telemetry): the serving front ends thread
+    it onto request events, spans, journal records and the labeled
+    ``serve_*`` metric children; None means single-tenant (everything
+    pre-Simline)."""
 
     index: int
     prompt_len: int
     max_new_tokens: int
     input_ids: object
     rng_seed: int
+    tenant: Optional[str] = None
 
 
 @dataclass

@@ -12,6 +12,15 @@ cheap enough for per-token calls — and the registry exports three ways:
   cumulative ``_bucket{le=...}`` histogram series) for scrape endpoints;
 - per-histogram ``percentile()`` — p50/p99 **from the buckets**, not means.
 
+Every metric type supports **labels** (Simline, docs/observability.md#
+labeled-metrics): ``metric.labels(tenant="a")`` returns a get-or-create
+child of the same type that records independently and exposes as
+``name{tenant="a"}`` series under the parent's family (one ``# TYPE`` line;
+label sets render key-sorted). The parent stays the unlabeled series — the
+serving counters increment BOTH (parent = the all-tenant total), so
+dashboards built on the unlabeled names keep working and the exposition of
+a label-free registry is byte-identical to the pre-label format.
+
 Histograms are log-bucketed: bucket ``i`` covers ``[GROWTH**i, GROWTH**(i+1))``
 with ``GROWTH = 2**0.25`` (~19% wide), so a reported percentile is the bucket's
 geometric midpoint — within ~9% of the true order statistic at any scale from
@@ -84,12 +93,60 @@ def merge_counts(*count_dicts: Dict) -> Dict[int, int]:
     return out
 
 
-class Counter:
+def _label_key(labels: Dict[str, str]) -> tuple:
+    """Canonical child identity: the key-sorted ``(name, value)`` tuple."""
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _escape_label(value: str) -> str:
+    """Prometheus label-value escaping (backslash, quote, newline)."""
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _label_str(key: tuple) -> str:
+    """``tenant="a",zone="b"`` — the rendered (key-sorted) label set."""
+    return ",".join(f'{k}="{_escape_label(v)}"' for k, v in key)
+
+
+class _LabelSupport:
+    """Shared ``labels()`` machinery: get-or-create a CHILD metric of the
+    parent's type, keyed by the sorted label set. Children record
+    independently of the parent (callers that want the unlabeled series to
+    stay the all-label total write both — the serving counters do); they
+    expose under the parent's family as ``name{k="v"}`` series and never
+    have children of their own."""
+
+    def labels(self, **labels):
+        if not labels:
+            raise ValueError("labels() needs at least one label")
+        if self.label_set:
+            raise ValueError(
+                f"metric {self.name!r} is already a labeled child "
+                f"{{{_label_str(self.label_set)}}}; labels() nests one level"
+            )
+        key = _label_key(labels)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = type(self)(self.name, self.help)
+                child.label_set = key
+                self._children[key] = child
+            return child
+
+    def children(self):
+        """``(label_key, child)`` pairs, label-sorted (a locked copy)."""
+        with self._lock:
+            return sorted(self._children.items())
+
+
+class Counter(_LabelSupport):
     """Monotonic counter. ``inc`` is the only mutation."""
 
     def __init__(self, name: str, help: str = ""):
         self.name, self.help = name, help
         self._value = 0.0
+        self._children: Dict[tuple, Counter] = {}
+        self.label_set: tuple = ()
         self._lock = threading.Lock()
 
     def inc(self, n: float = 1.0) -> None:
@@ -103,7 +160,7 @@ class Counter:
         return self._value
 
 
-class Gauge:
+class Gauge(_LabelSupport):
     """Last-write-wins scalar (queue depth, inflight requests, ...).
 
     :attr:`peak` keeps the high-water mark across every write — the
@@ -116,6 +173,8 @@ class Gauge:
         self.name, self.help = name, help
         self._value = 0.0
         self._peak = None
+        self._children: Dict[tuple, Gauge] = {}
+        self.label_set: tuple = ()
         self._lock = threading.Lock()
 
     def set(self, v: float) -> None:
@@ -141,12 +200,16 @@ class Gauge:
         """Restart the high-water mark at the CURRENT value — the
         measured-window boundary seam (tools/loadgen.py resets after its
         warmup leg so the committed peak covers only the measured run).
-        A gauge never written stays peak-less."""
+        A gauge never written stays peak-less. Resets labeled children too
+        (the window boundary applies to the whole family)."""
         with self._lock:
             self._peak = None if self._peak is None else self._value
+            children = list(self._children.values())
+        for child in children:
+            child.reset_peak()
 
 
-class Histogram:
+class Histogram(_LabelSupport):
     """Log-bucketed distribution (see module docstring). Standalone-usable:
     the instrumented generate fn keeps one per request for the TPOT
     percentiles its ``request`` event carries."""
@@ -158,6 +221,8 @@ class Histogram:
         self.sum = 0.0
         self.min: Optional[float] = None
         self.max: Optional[float] = None
+        self._children: Dict[tuple, Histogram] = {}
+        self.label_set: tuple = ()
         self._lock = threading.Lock()
 
     def record(self, value: float) -> None:
@@ -184,13 +249,17 @@ class Histogram:
         the latency histograms at the measured-window boundary, so committed
         percentiles cover only measured traffic. Exposition scrapes handle
         the count going backwards the way Prometheus clients handle any
-        counter reset; call it between windows, not mid-scrape-storm."""
+        counter reset; call it between windows, not mid-scrape-storm.
+        Resets labeled children too (the window covers the family)."""
         with self._lock:
             self.counts = {}
             self.n = 0
             self.sum = 0.0
             self.min = None
             self.max = None
+            children = list(self._children.values())
+        for child in children:
+            child.reset()
 
     def percentile(self, p: float) -> Optional[float]:
         """Bucket-midpoint percentile, clamped into the observed [min, max]
@@ -264,17 +333,28 @@ class MetricsRegistry:
         return len(self._metrics)
 
     def snapshot(self) -> Dict:
-        """JSON-ready state of every metric — the ``metrics`` event body."""
-        out: Dict = {"counters": {}, "gauges": {}, "histograms": {}}
+        """JSON-ready state of every metric — the ``metrics`` event body.
+        Labeled children ride as additional entries keyed by the rendered
+        series name (``serve_submitted{tenant="a"}``), so a ``metrics``
+        event row carries per-tenant series with zero schema change."""
+        out: Dict = {"counters": {}, "gauges": {}, "histograms": {}, "gauge_peaks": {}}
         with self._lock:
             items = list(self._metrics.items())
         for name, m in items:
-            if isinstance(m, Counter):
-                out["counters"][name] = m.value
-            elif isinstance(m, Gauge):
-                out["gauges"][name] = m.value
-            elif isinstance(m, Histogram):
-                out["histograms"][name] = m.to_dict()
+            for key, metric in [((), m)] + m.children():
+                sname = f"{name}{{{_label_str(key)}}}" if key else name
+                if isinstance(m, Counter):
+                    out["counters"][sname] = metric.value
+                elif isinstance(m, Gauge):
+                    out["gauges"][sname] = metric.value
+                    # the high-water mark rides along: a depth spike between
+                    # snapshots is invisible in `value`, and a post-hoc
+                    # consumer (obs_report's per-tenant table) cannot reach
+                    # the in-process Gauge.peak
+                    if metric.peak is not None:
+                        out["gauge_peaks"][sname] = metric.peak
+                elif isinstance(m, Histogram):
+                    out["histograms"][sname] = metric.to_dict()
         return out
 
     def emit_snapshot(self, events) -> None:
@@ -296,7 +376,11 @@ class MetricsRegistry:
 
     def to_prometheus(self) -> str:
         """Prometheus text exposition of the registry (counters/gauges as-is,
-        histograms as cumulative ``_bucket{le="..."}`` series + _sum/_count)."""
+        histograms as cumulative ``_bucket{le="..."}`` series + _sum/_count).
+        Labeled children render inside the parent's family — one ``# TYPE``
+        line, the unlabeled series first, then each child's series with its
+        key-sorted label set — so a label-free registry's exposition is
+        byte-identical to the pre-label format."""
         lines = []
         with self._lock:
             items = sorted(self._metrics.items())
@@ -306,24 +390,30 @@ class MetricsRegistry:
                 lines.append(f"# HELP {pname} {m.help}")
             if isinstance(m, Counter):
                 lines.append(f"# TYPE {pname} counter")
-                lines.append(f"{pname} {m.value:g}")
             elif isinstance(m, Gauge):
                 lines.append(f"# TYPE {pname} gauge")
-                lines.append(f"{pname} {m.value:g}")
             elif isinstance(m, Histogram):
                 lines.append(f"# TYPE {pname} histogram")
+            for key, metric in [((), m)] + m.children():
+                ls = _label_str(key)
+                if isinstance(m, (Counter, Gauge)):
+                    series = f"{pname}{{{ls}}}" if ls else pname
+                    lines.append(f"{series} {metric.value:g}")
+                    continue
                 # consistent locked snapshot: a scrape thread must never
                 # iterate counts while the serving thread inserts a bucket
                 # (dict-changed-size), nor expose cumulative > _count
-                counts, n, total, _, _ = m.state()
+                counts, n, total, _, _ = metric.state()
+                prefix = f"{ls}," if ls else ""
+                suffix = f"{{{ls}}}" if ls else ""
                 cum = 0
                 for idx in sorted(counts):
                     cum += counts[idx]
                     le = bucket_bounds(idx)[1]
-                    lines.append(f'{pname}_bucket{{le="{le:g}"}} {cum}')
-                lines.append(f'{pname}_bucket{{le="+Inf"}} {n}')
-                lines.append(f"{pname}_sum {total:g}")
-                lines.append(f"{pname}_count {n}")
+                    lines.append(f'{pname}_bucket{{{prefix}le="{le:g}"}} {cum}')
+                lines.append(f'{pname}_bucket{{{prefix}le="+Inf"}} {n}')
+                lines.append(f"{pname}_sum{suffix} {total:g}")
+                lines.append(f"{pname}_count{suffix} {n}")
         return "\n".join(lines) + ("\n" if lines else "")
 
 

@@ -15,7 +15,11 @@ zero new dependencies — the same constraint as every obs consumer):
 - ``GET /slo`` — ``obs.slo.build_slo_report`` over the run directory's
   live event stream: the per-request TTFT/TPOT/queue-wait aggregate as of
   *now*, which is what an SLO dashboard or the multi-tenant road's
-  per-tenant gate polls. The stream is ingested **incrementally** — the
+  per-tenant gate polls; ``GET /slo?tenant=acme`` narrows the report to
+  one tenant's tenant-stamped rows (an unknown query parameter is a 400 —
+  the endpoint takes real parameters, so it parses them; an unknown
+  tenant is an empty report, not an error). The stream is ingested
+  **incrementally** — the
   server remembers each shard's byte offset and parses only appended
   complete lines per scrape (events.jsonl is append-only; a shrunken shard
   resets the cache), so a 15s poll against a million-request run costs the
@@ -124,7 +128,11 @@ class ObsServer:
     # -- routing ------------------------------------------------------------
 
     def _handle(self, req: BaseHTTPRequestHandler) -> None:
-        path = req.path.split("?", 1)[0].rstrip("/") or "/"
+        from urllib.parse import parse_qs, urlparse
+
+        parsed = urlparse(req.path)
+        path = parsed.path.rstrip("/") or "/"
+        query = parse_qs(parsed.query, keep_blank_values=True)
         try:
             if path == "/metrics":
                 body = self.registry.to_prometheus().encode()
@@ -145,7 +153,18 @@ class ObsServer:
                         body["health_error"] = repr(e)
                 self._json(req, 200, body)
             elif path == "/slo":
-                self._json(req, *self._slo())
+                # /slo takes real parameters, so its query string is PARSED,
+                # not ignored: an unknown parameter is a caller bug (400),
+                # never silently the unfiltered report
+                unknown = sorted(k for k in query if k != "tenant")
+                if unknown:
+                    self._json(req, 400, {
+                        "error": f"unknown query parameter(s) {unknown}",
+                        "params": ["tenant"],
+                    })
+                else:
+                    tenant = query["tenant"][-1] if "tenant" in query else None
+                    self._json(req, *self._slo(tenant=tenant))
             else:
                 self._json(req, 404, {"error": f"unknown path {path!r}",
                                       "paths": ["/metrics", "/healthz", "/slo"]})
@@ -155,16 +174,25 @@ class ObsServer:
             except OSError:
                 pass  # client went away mid-error; nothing to do
 
-    def _slo(self):
+    def _slo(self, tenant: Optional[str] = None):
         if self.run_dir is None:
             return 404, {"error": "no run_dir configured for /slo"}
         from perceiver_io_tpu.obs.slo import build_slo_report
 
         with self._slo_lock:
             self._ingest_request_rows()
-            report = build_slo_report(self._slo_requests)
+            rows = self._slo_requests
+            if tenant is not None:
+                rows = [r for r in rows if r.get("tenant") == tenant]
+            report = build_slo_report(rows)
         if report is None:
-            return 200, {"n_requests": 0, "note": "no request events yet"}
+            body = {"n_requests": 0, "note": "no request events yet"}
+            if tenant is not None:
+                body["tenant"] = tenant
+                body["note"] = f"no request events for tenant {tenant!r}"
+            return 200, body
+        if tenant is not None:
+            report["tenant"] = tenant
         return 200, report
 
     def _ingest_request_rows(self) -> None:

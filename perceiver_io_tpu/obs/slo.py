@@ -36,9 +36,13 @@ def iter_requests(events: List[Dict]) -> List[Dict]:
     return [e for e in events if e.get("event") == "request"]
 
 
-def build_slo_report(events: List[Dict]) -> Optional[Dict]:
+def build_slo_report(events: List[Dict], by_tenant: bool = False) -> Optional[Dict]:
     """The SLO aggregate of one run's event stream (None when the run made
-    no requests)."""
+    no requests). With ``by_tenant=True`` and any tenant-stamped ``request``
+    rows present, the report gains ``tenants``: one full sub-report per
+    tenant over that tenant's rows only (same shape, same warm-only
+    convention), the surface ``/slo?tenant=`` and the per-tenant isolation
+    scenarios read."""
     from perceiver_io_tpu.obs.metrics import merge_counts, percentile_from_counts
     from perceiver_io_tpu.utils.profiling import summarize_latencies
 
@@ -107,6 +111,15 @@ def build_slo_report(events: List[Dict]) -> Optional[Dict]:
             report["queue_wait_s"] = {
                 k: round(v, 6) if isinstance(v, float) else v
                 for k, v in summarize_latencies(qws).items()
+            }
+    if by_tenant:
+        tenants = sorted(
+            {str(r["tenant"]) for r in requests if r.get("tenant") is not None}
+        )
+        if tenants:
+            report["tenants"] = {
+                t: build_slo_report([r for r in requests if r.get("tenant") == t])
+                for t in tenants
             }
     return report
 

@@ -246,7 +246,29 @@ class EngineFrontEnd(RequestFrontEnd):
             # inputs the graduation ledger and docs/performance.md cite
             self._m_accept = r.histogram("spec_acceptance_rate")
             self._m_tps = r.histogram("spec_tokens_per_step")
+        # per-tenant pages held (feeds engine_kv_pages_used{tenant=...})
+        self._tenant_pages: Dict[str, int] = {}
         self._admission_checks.append(self._page_fit_check)
+
+    # -- the service clock (Simline's virtual-time seam) ---------------------
+
+    def _now_s(self) -> float:
+        """The clock service timing reads (ttft, step dt, service_s). The
+        REAL engine times actual compute, so this is wall perf_counter even
+        under an injected ManualClock (which does not advance during
+        compiled steps); the discrete-event simulation overrides it to the
+        injected virtual clock so sampled service times ARE the timeline."""
+        return time.perf_counter()
+
+    def _tenant_pages_delta(self, rec, n_pages: int) -> None:
+        """Track pages held per tenant; mirrors every grant/free so the
+        labeled ``engine_kv_pages_used{tenant=...}`` gauge (and its .peak)
+        follows each tenant's live KV footprint."""
+        if rec.tenant is None:
+            return
+        cur = self._tenant_pages.get(rec.tenant, 0) + n_pages
+        self._tenant_pages[rec.tenant] = cur
+        self._m_pages.labels(tenant=rec.tenant).set(cur)
 
     # -- admission -----------------------------------------------------------
 
@@ -341,16 +363,20 @@ class EngineFrontEnd(RequestFrontEnd):
         self._m_queue_wait.record(rec.queue_wait_s)
         slot = _EngineSlot(ticket=ticket, slot_id=slot_id,
                            ca_grant=ca_grant, sa_grant=sa_grant)
+        slot.t_joined = self._now_s()
+        self._tenant_pages_delta(rec, ca_grant.n_pages + sa_grant.n_pages)
         if self.events is not None and self._tracer is not None:
             # DETACHED span (no contextvar nesting): slot lifetimes overlap
             # and close out of LIFO order, which the nested span stack
             # cannot express — the span row is recorded at retire
             from perceiver_io_tpu.obs.trace import Span
 
-            slot.span = Span(name="request", parent_id=None,
-                             attrs={"request_id": slot.request_id})
+            attrs = {"request_id": slot.request_id}
+            if rec.tenant is not None:
+                attrs["tenant"] = rec.tenant
+            slot.span = Span(name="request", parent_id=None, attrs=attrs)
         compiles0 = self._tracker.total_compiles
-        t0 = time.perf_counter()
+        t0 = self._now_s()
         try:
             if self._injector is not None:
                 self._injector.before_attempt(rec.index)
@@ -370,11 +396,12 @@ class EngineFrontEnd(RequestFrontEnd):
         except Exception as e:  # noqa: BLE001 — books close, pages return
             self.ca_alloc.free(ca_grant)
             self.sa_alloc.free(sa_grant)
+            self._tenant_pages_delta(rec, -(ca_grant.n_pages + sa_grant.n_pages))
             rec.error = repr(e)
             rec.attempts += 1
             self._retire_books(slot, "error", emit=True)
             return True  # the ticket reached a terminal outcome
-        slot.ttft_s = time.perf_counter() - t0
+        slot.ttft_s = self._now_s() - t0
         rec.attempts += 1
         slot.compiled = self._tracker.total_compiles > compiles0
         slot.tokens_out = 1
@@ -427,7 +454,7 @@ class EngineFrontEnd(RequestFrontEnd):
         rec.tokens_out = slot.tokens_out
         rec.compiled = slot.compiled
         rec.decode_s = round(sum(slot.step_times), 6)
-        rec.service_s = round(time.perf_counter() - slot.t_joined, 6)
+        rec.service_s = round(self._now_s() - slot.t_joined, 6)
         self._finish(slot.ticket, outcome)
         # speculative quality accounting (the measurement half of the
         # graduation story): raw drafter acceptance over the slot's verify
@@ -459,6 +486,8 @@ class EngineFrontEnd(RequestFrontEnd):
                 decode_s=round(sum(slot.step_times), 6),
                 tpot_hist=dict(sorted((str(k), v) for k, v in slot.hist.counts.items())),
             )
+            if rec.tenant is not None:
+                row["tenant"] = rec.tenant
             if slot.batch_sizes:
                 row["batch_size_at_decode"] = round(
                     sum(slot.batch_sizes) / len(slot.batch_sizes), 3
@@ -489,6 +518,8 @@ class EngineFrontEnd(RequestFrontEnd):
         self._in_flight -= 1
         self.ca_alloc.free(slot.ca_grant)
         self.sa_alloc.free(slot.sa_grant)
+        self._tenant_pages_delta(slot.ticket.record,
+                                 -(slot.ca_grant.n_pages + slot.sa_grant.n_pages))
         self._state = self._retire_fn(self._state, self._jnp.int32(slot_id))
         self._retire_books(slot, outcome, emit=True)
         self._busy_until = float(self._clock())
@@ -521,6 +552,7 @@ class EngineFrontEnd(RequestFrontEnd):
         pages_freed = slot.ca_grant.n_pages + slot.sa_grant.n_pages
         self.ca_alloc.free(slot.ca_grant)
         self.sa_alloc.free(slot.sa_grant)
+        self._tenant_pages_delta(slot.ticket.record, -pages_freed)
         slot.ca_grant = slot.sa_grant = None
         self._state = self._retire_fn(self._state, self._jnp.int32(slot_id))
         slot.slot_id = -1
@@ -546,6 +578,8 @@ class EngineFrontEnd(RequestFrontEnd):
         if self.events is not None:
             row = dict(request_index=rec.index, tokens_out=slot.tokens_out,
                        pages_freed=pages_freed)
+            if rec.tenant is not None:
+                row["tenant"] = rec.tenant
             if span_id is not None:
                 row["span_id"] = span_id
             self.events.emit("serve.evict", **row)
@@ -609,6 +643,7 @@ class EngineFrontEnd(RequestFrontEnd):
             self.ca_alloc.free(ca_grant)
             return False
         slot.ca_grant, slot.sa_grant = ca_grant, sa_grant
+        self._tenant_pages_delta(rec, ca_grant.n_pages + sa_grant.n_pages)
         emitted = self.served_tokens[idx]
         replay_ids = np.concatenate(
             [np.asarray(slot.ticket.spec.input_ids, np.int32),
@@ -618,8 +653,10 @@ class EngineFrontEnd(RequestFrontEnd):
         if self.events is not None and self._tracer is not None:
             from perceiver_io_tpu.obs.trace import Span
 
-            slot.span = Span(name="request", parent_id=None,
-                             attrs={"request_id": slot.request_id})
+            attrs = {"request_id": slot.request_id}
+            if rec.tenant is not None:
+                attrs["tenant"] = rec.tenant
+            slot.span = Span(name="request", parent_id=None, attrs=attrs)
         compiles0 = self._tracker.total_compiles
         try:
             if self._injector is not None:
@@ -638,6 +675,7 @@ class EngineFrontEnd(RequestFrontEnd):
         except Exception as e:  # noqa: BLE001 — books close, pages return
             self.ca_alloc.free(ca_grant)
             self.sa_alloc.free(sa_grant)
+            self._tenant_pages_delta(rec, -(ca_grant.n_pages + sa_grant.n_pages))
             slot.ca_grant = slot.sa_grant = None
             rec.error = repr(e)
             rec.attempts += 1
@@ -666,6 +704,8 @@ class EngineFrontEnd(RequestFrontEnd):
             self.journal.append("progress", idx, tokens=[first])
         if self.events is not None:
             row = dict(request_index=idx, tokens_out=n)
+            if rec.tenant is not None:
+                row["tenant"] = rec.tenant
             if slot.span is not None:
                 row["span_id"] = slot.span.span_id
             self.events.emit("serve.resume", **row)
@@ -759,11 +799,14 @@ class EngineFrontEnd(RequestFrontEnd):
                 prompt_len=int(entry.prompt_len),
                 max_new_tokens=int(entry.max_new_tokens),
                 batch=1,
+                tenant=entry.tenant,
             )
             rec.queue_wait_s = 0.0
             self.records.append(rec)
             self._n["submitted"] += 1
             self._m_submitted.inc()
+            if rec.tenant is not None:
+                self._m_submitted.labels(tenant=rec.tenant).inc()
             verdict = self._page_fit_check(spec, None)
             if verdict is not None:
                 # the dead engine admitted this, but THIS engine's geometry
@@ -775,6 +818,8 @@ class EngineFrontEnd(RequestFrontEnd):
                 rec.outcome, rec.shed_reason = "shed", reason
                 self._n["shed"] += 1
                 self._m_shed.inc()
+                if rec.tenant is not None:
+                    self._m_shed.labels(tenant=rec.tenant).inc()
                 journal.append("terminal", entry.index, outcome="shed",
                                shed_reason=reason)
                 self._emit_frontend_request(rec, shed_reason=reason,
@@ -784,6 +829,8 @@ class EngineFrontEnd(RequestFrontEnd):
                 continue
             self._n["admitted"] += 1
             self._m_admitted.inc()
+            if rec.tenant is not None:
+                self._m_admitted.labels(tenant=rec.tenant).inc()
             ticket = _Ticket(
                 spec=spec, record=rec, arrival_s=now,
                 deadline_at=(
@@ -796,6 +843,7 @@ class EngineFrontEnd(RequestFrontEnd):
             if tokens:
                 slot = _EngineSlot(ticket=ticket, slot_id=-1,
                                    ca_grant=None, sa_grant=None)
+                slot.t_joined = self._now_s()
                 slot.tokens_out = len(tokens)
                 self.served_tokens[entry.index] = tokens
             self._n_recovered += 1
@@ -803,6 +851,8 @@ class EngineFrontEnd(RequestFrontEnd):
             journal.append("recovered", entry.index, tokens_resumed=len(tokens))
             if self.events is not None:
                 row = dict(request_index=entry.index, tokens_resumed=len(tokens))
+                if entry.tenant is not None:
+                    row["tenant"] = entry.tenant
                 if self._tracer is not None:
                     # the recover span carries the SAME request_id the
                     # request's later resume span / terminal row will (the
@@ -925,7 +975,7 @@ class EngineFrontEnd(RequestFrontEnd):
         if not active:
             return
         compiles0 = self._tracker.total_compiles
-        t0 = time.perf_counter()
+        t0 = self._now_s()
         if self._spec:
             self._state, tokens, m = self._step_fn(self._decode_params, self._state)
             tokens, m = np.asarray(tokens), np.asarray(m)
@@ -933,7 +983,7 @@ class EngineFrontEnd(RequestFrontEnd):
             self._state, tokens = self._step_fn(self._decode_params, self._state)
             tokens = np.asarray(tokens)[:, None]  # ONE host fetch either way
             m = np.ones(len(self._slots), np.int64)
-        dt = time.perf_counter() - t0
+        dt = self._now_s() - t0
         self._engine_steps += 1
         self._fill_sum += len(active)
         cold_step = self._tracker.total_compiles > compiles0

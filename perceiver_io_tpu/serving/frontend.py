@@ -134,6 +134,7 @@ class FrontEndRecord:
     prompt_len: int
     max_new_tokens: int
     batch: int
+    tenant: Optional[str] = None  # multi-tenant identity (None = single-tenant)
     outcome: Optional[str] = None  # one of TERMINAL_OUTCOMES once terminal
     shed_reason: Optional[str] = None
     queue_wait_s: Optional[float] = None
@@ -337,29 +338,37 @@ class RequestFrontEnd:
         deadline_s = (
             self.config.default_deadline_s if deadline_s is None else deadline_s
         )
+        tenant = getattr(spec, "tenant", None)
         rec = FrontEndRecord(
             index=int(spec.index),
             prompt_len=int(spec.prompt_len),
             max_new_tokens=int(spec.max_new_tokens),
             batch=int(getattr(spec.input_ids, "shape", (1,))[0]),
+            tenant=None if tenant is None else str(tenant),
         )
         self.records.append(rec)
         self._n["submitted"] += 1
         self._m_submitted.inc()
+        if rec.tenant is not None:
+            # per-tenant child series under the same family — the unlabeled
+            # parent above stays the all-tenant total
+            self._m_submitted.labels(tenant=rec.tenant).inc()
         if self.journal is not None:
             # WRITE-AHEAD, before any admission verdict: the full request
             # identity, so a fresh engine can reconstruct the spec verbatim
             # (serving.journal — a shed below still writes its terminal row)
             import numpy as _np
 
-            self.journal.append(
-                "submitted", rec.index,
+            jfields = dict(
                 prompt_len=rec.prompt_len,
                 max_new_tokens=rec.max_new_tokens,
                 input_ids=_np.asarray(spec.input_ids).tolist(),
                 rng_seed=int(spec.rng_seed),
                 deadline_s=None if deadline_s is None else float(deadline_s),
             )
+            if rec.tenant is not None:
+                jfields["tenant"] = rec.tenant
+            self.journal.append("submitted", rec.index, **jfields)
         reason, detail = None, {}
         if self._draining:
             reason = "draining"
@@ -391,6 +400,8 @@ class RequestFrontEnd:
             rec.outcome, rec.shed_reason = "shed", reason
             self._n["shed"] += 1
             self._m_shed.inc()
+            if rec.tenant is not None:
+                self._m_shed.labels(tenant=rec.tenant).inc()
             if self.journal is not None:
                 # sheds close their journal entry here (they never reach
                 # _finish): the write-ahead submitted row above must not
@@ -403,6 +414,8 @@ class RequestFrontEnd:
         rec.probe = probe
         self._n["admitted"] += 1
         self._m_admitted.inc()
+        if rec.tenant is not None:
+            self._m_admitted.labels(tenant=rec.tenant).inc()
         if self.journal is not None:
             self.journal.append("admitted", rec.index)
         self._queue.append(_Ticket(
@@ -515,7 +528,7 @@ class RequestFrontEnd:
                 self._injector.before_attempt(rec.index)
             try:
                 out = fn(serve_params, input_ids, None, rng,
-                         queue_wait_s=rec.queue_wait_s)
+                         queue_wait_s=rec.queue_wait_s, tenant=rec.tenant)
             except GenerationAborted:
                 raise
             except Exception as e:
@@ -628,6 +641,8 @@ class RequestFrontEnd:
         if self._tracer is not None:
             with self._tracer.span("request", request_id=request_id) as sp:
                 sp.set("outcome", rec.outcome)
+                if rec.tenant is not None:
+                    sp.set("tenant", rec.tenant)
             self._tracer.flush()  # span row lands BEFORE the request row
             span_id = sp.span_id
         row = dict(
@@ -640,6 +655,8 @@ class RequestFrontEnd:
             outcome=rec.outcome,
             **extra,
         )
+        if rec.tenant is not None:
+            row["tenant"] = rec.tenant
         if span_id is not None:
             row["span_id"] = span_id
         self.events.emit("request", **row)

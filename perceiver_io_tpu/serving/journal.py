@@ -60,8 +60,8 @@ class JournalEntry:
 
     __slots__ = (
         "index", "prompt_len", "max_new_tokens", "input_ids", "rng_seed",
-        "deadline_s", "admitted", "tokens", "terminal", "evictions",
-        "recovered",
+        "deadline_s", "tenant", "admitted", "tokens", "terminal",
+        "evictions", "recovered",
     )
 
     def __init__(self, index: int):
@@ -71,6 +71,7 @@ class JournalEntry:
         self.input_ids: Optional[list] = None
         self.rng_seed: Optional[int] = None
         self.deadline_s: Optional[float] = None
+        self.tenant: Optional[str] = None
         self.admitted = False
         self.tokens: List[int] = []
         self.terminal: Optional[str] = None
@@ -89,6 +90,7 @@ class JournalEntry:
             max_new_tokens=int(self.max_new_tokens),
             input_ids=np.asarray(self.input_ids, np.int32),
             rng_seed=int(self.rng_seed),
+            tenant=self.tenant,
         )
 
 
@@ -186,6 +188,7 @@ class RequestJournal:
                 entry.input_ids = row.get("input_ids")
                 entry.rng_seed = row.get("rng_seed")
                 entry.deadline_s = row.get("deadline_s")
+                entry.tenant = row.get("tenant")
             elif kind == "admitted":
                 entry.admitted = True
             elif kind == "progress":

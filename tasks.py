@@ -13,6 +13,7 @@ equivalent with the same task names:
     python tasks.py perf [...]         # perf CI: graphcheck contracts + graphlint + bench floors + obs gate
     python tasks.py obs [...]          # observability gate (spans/requests/SLO + obs_diff self-check)
     python tasks.py load [...]         # serving load gate (closed-loop loadgen + flight recorder + /metrics)
+    python tasks.py sim [...]          # discrete-event scale gate (multi-tenant sim of the real engine)
     python tasks.py dryrun [...]       # 8-virtual-device multichip certification
     python tasks.py chaos [...]        # fault-injection gate (preempt/NaN/torn-save/elastic resume/serving)
 """
@@ -188,6 +189,21 @@ def load(args):
 
 
 @task
+def sim(args):
+    """Discrete-event scale gate (tools/sim.py; docs/serving.md#
+    multi-tenant-telemetry): drives a seeded multi-tenant workload through
+    the REAL engine front end — admission, page allocator, Evictline,
+    breaker, books — under a ManualClock with service times sampled from
+    the committed LOAD artifact, at thousands of simulated req/s in
+    seconds of host time. Asserts books balanced + allocator audits clean,
+    per-tenant /metrics series and /slo?tenant= live, Jain's-fairness /
+    starvation SIM floors, and a run-vs-itself diff_sim clean. Extra args
+    pass through (e.g. ``--smoke``, ``--write-artifact``,
+    ``--diff OLD NEW``)."""
+    run(sys.executable, "tools/sim.py", *args.rest)
+
+
+@task
 def perf(args):
     """The standing perf-CI gate (docs/static-analysis.md): graphcheck —
     compiled-graph contracts vs contracts/, graduation-ledger validation,
@@ -207,7 +223,10 @@ def perf(args):
     serve_kill_mid_decode,serve_crash_recover --smoke``: a mid-decode kill
     through the hardened front end with the clean-books audit, plus an
     engine crash recovered token-exactly from the write-ahead journal with
-    books balanced across the restart). Extra args go to
+    books balanced across the restart), and the simulation smoke
+    (``tools/sim.py --smoke``: the Simline multi-tenant discrete-event
+    gate over the real engine control plane — fairness + books + SIM
+    floors + per-tenant scrape surface). Extra args go to
     tools/graphcheck.py (e.g. ``--programs train_flat,decode``)."""
     run(sys.executable, "tools/graphcheck.py", *args.rest)
     run(sys.executable, "tools/graphlint.py", "--fail-on", "error")
@@ -240,6 +259,11 @@ def perf(args):
     # family incl. serve_evict_storm runs under `tasks.py chaos`)
     run(sys.executable, "tools/chaos.py", "--scenarios",
         "serve_kill_mid_decode,serve_crash_recover", "--smoke")
+    # simulation smoke leg (Simline): two tenants at ~1k simulated req/s
+    # through the REAL engine front end under a ManualClock — books +
+    # fairness + per-tenant /metrics///slo + self-diff, SIM ledger floors
+    # (the full-size 3-tenant 10k req/s run is `tasks.py sim`)
+    run(sys.executable, "tools/sim.py", "--smoke")
 
 
 def main(argv=None):

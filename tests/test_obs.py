@@ -1143,3 +1143,77 @@ def test_validate_events_unknown_kinds_warn_forward_compatibly(tmp_path):
     problems = validate_events(d)
     assert any("[probe]" in p and "step" in p for p in problems)
     assert any("[probe.blast]" in p and "scope" in p for p in problems)
+
+
+def test_prometheus_exposition_golden_labeled():
+    """ISSUE 16 satellite: labeled children (Simline per-tenant series)
+    render INSIDE the parent's family — one # TYPE line, the unlabeled
+    series first (the all-label total), then each child with its
+    key-sorted, value-escaped label set — pinned byte-for-byte. The
+    unlabeled golden above passing unchanged is the other half of the
+    contract: a label-free registry's exposition is byte-identical to the
+    pre-label format."""
+    from perceiver_io_tpu.obs.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    c = reg.counter("serve_reqs")
+    c.inc(2)                            # the all-tenant total
+    c.labels(tenant="acme").inc(1)
+    c.labels(tenant='b"corp').inc(1)    # quote must escape in the value
+    reg.gauge("depth").labels(tenant="acme").set(4)
+    h = reg.histogram("lat_s")
+    h.record(1.0)                       # bucket le = 2**0.25
+    h.labels(tenant="acme").record(2.0)  # bucket le = 2**1.25
+    assert reg.to_prometheus() == (
+        "# TYPE depth gauge\n"
+        "depth 0\n"
+        'depth{tenant="acme"} 4\n'
+        "# TYPE lat_s histogram\n"
+        'lat_s_bucket{le="1.18921"} 1\n'
+        'lat_s_bucket{le="+Inf"} 1\n'
+        "lat_s_sum 1\n"
+        "lat_s_count 1\n"
+        'lat_s_bucket{tenant="acme",le="2.37841"} 1\n'
+        'lat_s_bucket{tenant="acme",le="+Inf"} 1\n'
+        'lat_s_sum{tenant="acme"} 2\n'
+        'lat_s_count{tenant="acme"} 1\n'
+        "# TYPE serve_reqs counter\n"
+        "serve_reqs 2\n"
+        'serve_reqs{tenant="acme"} 1\n'
+        'serve_reqs{tenant="b\\"corp"} 1\n'
+    )
+
+
+def test_labeled_metrics_children_semantics_and_snapshot(tmp_path):
+    """ISSUE 16 satellite: labels() is get-or-create on the sorted label
+    set, children record independently of the parent, nesting is refused,
+    and the metrics-event snapshot carries labeled series (plus gauge
+    high-water marks in gauge_peaks) under rendered series names."""
+    from perceiver_io_tpu.obs.events import EventLog, validate_events
+    from perceiver_io_tpu.obs.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    c = reg.counter("reqs")
+    assert c.labels(tenant="a") is c.labels(tenant="a")  # get-or-create
+    assert c.labels(tenant="a") is not c.labels(tenant="b")
+    c.labels(tenant="a").inc(3)
+    assert c.value == 0  # children never write the parent implicitly
+    with pytest.raises(ValueError):
+        c.labels(tenant="a").labels(zone="z")  # one level only
+    with pytest.raises(ValueError):
+        c.labels()
+    g = reg.gauge("pages")
+    g.labels(tenant="a").set(7)
+    g.labels(tenant="a").set(2)
+    assert g.labels(tenant="a").peak == 7  # high-water mark survives the drop
+    snap = reg.snapshot()
+    assert snap["counters"]['reqs{tenant="a"}'] == 3
+    assert snap["gauges"]['pages{tenant="a"}'] == 2
+    assert snap["gauge_peaks"]['pages{tenant="a"}'] == 7
+    assert "pages" not in snap["gauge_peaks"]  # parent never written: no peak
+    # the snapshot still validates as a metrics event row
+    events = EventLog(str(tmp_path), main_process=True)
+    reg.emit_snapshot(events)
+    warnings_out = []
+    assert validate_events(str(tmp_path), warnings_out=warnings_out) == []
+    assert warnings_out == []

@@ -1,0 +1,320 @@
+"""Simline (ISSUE 16): discrete-event simulation of the REAL serving stack
+under a ManualClock — multi-tenant fairness, books, determinism, the
+eviction path at simulated scale, per-tenant SLO bounds, the /slo tenant
+filter, and the SIM_r*.json artifact/diff discipline
+(perceiver_io_tpu/serving/sim.py; docs/serving.md#multi-tenant-telemetry).
+
+No jax computation runs anywhere in this file: the SimEngineFrontEnd
+replaces the compiled programs with sampled service times, which is the
+property the wall-clock test pins.
+"""
+
+import copy
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from perceiver_io_tpu.obs.events import EventLog, merged_events, validate_events
+from perceiver_io_tpu.obs.flightrec import FlightRecorder, SLOBounds
+from perceiver_io_tpu.obs.metrics import MetricsRegistry
+from perceiver_io_tpu.obs.slo import build_slo_report
+from perceiver_io_tpu.serving import EngineConfig, FrontEndConfig
+from perceiver_io_tpu.serving.sim import (
+    SIM_METRICS,
+    ServiceTimeModel,
+    TenantSpec,
+    build_multi_tenant_workload,
+    build_sim_doc,
+    diff_sim,
+    jain_fairness,
+    run_sim,
+    sim_comparability_problems,
+    sim_doc_metrics,
+)
+
+MODEL = ServiceTimeModel(
+    prefill_p50_s=0.002, prefill_p99_s=0.004,
+    tpot_p50_s=0.0005, tpot_p99_s=0.001, source="test_synthetic",
+)
+
+CONFIG = FrontEndConfig(max_queue=64, admission_projection=False)
+
+
+def _tenants(n=120):
+    return [
+        TenantSpec("acme", rate_rps=300.0, n_requests=n,
+                   prompt_lens=(8, 12), max_new_tokens=(4, 6), seed=11),
+        TenantSpec("bcorp", rate_rps=200.0, n_requests=(2 * n) // 3,
+                   prompt_lens=(12,), max_new_tokens=(6,), seed=22),
+    ]
+
+
+def _engine_cfg(**kw):
+    base = dict(slots=8, page_size=8, max_ca_tokens=24, max_sa_tokens=8)
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def test_sim_books_balance_fairness_and_stream(tmp_path):
+    """The core certification: a two-tenant open-loop run through the real
+    engine control plane — extended books identity closes, both allocator
+    audits are empty, every request row is tenant-stamped, the per-tenant
+    summary blocks sum back to the books, the per-tenant serve_* counter
+    children are on /metrics (with the unlabeled family still the
+    all-tenant total), and the event stream validates with zero problems
+    AND zero forward-compat warnings."""
+    events = EventLog(str(tmp_path), main_process=True)
+    registry = MetricsRegistry()
+    tenants = _tenants()
+    report = run_sim(
+        tenants, service_model=MODEL, engine_config=_engine_cfg(),
+        config=CONFIG, events=events, registry=registry, seed=3,
+    )
+    s = report.summary
+    fe = report.frontend
+    assert s["books_balanced"] and fe.audit() == []
+    assert fe.ca_alloc.audit() == [] and fe.sa_alloc.audit() == []
+    assert fe.ca_alloc.pages_used == 0 and fe.sa_alloc.pages_used == 0
+    assert s["n_requests"] == sum(t.n_requests for t in tenants)
+    assert s["error_rate"] == 0.0
+    assert 0.0 < s["fairness_jain"] <= 1.0
+    # per-tenant blocks decompose the books exactly
+    books = fe.books()
+    assert sum(b["n_requests"] for b in s["tenants"].values()) == books["submitted"]
+    assert sum(b["ok"] for b in s["tenants"].values()) == books["ok"]
+    assert sum(b["shed"] for b in s["tenants"].values()) == books["shed"]
+    # the stream: every request row tenant-stamped, one sim.summary row,
+    # zero problems, zero warnings
+    stream = merged_events(str(tmp_path))
+    reqs = [e for e in stream if e.get("event") == "request"]
+    assert reqs and all(e.get("tenant") in ("acme", "bcorp") for e in reqs)
+    sims = [e for e in stream if e.get("event") == "sim.summary"]
+    assert len(sims) == 1 and sims[0]["n_tenants"] == 2
+    warnings_out = []
+    assert validate_events(str(tmp_path), warnings_out=warnings_out) == []
+    assert warnings_out == []
+    # per-tenant SLO sub-reports cover exactly the tenant set
+    slo = build_slo_report(stream, by_tenant=True)
+    assert set(slo["tenants"]) == {"acme", "bcorp"}
+    assert slo["tenants"]["acme"]["n_requests"] == tenants[0].n_requests
+    # labeled metrics: child series per tenant, parent = all-tenant total
+    text = registry.to_prometheus()
+    assert 'serve_submitted_total{tenant="acme"}' in text
+    assert 'serve_submitted_total{tenant="bcorp"}' in text
+    sub = registry.counter("serve_submitted_total")
+    assert sub.value == books["submitted"]
+    assert (
+        sub.labels(tenant="acme").value + sub.labels(tenant="bcorp").value
+        == sub.value
+    )
+
+
+def test_sim_deterministic_and_self_diff_clean(tmp_path):
+    """Seeded determinism is what makes SIM artifacts diffable: two runs
+    with the same tenants/model/seed produce identical diffable metrics,
+    diff_sim run-vs-itself is all-neutral, and the comparability identity
+    (tenants + service model + engine geometry) flags any drift as stale
+    instead of diffing it."""
+    docs = []
+    for i in range(2):
+        d = tmp_path / f"run{i}"
+        events = EventLog(str(d), main_process=True)
+        report = run_sim(
+            _tenants(), service_model=MODEL, engine_config=_engine_cfg(),
+            config=CONFIG, events=events, registry=MetricsRegistry(), seed=9,
+        )
+        docs.append(build_sim_doc(
+            i + 1, report.summary, _tenants(), MODEL, _engine_cfg(),
+        ))
+    assert sim_doc_metrics(docs[0]) == sim_doc_metrics(docs[1])
+    m = sim_doc_metrics(docs[0])
+    assert set(m) <= set(SIM_METRICS) and "achieved_rps" in m
+    assert sim_comparability_problems(docs[0], docs[1]) == []
+    d = diff_sim(docs[0], docs[1])
+    assert d["comparable"] and d["ok"]
+    assert d["deltas"] and all(r["kind"] == "neutral" for r in d["deltas"])
+    # ...and the tolerance machinery flags a genuinely worse run
+    worse = copy.deepcopy(docs[1])
+    worse["summary"]["fairness_jain"] = docs[0]["summary"]["fairness_jain"] - 0.2
+    d2 = diff_sim(docs[0], worse)
+    assert not d2["ok"]
+    assert any(r["metric"] == "fairness_jain" and r["kind"] == "regression"
+               for r in d2["deltas"])
+    # a different workload is STALE, not a regression
+    other = build_sim_doc(
+        3, docs[0]["summary"],
+        [TenantSpec("acme", rate_rps=999.0, n_requests=5)], MODEL, _engine_cfg(),
+    )
+    assert sim_comparability_problems(docs[0], other)
+    # ...and so is a different service-model fit
+    refit = copy.deepcopy(docs[1])
+    refit["service_model"]["source"] = "LOAD_r99"
+    assert sim_comparability_problems(docs[0], refit)
+
+
+def test_sim_never_sleeps_wall_clock_free(tmp_path, monkeypatch):
+    """Virtual time is the whole trick: a simulated second must cost zero
+    wall-clock sleeps. time.sleep raising anywhere during the run is the
+    strongest version of that claim."""
+
+    def _no_sleep(_):
+        raise AssertionError("sim must never call time.sleep")
+
+    monkeypatch.setattr(time, "sleep", _no_sleep)
+    events = EventLog(str(tmp_path), main_process=True)
+    report = run_sim(
+        _tenants(40), service_model=MODEL, engine_config=_engine_cfg(),
+        config=CONFIG, events=events, registry=MetricsRegistry(), seed=5,
+    )
+    assert report.summary["books_balanced"]
+    assert report.duration_s > 0.0  # virtual time DID move
+
+
+def test_sim_eviction_path_books_exact(tmp_path):
+    """Evictline under simulation: a page pool at half the slot demand with
+    slow sampled service times forces REAL evictions through the real
+    allocator — every eviction resumes, nothing stays parked, pages come
+    back exact, and the serve.evict audit rows are tenant-stamped."""
+    slow = ServiceTimeModel(
+        prefill_p50_s=0.005, prefill_p99_s=0.010,
+        tpot_p50_s=0.004, tpot_p99_s=0.008, source="test_slow",
+    )
+    tenants = [
+        TenantSpec("lat", rate_rps=30.0, n_requests=30,
+                   prompt_lens=(8,), max_new_tokens=(3, 4), seed=44),
+        TenantSpec("bulk", rate_rps=30.0, n_requests=30,
+                   prompt_lens=(16,), max_new_tokens=(12, 16), seed=55),
+    ]
+    events = EventLog(str(tmp_path), main_process=True)
+    report = run_sim(
+        tenants, service_model=slow,
+        engine_config=EngineConfig(slots=4, page_size=8, max_ca_tokens=32,
+                                   max_sa_tokens=24, pool_headroom=0.5,
+                                   eviction=True),
+        config=CONFIG, events=events, registry=MetricsRegistry(), seed=6,
+    )
+    books = report.frontend.books()
+    assert books["balanced"], books
+    assert books["evictions"] >= 1, "pool never pressured — the test is vacuous"
+    assert books["evictions"] == books["resumes"], books
+    assert books["parked"] == 0 and books["ok"] == 60 and books["shed"] == 0, books
+    fe = report.frontend
+    assert fe.ca_alloc.pages_used == 0 and fe.sa_alloc.pages_used == 0
+    assert fe.ca_alloc.audit() == [] and fe.sa_alloc.audit() == []
+    stream = merged_events(str(tmp_path))
+    evicts = [e for e in stream if e.get("event") == "serve.evict"]
+    assert len(evicts) == books["evictions"]
+    assert all(e.get("tenant") in ("lat", "bulk") for e in evicts)
+    assert validate_events(str(tmp_path)) == []
+
+
+def test_sim_per_tenant_slo_bounds_trigger_only_their_tenant(tmp_path):
+    """SLOBounds.tenants isolation: a planted always-breach TTFT bound on
+    ONE tenant trips flight dumps naming only that tenant's rows, while
+    the other tenant — same latency distribution — never trips the
+    generous default."""
+    events = EventLog(str(tmp_path), main_process=True)
+    recorder = FlightRecorder(
+        events, out_dir=str(tmp_path),
+        slo=SLOBounds(ttft_s=10.0, tenants={"acme": SLOBounds(ttft_s=1e-9)}),
+        max_dumps=8,
+    )
+    report = run_sim(
+        _tenants(30), service_model=MODEL, engine_config=_engine_cfg(),
+        config=CONFIG, events=recorder, registry=MetricsRegistry(), seed=7,
+    )
+    assert report.summary["books_balanced"]
+    assert recorder.dumps, "planted per-tenant bound produced no dump"
+    for path in recorder.dumps:
+        with open(path) as f:
+            dump = json.load(f)
+        assert dump["trigger"] == "slo_ttft"
+        assert dump["trigger_event"].get("tenant") == "acme", dump["trigger_event"]
+    # the default bounds govern rows of unlisted tenants
+    bounds = recorder.slo
+    assert bounds.for_tenant("bcorp") is bounds
+    assert bounds.for_tenant(None) is bounds
+    assert bounds.for_tenant("acme").ttft_s == 1e-9
+
+
+def test_slo_endpoint_tenant_filter_and_unknown_param_400(tmp_path):
+    """The /slo endpoint satellite: ?tenant= narrows the report to that
+    tenant's rows, an unknown tenant is an empty report (200, not an
+    error), an unknown query parameter is a 400 — parsed, never silently
+    the unfiltered report."""
+    from perceiver_io_tpu.obs.server import ObsServer
+
+    events = EventLog(str(tmp_path), main_process=True)
+    report = run_sim(
+        _tenants(30), service_model=MODEL, engine_config=_engine_cfg(),
+        config=CONFIG, events=events, registry=MetricsRegistry(), seed=8,
+    )
+    total = report.summary["n_requests"]
+    acme = report.summary["tenants"]["acme"]["n_requests"]
+
+    def get(url):
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return json.loads(r.read())
+
+    with ObsServer(run_dir=str(tmp_path)) as srv:
+        full = get(srv.url + "/slo")
+        assert full["n_requests"] == total and "tenant" not in full
+        one = get(srv.url + "/slo?tenant=acme")
+        assert one["n_requests"] == acme and one["tenant"] == "acme"
+        ghost = get(srv.url + "/slo?tenant=ghost")
+        assert ghost["n_requests"] == 0 and ghost["tenant"] == "ghost"
+        assert "no request events" in ghost["note"]
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            get(srv.url + "/slo?bogus=1")
+        assert exc.value.code == 400
+        body = json.loads(exc.value.read())
+        assert "bogus" in body["error"] and body["params"] == ["tenant"]
+        # a known AND an unknown param together: still a 400
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            get(srv.url + "/slo?tenant=acme&bogus=1")
+        assert exc.value.code == 400
+
+
+def test_service_time_model_fit_and_workload_merge():
+    """The lognormal fit recovers the artifact's percentiles (median of
+    many samples ≈ p50, 99th ≈ p99), from_load_doc refuses a doc without
+    them, and the multi-tenant merge produces arrival-ordered globally
+    unique indices with per-tenant stamps."""
+    import numpy as np
+
+    model = ServiceTimeModel.from_load_doc(
+        {"n": 3, "summary": {"ttft_s": {"p50": 0.01, "p99": 0.03},
+                             "tpot_s": {"p50": 0.001, "p99": 0.002}}}
+    )
+    assert model.source == "LOAD_r3"
+    rng = np.random.default_rng(0)
+    samples = sorted(model.sample_prefill(rng) for _ in range(4000))
+    assert samples[2000] == pytest.approx(0.01, rel=0.1)
+    assert samples[int(4000 * 0.99)] == pytest.approx(0.03, rel=0.2)
+    # determinism: same seed, same stream
+    a = [model.sample_tpot(np.random.default_rng(1)) for _ in range(3)]
+    b = [model.sample_tpot(np.random.default_rng(1)) for _ in range(3)]
+    assert a[0] == b[0]
+    with pytest.raises(ValueError):
+        ServiceTimeModel.from_load_doc({"summary": {"ttft_s": {"p50": 0.01}}})
+    with pytest.raises(ValueError):
+        ServiceTimeModel(prefill_p50_s=0.0, prefill_p99_s=1.0,
+                         tpot_p50_s=1.0, tpot_p99_s=1.0)
+
+    specs, offsets = build_multi_tenant_workload(_tenants(20))
+    assert [s.index for s in specs] == list(range(len(specs)))
+    assert offsets == sorted(offsets)
+    assert {s.tenant for s in specs} == {"acme", "bcorp"}
+    with pytest.raises(ValueError):
+        build_multi_tenant_workload([
+            TenantSpec("dup", rate_rps=1.0, n_requests=1),
+            TenantSpec("dup", rate_rps=1.0, n_requests=1),
+        ])
+
+    # Jain's index: equal shares are 1.0, one tenant taking everything is 1/n
+    assert jain_fairness([0.5, 0.5, 0.5]) == pytest.approx(1.0)
+    assert jain_fairness([1.0, 0.0, 0.0, 0.0]) == pytest.approx(0.25)
+    assert jain_fairness([]) == 1.0

@@ -101,6 +101,25 @@ exactly one terminal outcome, zero leaked worker slots):
                               ``serve.recover`` events name each
                               re-admission.
 
+Simline scenarios (serving/sim.py — the REAL engine control plane under a
+ManualClock with sampled service times; no jax, no model,
+docs/serving.md#multi-tenant-telemetry):
+
+- ``sim_tenant_storm``      — one tenant floods at 10x each victim's rate,
+                              far over join capacity: admission degrades
+                              PROPORTIONALLY (demand-normalized Jain >=
+                              0.9, neither victim starves), every shed is
+                              a tenant-stamped first-class row, books
+                              balance at the full offered scale.
+- ``sim_noisy_neighbor``    — a long-budget bulk tenant forces REAL
+                              Evictline evictions on a half-size page
+                              pool shared with a latency tenant: both
+                              tenants fully served, and per-tenant
+                              ``SLOBounds`` prove isolation — the latency
+                              tenant's planted TTFT bound trips flight
+                              dumps naming ONLY its rows while the bulk
+                              tenant's generous bound never fires.
+
 ``--scenarios`` accepts fnmatch globs: ``--scenarios 'serve_*'`` runs the
 serving family standalone, ``--scenarios 'elastic_*,preempt'`` composes.
 ``--smoke`` shrinks the Evictline scenarios (greedy-only, fewer requests)
@@ -1307,6 +1326,184 @@ def scenario_serve_crash_recover(tmp):
         )
 
 
+# ---------------------------------------------------------------------------
+# Simline scenarios: multi-tenant pressure at simulated scale — the real
+# engine control plane under a ManualClock with sampled service times
+# (serving/sim.py; docs/serving.md#multi-tenant-telemetry). No jax, no
+# model: tens of thousands of simulated requests in host-loop time.
+# ---------------------------------------------------------------------------
+
+
+def _sim_service_model():
+    """A fixed synthetic service model for the chaos scenarios: the gate
+    artifact (tools/sim.py) fits from a committed LOAD round; chaos wants
+    pinned numbers so the pressure geometry never drifts with the
+    artifact."""
+    from perceiver_io_tpu.serving.sim import ServiceTimeModel
+
+    return ServiceTimeModel(
+        prefill_p50_s=0.002, prefill_p99_s=0.004,
+        tpot_p50_s=0.0005, tpot_p99_s=0.001, source="chaos_synthetic",
+    )
+
+
+def scenario_sim_tenant_storm(tmp):
+    """Simline tenant storm: one tenant floods at 10x each victim's rate,
+    far over the engine's join capacity. Admission must degrade
+    PROPORTIONALLY — demand-normalized shares stay near-equal (Jain >=
+    0.9), neither victim starves (its achieved share holds within 35% of
+    the flooder's, queue-wait p99 bounded), and every shed is a
+    first-class tenant-stamped row with the books balancing at the full
+    offered scale."""
+    from perceiver_io_tpu.obs.slo import build_slo_report
+    from perceiver_io_tpu.serving import EngineConfig, FrontEndConfig
+    from perceiver_io_tpu.serving.sim import TenantSpec, run_sim
+
+    window = 1.0 if SMOKE else 2.0
+    tenants = [
+        TenantSpec("victim_a", rate_rps=60.0, n_requests=int(60 * window),
+                   prompt_lens=(8,), max_new_tokens=(4,), seed=11),
+        TenantSpec("victim_b", rate_rps=60.0, n_requests=int(60 * window),
+                   prompt_lens=(8, 12), max_new_tokens=(4, 6), seed=22),
+        TenantSpec("flood", rate_rps=600.0, n_requests=int(600 * window),
+                   prompt_lens=(8,), max_new_tokens=(4,), seed=33),
+    ]
+    recorder, clock, run_dir = _serve_env(tmp, "sim_tenant_storm")
+    report = run_sim(
+        tenants, service_model=_sim_service_model(),
+        engine_config=EngineConfig(slots=8, page_size=8, max_ca_tokens=24,
+                                   max_sa_tokens=8),
+        config=FrontEndConfig(max_queue=64, admission_projection=False),
+        events=recorder, clock=clock, seed=5,
+    )
+    s = report.summary
+    books = _audit_serving(report.frontend, run_dir, "sim_tenant_storm")
+    assert s["books_balanced"] and s["error_rate"] == 0.0, s["books"]
+    # the storm was real: offered far over capacity, sheds happened
+    assert s["shed_rate"] > 0.2, f"no real pressure: shed_rate {s['shed_rate']}"
+    # ...and degraded FAIRLY: demand-normalized shares near-equal
+    assert s["fairness_jain"] >= 0.9, (
+        f"flood tenant skewed admission: fairness {s['fairness_jain']}, "
+        f"tenants {s['tenants']}"
+    )
+    flood_share = s["tenants"]["flood"]["achieved_rps"] / 600.0
+    for victim in ("victim_a", "victim_b"):
+        share = s["tenants"][victim]["achieved_rps"] / 60.0
+        assert share >= 0.65 * flood_share, (
+            f"{victim} starved: share {share:.3f} vs flood {flood_share:.3f}"
+        )
+        qw = s["tenants"][victim].get("queue_wait_s")
+        assert qw is not None and qw["p99"] <= 1.0, (
+            f"{victim} queue-wait p99 unbounded under the storm: {qw}"
+        )
+    # every shed is a first-class tenant-stamped row — never a silent drop
+    stream = _stream(run_dir)
+    shed_rows = [e for e in stream if e.get("event") == "request"
+                 and e.get("outcome") == "shed"]
+    assert len(shed_rows) == books["shed"], (len(shed_rows), books["shed"])
+    assert all(e.get("shed_reason") and e.get("tenant") for e in shed_rows)
+    per_tenant_shed = sum(t["shed"] for t in s["tenants"].values())
+    assert per_tenant_shed == books["shed"], (per_tenant_shed, books)
+    assert any(e.get("event") == "sim.summary" for e in stream)
+    slo = build_slo_report(stream, by_tenant=True)
+    assert set(slo["tenants"]) == {"victim_a", "victim_b", "flood"}, slo.keys()
+    print(
+        f"chaos: sim_tenant_storm ok — flood offered 600 req/s vs 60+60 "
+        f"victims ({s['n_requests']} requests, shed_rate {s['shed_rate']}), "
+        f"fairness {s['fairness_jain']}, victim shares within 35% of the "
+        f"flooder's, {books['shed']} sheds all tenant-stamped, books balanced"
+    )
+
+
+def scenario_sim_noisy_neighbor(tmp):
+    """Simline noisy neighbor: a long-prompt/long-budget bulk tenant shares
+    the engine with a latency-sensitive tenant under a page pool sized
+    BELOW the combined demand (Evictline on) — the bulk pressure forces
+    REAL evictions through the real allocator, yet both tenants reach
+    ``ok`` on every request, parked work all resumes, and the PER-TENANT
+    SLO machinery proves isolation: the latency tenant's planted
+    near-zero TTFT bound (``SLOBounds.tenants``) trips flight dumps naming
+    ONLY its rows while the bulk tenant's generous bound never fires."""
+    from perceiver_io_tpu.obs.flightrec import SLOBounds
+    from perceiver_io_tpu.obs.slo import build_slo_report
+    from perceiver_io_tpu.serving import EngineConfig, FrontEndConfig
+    from perceiver_io_tpu.serving.sim import TenantSpec, run_sim
+
+    from perceiver_io_tpu.serving.sim import ServiceTimeModel
+
+    n = 40 if SMOKE else 80
+    tenants = [
+        TenantSpec("lat", rate_rps=30.0, n_requests=n,
+                   prompt_lens=(8,), max_new_tokens=(3, 4), seed=44),
+        TenantSpec("bulk", rate_rps=30.0, n_requests=n,
+                   prompt_lens=(16,), max_new_tokens=(12, 16), seed=55),
+    ]
+    recorder, clock, run_dir = _serve_env(tmp, "sim_noisy_neighbor")
+    # the per-tenant bounds under test: lat's is a planted always-breach,
+    # bulk's is generous — a shared bound could not tell them apart
+    recorder.slo = SLOBounds(
+        ttft_s=10.0, tenants={"lat": SLOBounds(ttft_s=1e-9)}
+    )
+    # a slower service model than _sim_service_model(): a bulk request
+    # must OCCUPY its slot long enough (~90ms) that ~3 of them overlap on
+    # the half-size pool — that overlap IS the page pressure under test
+    slow = ServiceTimeModel(
+        prefill_p50_s=0.005, prefill_p99_s=0.010,
+        tpot_p50_s=0.004, tpot_p99_s=0.008, source="chaos_synthetic_slow",
+    )
+    report = run_sim(
+        tenants, service_model=slow,
+        engine_config=EngineConfig(slots=4, page_size=8, max_ca_tokens=32,
+                                   max_sa_tokens=24, pool_headroom=0.5,
+                                   eviction=True),
+        config=FrontEndConfig(max_queue=64, admission_projection=False),
+        events=recorder, clock=clock, seed=6,
+    )
+    s = report.summary
+    fe = report.frontend
+    books = _audit_serving(fe, run_dir, "sim_noisy_neighbor")
+    # the pressure was real page pressure: evictions through the REAL
+    # allocator, everything parked came back, pages exact after drain
+    assert books["evictions"] >= 1 and books["evictions"] == books["resumes"], books
+    assert books["parked"] == 0 and fe.ca_alloc.pages_used == 0, books
+    assert fe.ca_alloc.audit() == [] and fe.sa_alloc.audit() == []
+    # ...and STILL both tenants fully served: the neighbor was noisy, not lethal
+    for name in ("lat", "bulk"):
+        blk = s["tenants"][name]
+        assert blk["ok"] == n and blk["shed"] == 0, (name, blk)
+    stream = _stream(run_dir)
+    evict_rows = [e for e in stream if e.get("event") == "serve.evict"]
+    assert evict_rows and all(e.get("tenant") for e in evict_rows), (
+        "serve.evict rows must be tenant-stamped"
+    )
+    # per-tenant SLO series: both sub-reports present, each over its own rows
+    slo = build_slo_report(stream, by_tenant=True)
+    assert set(slo["tenants"]) == {"lat", "bulk"}
+    assert slo["tenants"]["lat"]["n_requests"] == n
+    # the isolation proof: lat's planted bound tripped dumps naming ONLY
+    # lat rows; bulk's TTFTs (same distribution) never tripped its own
+    assert recorder.dumps, "lat's planted TTFT bound produced no flight dump"
+    for path in recorder.dumps:
+        with open(path) as f:
+            dump = json.load(f)
+        assert dump["trigger"] == "slo_ttft", dump["trigger"]
+        assert dump["trigger_event"].get("tenant") == "lat", (
+            f"dump names a non-lat row: {dump['trigger_event']}"
+        )
+    # the bulk tenant really held pages the victim didn't: per-tenant
+    # pages-held peaks reflect the asymmetric footprints
+    lat_peak = s["tenants"]["lat"]["pages_held_peak"] or 0
+    bulk_peak = s["tenants"]["bulk"]["pages_held_peak"] or 0
+    assert bulk_peak > lat_peak, (lat_peak, bulk_peak)
+    print(
+        f"chaos: sim_noisy_neighbor ok — bulk tenant forced "
+        f"{books['evictions']} evictions (pool_headroom 0.5), {n}+{n} "
+        f"requests all ok, per-tenant bounds tripped {len(recorder.dumps)} "
+        f"dumps all naming 'lat' rows, pages peak bulk {bulk_peak:.0f} > "
+        f"lat {lat_peak:.0f}, books balanced"
+    )
+
+
 SCENARIOS = {
     "preempt": scenario_preempt,
     "preempt_mesh": scenario_preempt_mesh,
@@ -1328,6 +1525,8 @@ SCENARIOS = {
     "serve_spec_kill_mid_span": scenario_serve_spec_kill_mid_span,
     "serve_evict_storm": scenario_serve_evict_storm,
     "serve_crash_recover": scenario_serve_crash_recover,
+    "sim_tenant_storm": scenario_sim_tenant_storm,
+    "sim_noisy_neighbor": scenario_sim_noisy_neighbor,
 }
 
 

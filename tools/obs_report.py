@@ -290,31 +290,32 @@ def render(run_dir: str, max_compile_rows: int = 20) -> str:
         # Canonical math lives in obs.metrics (the bucket base is
         # load-bearing for every committed tpot_hist); the inline copy is
         # only the no-package fallback, same pattern as load_events.
-        try:
-            from perceiver_io_tpu.obs.metrics import merge_counts, percentile_from_counts
-
-            merged = merge_counts(*((g.get("tpot_hist") or {}) for g in warm))
-            hist_pct = lambda p: percentile_from_counts(merged, p)  # noqa: E731
-        except ImportError:
-            merged = {}
-            for g in warm:
+        def _merge_hists(rows_):
+            out: Dict[int, int] = {}
+            for g in rows_:
                 for k, v in (g.get("tpot_hist") or {}).items():
-                    merged[int(k)] = merged.get(int(k), 0) + int(v)
+                    out[int(k)] = out.get(int(k), 0) + int(v)
+            return out
+
+        try:
+            from perceiver_io_tpu.obs.metrics import percentile_from_counts as _hpct
+        except ImportError:
             growth = 2.0 ** 0.25  # must track obs.metrics.GROWTH
 
-            def hist_pct(p, _n=None):
-                n = sum(merged.values())
+            def _hpct(counts, p):
+                n = sum(counts.values())
                 target, seen = max(int(math.ceil(p / 100.0 * n)), 1), 0
-                for idx in sorted(merged):
-                    seen += merged[idx]
+                for idx in sorted(counts):
+                    seen += counts[idx]
                     if seen >= target:
                         return growth ** (idx + 0.5)
+        merged = _merge_hists(warm)
         n_tok = sum(merged.values())
         if n_tok:
             low = "  (low_n)" if n_tok < 5 else ""
             lines.append(
-                f"  tpot_s ({n_tok} tokens): p50 {hist_pct(50):.4g}  "
-                f"p90 {hist_pct(90):.4g}  p99 {hist_pct(99):.4g}{low}" + note
+                f"  tpot_s ({n_tok} tokens): p50 {_hpct(merged, 50):.4g}  "
+                f"p90 {_hpct(merged, 90):.4g}  p99 {_hpct(merged, 99):.4g}{low}" + note
             )
         # queue-wait (loadgen-issued requests carry admission telemetry)
         qws = [float(g["queue_wait_s"]) for g in warm if g.get("queue_wait_s") is not None]
@@ -333,6 +334,48 @@ def render(run_dir: str, max_compile_rows: int = 20) -> str:
                 f"  batch_size_at_decode: mean {sum(bsz)/len(bsz):.4g}  "
                 f"min {min(bsz):.4g}  max {max(bsz):.4g}  ({len(bsz)} engine requests)"
             )
+        # per-tenant rollup (Simline, docs/serving.md#multi-tenant-telemetry):
+        # tenant-stamped request rows become one line per tenant — outcome
+        # rates, TTFT/TPOT percentiles, and the pages-held peak read from
+        # the labeled engine gauge's high-water mark in the metrics rows
+        tenants = sorted({str(r["tenant"]) for r in reqs if r.get("tenant") is not None})
+        if tenants:
+            peaks: Dict[str, float] = {}
+            for e in events:
+                if e.get("event") == "metrics":
+                    for k, v in (e.get("gauge_peaks") or {}).items():
+                        if k.startswith("engine_kv_pages_used{") and isinstance(v, (int, float)):
+                            peaks[k] = max(peaks.get(k, 0.0), float(v))
+            rows = []
+            for t in tenants:
+                trows = [r for r in reqs if str(r.get("tenant")) == t]
+                n_t = len(trows)
+                by_outcome: Dict[str, int] = {}
+                for r in trows:
+                    o = str(r.get("outcome", "ok"))
+                    by_outcome[o] = by_outcome.get(o, 0) + 1
+                tok = [r for r in trows if r.get("outcome", "ok") == "ok"]
+                ttfts = [float(r["ttft_s"]) for r in tok if r.get("ttft_s") is not None]
+                th = _merge_hists(tok)
+                peak = peaks.get(f'engine_kv_pages_used{{tenant="{t}"}}')
+                rows.append([
+                    t,
+                    str(n_t),
+                    f"{by_outcome.get('ok', 0) / n_t:.3f}",
+                    f"{by_outcome.get('shed', 0) / n_t:.3f}",
+                    f"{by_outcome.get('timeout', 0) / n_t:.3f}",
+                    f"{_pct(ttfts, 50):.4g}" if ttfts else "-",
+                    f"{_pct(ttfts, 99):.4g}" if ttfts else "-",
+                    f"{_hpct(th, 50):.4g}" if th else "-",
+                    f"{_hpct(th, 99):.4g}" if th else "-",
+                    f"{peak:.4g}" if peak is not None else "-",
+                ])
+            lines.append("")
+            lines.append(f"== tenants ({len(tenants)}) ==")
+            lines.extend("  " + r for r in _table(rows, [
+                "tenant", "reqs", "ok", "shed", "timeout",
+                "ttft_p50", "ttft_p99", "tpot_p50", "tpot_p99", "pages_peak",
+            ]))
 
     # engine gauges (Pageline): the LAST registry snapshot's engine_* gauges
     # plus their run maxima — batch occupancy and page-pool utilization

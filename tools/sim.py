@@ -1,0 +1,390 @@
+#!/usr/bin/env python
+"""Simline CLI — discrete-event scale certification of the real serving stack.
+
+The standing multi-tenant serving gate (``tasks.py sim``; ``--smoke`` is
+wired into ``tasks.py perf``): drive a merged multi-tenant Poisson workload
+through the REAL :class:`~perceiver_io_tpu.serving.engine.EngineFrontEnd`
+control plane — admission, paging, eviction, breaker, books — with only the
+compiled prefill/decode replaced by a :class:`~perceiver_io_tpu.serving.sim.
+ServiceTimeModel` fitted from the latest committed ``LOAD_r*.json`` round,
+all on a ``ManualClock`` (zero wall-clock sleeps; tens of thousands of
+offered req/s complete in host-loop time). Then assert the whole surface:
+
+1. books balanced + zero leaked slots/pages (the same audit the chaos
+   scenarios close with), zero errors;
+2. the event stream validates — tenant-stamped ``request`` rows, one
+   ``sim.summary`` row — and ``build_slo_report(by_tenant=True)`` carries
+   one full sub-report per tenant;
+3. the live scrape surface answers per tenant: ``/metrics`` exposes
+   tenant-labeled ``serve_*`` series, ``/slo?tenant=`` narrows to that
+   tenant's rows only;
+4. the run summarizes into a SIM artifact body whose run-vs-itself
+   :func:`~perceiver_io_tpu.serving.sim.diff_sim` is clean (the run is
+   seeded end to end, so the self-diff is exact);
+5. the ledger's ``SIM_r*.json`` floors hold against the latest committed
+   artifact (fairness_jain minimum, max-starvation-age ceiling —
+   contracts/ledger.json, the same floor machinery as LOAD/BENCH).
+
+    python tools/sim.py                      # the full gate (>= 10k rps offered)
+    python tools/sim.py --smoke              # CI-fast subset (2 tenants, ~2k reqs)
+    python tools/sim.py --write-artifact     # refresh SIM_r<next>.json
+    python tools/sim.py --diff OLD.json NEW.json [--tolerance k=v]
+
+Exit codes (mirrors tools/loadgen.py): 0 clean, 1 gate failure /
+regression, 2 not comparable (diff mode), 3 internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import shutil
+import sys
+import tempfile
+import time
+import urllib.request
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+_ROUND_RE = re.compile(r"_r(\d+)\.json$")
+
+
+def build_tenants(smoke: bool, slots: int):
+    """The gate's workload: heterogeneous tenants whose SUMMED offered rate
+    is the certification scale (>= 10k simulated req/s for the full gate —
+    two orders of magnitude above what the CPU chaos scenarios can decode),
+    sized so every prompt+budget fits the engine geometry below."""
+    from perceiver_io_tpu.serving import EngineConfig
+    from perceiver_io_tpu.serving.sim import TenantSpec
+
+    if smoke:
+        tenants = [
+            TenantSpec("acme", rate_rps=600.0, n_requests=1200,
+                       prompt_lens=(8, 12), max_new_tokens=(4, 6), seed=101),
+            TenantSpec("bcorp", rate_rps=400.0, n_requests=800,
+                       prompt_lens=(12, 16), max_new_tokens=(6, 8), seed=202),
+        ]
+    else:
+        tenants = [
+            TenantSpec("api", rate_rps=5000.0, n_requests=6000,
+                       prompt_lens=(8, 12), max_new_tokens=(4, 6), seed=101),
+            TenantSpec("batch", rate_rps=3500.0, n_requests=4200,
+                       prompt_lens=(12, 16), max_new_tokens=(8, 12), seed=202),
+            TenantSpec("realtime", rate_rps=1500.0, n_requests=1800,
+                       prompt_lens=(8,), max_new_tokens=(4,), seed=303),
+        ]
+    # geometry covers the widest tenant: prompt 16 + budget 12 <= 32 CA
+    # tokens, 1 latent + 12 <= 16 SA tokens
+    engine_cfg = EngineConfig(slots=slots, page_size=8,
+                              max_ca_tokens=32, max_sa_tokens=16)
+    return tenants, engine_cfg
+
+
+def load_service_model():
+    """Fit the service-time model from the LATEST committed LOAD round that
+    carries warm TTFT/TPOT percentiles (the comparability stamp names it) —
+    simulated service times are measured, not invented."""
+    from perceiver_io_tpu.serving.sim import ServiceTimeModel
+
+    rounds = sorted(
+        ((int(m.group(1)), p)
+         for p in glob.glob(os.path.join(_REPO, "LOAD_r*.json"))
+         if (m := _ROUND_RE.search(p))),
+        reverse=True,
+    )
+    for n, path in rounds:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            return ServiceTimeModel.from_load_doc(doc, source=f"LOAD_r{n:02d}")
+        except (OSError, json.JSONDecodeError, ValueError):
+            continue
+    raise RuntimeError("no committed LOAD_r*.json carries ttft/tpot p50+p99")
+
+
+def _fetch(url: str) -> str:
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.read().decode()
+
+
+def run_gate(args) -> int:
+    from perceiver_io_tpu.obs.events import EventLog, validate_events
+    from perceiver_io_tpu.obs.flightrec import FlightRecorder, SLOBounds
+    from perceiver_io_tpu.obs.metrics import MetricsRegistry
+    from perceiver_io_tpu.obs.server import ObsServer
+    from perceiver_io_tpu.obs.slo import build_slo_report
+    from perceiver_io_tpu.serving import FrontEndConfig
+    from perceiver_io_tpu.serving.sim import (
+        build_sim_doc,
+        diff_sim,
+        format_sim_diff,
+        run_sim,
+    )
+
+    out_dir = args.out or tempfile.mkdtemp(prefix="simgate_")
+    keep = args.keep or args.out is not None
+    problems: list = []
+    try:
+        tenants, engine_cfg = build_tenants(args.smoke, args.slots)
+        service_model = load_service_model()
+        offered = sum(t.rate_rps for t in tenants)
+        n_requests = sum(t.n_requests for t in tenants)
+        print(
+            f"sim: {len(tenants)} tenants, {n_requests} requests offered at "
+            f"{offered:.0f} req/s (service model {service_model.source}, "
+            f"slots {engine_cfg.slots}) -> {out_dir}"
+        )
+        events = EventLog(out_dir, main_process=True)
+        # generous standing bounds: the gate certifies scale accounting,
+        # not a planted breach (per-tenant triggers are the chaos
+        # scenarios' job — sim_noisy_neighbor)
+        recorder = FlightRecorder(
+            events, out_dir=out_dir, slo=SLOBounds(ttft_s=30.0, tpot_p99_s=30.0)
+        )
+        registry = MetricsRegistry()
+        host_t0 = time.perf_counter()
+        report = run_sim(
+            tenants, service_model=service_model, engine_config=engine_cfg,
+            config=FrontEndConfig(max_queue=256, admission_projection=False),
+            events=recorder, registry=registry, seed=args.seed,
+        )
+        host_s = time.perf_counter() - host_t0
+        fe, summary = report.frontend, report.summary
+        print(
+            f"sim: {summary['n_requests']} requests over {report.duration_s:.3f}s "
+            f"VIRTUAL ({host_s:.2f}s host wall, zero sleeps): achieved "
+            f"{summary['achieved_rps']:.0f} req/s, shed_rate {summary['shed_rate']}, "
+            f"fairness {summary['fairness_jain']}, max starvation "
+            f"{summary['max_starvation_age_s']}s"
+        )
+
+        # --- the clean-books audit every serving gate closes with ---------
+        if not summary["books_balanced"]:
+            problems.append(f"books not balanced: {summary['books']}")
+        problems += [f"engine books: {p}" for p in fe.audit()]
+        problems += [f"ca pages: {p}" for p in fe.ca_alloc.audit()]
+        problems += [f"sa pages: {p}" for p in fe.sa_alloc.audit()]
+        if fe.ca_alloc.pages_used or fe.sa_alloc.pages_used:
+            problems.append(
+                f"pages leaked after drain: ca={fe.ca_alloc.pages_used} "
+                f"sa={fe.sa_alloc.pages_used}"
+            )
+        if summary["error_rate"] != 0.0:
+            problems.append(f"simulated run errored: error_rate {summary['error_rate']}")
+
+        # --- the scrape surface answers PER TENANT while the run is live --
+        with ObsServer(registry=registry, run_dir=out_dir, health=fe.health) as server:
+            metrics_text = _fetch(server.url + "/metrics")
+            for t in tenants:
+                if f'serve_submitted_total{{tenant="{t.name}"}}' not in metrics_text:
+                    problems.append(
+                        f"/metrics lacks the tenant-labeled series "
+                        f'serve_submitted_total{{tenant="{t.name}"}}'
+                    )
+            if "serve_submitted_total " not in metrics_text:
+                problems.append("/metrics lost the unlabeled all-tenant total")
+            t0 = tenants[0]
+            slo_t = json.loads(_fetch(server.url + f"/slo?tenant={t0.name}"))
+            want = summary["tenants"][t0.name]["n_requests"]
+            if slo_t.get("n_requests") != want:
+                problems.append(
+                    f"/slo?tenant={t0.name} n_requests {slo_t.get('n_requests')} "
+                    f"!= {want} (tenant filter broken)"
+                )
+            slo_all = json.loads(_fetch(server.url + "/slo"))
+            if slo_all.get("n_requests") != summary["n_requests"]:
+                problems.append(
+                    f"/slo n_requests {slo_all.get('n_requests')} != {summary['n_requests']}"
+                )
+
+        # --- event stream validates; per-tenant SLO sub-reports -----------
+        warnings_out: list = []
+        problems += validate_events(out_dir, warnings_out=warnings_out)
+        for w in warnings_out:
+            print(f"sim: warning: {w}")
+        from perceiver_io_tpu.obs.events import merged_events
+
+        stream = merged_events(out_dir)
+        if not any(e.get("event") == "sim.summary" for e in stream):
+            problems.append("no sim.summary event in the stream")
+        req_rows = [e for e in stream if e.get("event") == "request"]
+        if len(req_rows) != n_requests:
+            problems.append(f"{len(req_rows)} request rows, want {n_requests}")
+        untagged = [e for e in req_rows if e.get("tenant") is None]
+        if untagged:
+            problems.append(f"{len(untagged)} request rows lack the tenant stamp")
+        slo_report = build_slo_report(stream, by_tenant=True)
+        tenant_names = {t.name for t in tenants}
+        if set((slo_report or {}).get("tenants", {})) != tenant_names:
+            problems.append(
+                f"per-tenant SLO report covers {sorted((slo_report or {}).get('tenants', {}))}, "
+                f"want {sorted(tenant_names)}"
+            )
+
+        # --- artifact body + run-vs-itself comparability diff -------------
+        doc = build_sim_doc(
+            args.round or _next_round(), summary, tenants, service_model,
+            engine_cfg,
+        )
+        self_diff = diff_sim(doc, doc)
+        if not (self_diff["comparable"] and self_diff["ok"]):
+            problems.append("run-vs-itself sim diff NOT clean (differ broken): "
+                            + format_sim_diff(self_diff))
+        else:
+            print("sim: run-vs-itself comparability diff clean")
+
+        if args.write_artifact:
+            # write-side guard (the loadgen discipline): a sub-floor doc —
+            # e.g. a --smoke-size run — must never become the latest round
+            floor_fails = check_doc_floors(doc)
+            if floor_fails:
+                problems += [f"refusing to write artifact: {f}" for f in floor_fails]
+            else:
+                path = os.path.join(_REPO, f"SIM_r{doc['n']:02d}.json")
+                with open(path, "w") as f:
+                    json.dump(doc, f, indent=1, sort_keys=True)
+                    f.write("\n")
+                print(f"sim: wrote {path}")
+
+        # --- ledger floors over the committed SIM artifacts ----------------
+        problems += check_sim_floors()
+
+        if problems:
+            print("sim: gate FAILED:")
+            for p in problems:
+                print(f"  - {p}")
+            return 1
+        per = ", ".join(
+            f"{name} {blk['achieved_rps']:.0f}/{blk['offered_rps']:.0f} rps"
+            for name, blk in summary["tenants"].items()
+        )
+        print(
+            f"sim: OK — fairness {summary['fairness_jain']} over [{per}], "
+            f"max starvation {summary['max_starvation_age_s']}s, "
+            f"{summary['evictions']} evictions / {summary['resumes']} resumes, "
+            "books balanced"
+        )
+        return 0
+    except Exception as e:  # noqa: BLE001 — CI must see crash != verdict
+        print(f"sim: internal error: {e}", file=sys.stderr)
+        import traceback
+
+        traceback.print_exc()
+        return 3
+    finally:
+        if not keep:
+            shutil.rmtree(out_dir, ignore_errors=True)
+
+
+def _next_round() -> int:
+    rounds = [
+        int(m.group(1))
+        for p in glob.glob(os.path.join(_REPO, "SIM_r*.json"))
+        if (m := _ROUND_RE.search(p))
+    ]
+    return max(rounds) + 1 if rounds else 1
+
+
+def _sim_floors() -> dict:
+    from perceiver_io_tpu.analysis.ledger import load_ledger
+
+    ledger = load_ledger(os.path.join(_REPO, "contracts")) or {}
+    return {
+        name: floor
+        for name, floor in ledger.get("floors", {}).items()
+        if str(floor.get("artifact", "")).startswith("SIM_")
+    }
+
+
+def check_doc_floors(doc: dict) -> list:
+    """SIM-floor failures of ONE candidate doc before it is committed (the
+    write-side guard; :func:`check_sim_floors` is the read-side gate over
+    whatever is already on disk)."""
+    from perceiver_io_tpu.analysis.ledger import _dig, doc_matches
+
+    failures = []
+    for name, floor in _sim_floors().items():
+        if not doc_matches(doc, floor.get("match")):
+            continue
+        value = _dig(doc, floor["key"])
+        if not isinstance(value, (int, float)):
+            failures.append(f"{name}: {floor['key']} = {value!r} missing or non-numeric")
+            continue
+        if "min" in floor and value < floor["min"]:
+            failures.append(f"{name}: {floor['key']} = {value!r} below floor {floor['min']}")
+        if "max" in floor and value > floor["max"]:
+            failures.append(f"{name}: {floor['key']} = {value!r} above ceiling {floor['max']}")
+    return failures
+
+
+def check_sim_floors() -> list:
+    """Enforce every ``contracts/ledger.json`` floor whose artifact pattern
+    targets SIM_r*.json (latest round wins — the same machinery as the
+    committed-bench floors). No SIM floors or no committed artifact yet ->
+    nothing to enforce."""
+    from perceiver_io_tpu.analysis.ledger import check_bench_floors
+
+    sim_floors = _sim_floors()
+    if not sim_floors:
+        return []
+    return check_bench_floors({"floors": sim_floors}, _REPO)
+
+
+def run_diff(args) -> int:
+    from perceiver_io_tpu.serving.sim import SIM_METRICS, diff_sim, format_sim_diff
+
+    tolerances = {}
+    for spec in args.tolerance:
+        if "=" not in spec:
+            print(f"--tolerance wants METRIC=TOL, got {spec!r}", file=sys.stderr)
+            return 3
+        k, v = spec.split("=", 1)
+        if k not in SIM_METRICS:
+            print(f"unknown metric {k!r} (known: {', '.join(sorted(SIM_METRICS))})",
+                  file=sys.stderr)
+            return 3
+        tolerances[k] = float(v)
+    with open(args.diff[0]) as f:
+        old = json.load(f)
+    with open(args.diff[1]) as f:
+        new = json.load(f)
+    diff = diff_sim(old, new, tolerances)
+    print(format_sim_diff(diff))
+    if not diff["comparable"]:
+        return 2
+    return 0 if diff["ok"] else 1
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--smoke", action="store_true",
+                   help="CI-fast gate: 2 tenants / ~2k requests, same assertions")
+    p.add_argument("--slots", type=int, default=None,
+                   help="engine decode slots (default: 64, or 16 with --smoke)")
+    p.add_argument("--seed", type=int, default=1,
+                   help="service-time sampling seed (workload seeds are per-tenant)")
+    p.add_argument("--out", default=None, help="run dir (default: a temp dir)")
+    p.add_argument("--keep", action="store_true",
+                   help="keep the run dir (implied by --out)")
+    p.add_argument("--write-artifact", action="store_true",
+                   help="write/refresh SIM_r<round>.json at the repo root")
+    p.add_argument("--round", type=int, default=None,
+                   help="artifact round number (default: next free)")
+    p.add_argument("--diff", nargs=2, metavar=("OLD", "NEW"),
+                   help="diff two SIM_r*.json artifacts instead of running")
+    p.add_argument("--tolerance", action="append", default=[], metavar="METRIC=TOL")
+    args = p.parse_args(argv)
+    if args.diff:
+        return run_diff(args)
+    if args.slots is None:
+        args.slots = 16 if args.smoke else 64
+    return run_gate(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
