@@ -939,6 +939,7 @@ class PerceiverAR(nn.Module):
         sa_pad_mask=None,
         pos_shift=None,
         prefix_keep_idx=None,
+        pos_offset=None,
     ) -> BlockOutput:
         """``sa_pad_mask``/``pos_shift`` apply to decode steps only:
         slot masks for the self-attention caches (expired sliding-window
@@ -963,9 +964,22 @@ class PerceiverAR(nn.Module):
         duplicated index does NOT error — the forward gathers the row twice
         but the inverted-map backward credits only one copy, silently
         corrupting d_embedding/d_position-table. Verify suspect pipelines
-        with ``ops.gathers.debug_unique_indices()``."""
+        with ``ops.gathers.debug_unique_indices()``.
+
+        ``pos_offset``: optional absolute start position for the whole input
+        (scalar, possibly traced) — the Shareline shared-prefill seam: when a
+        prompt's leading ``pos_offset`` tokens are already resident in the
+        cross-attention cache (gathered from shared pool pages), the forward
+        runs over the SUFFIX alone, whose token ``i`` sits at absolute
+        position ``pos_offset + i``. Rotate-at-write keys and the
+        right-aligned causal mask make the result bit-exact equal to the
+        full-prompt forward on the einsum attend route (pinned by
+        tests/test_pages.py decode_shared)."""
         if decode and kv_cache is None:
             raise ValueError("decode=True requires kv_cache")
+        if pos_offset is not None and decode:
+            raise ValueError("pos_offset applies to the forward route; decode "
+                             "steps derive positions from the cache fill level")
         if kv_cache is not None and not deterministic and self.cross_attention_dropout > 0.0:
             # reference: modules.py:810-812
             raise ValueError("cross-attention dropout not supported with caching")
@@ -988,9 +1002,11 @@ class PerceiverAR(nn.Module):
             kv_cache=kv_cache,
             deterministic=deterministic,
             prefix_keep_idx=prefix_keep_idx,
+            pos_offset=pos_offset,
         )
 
-    def _forward(self, x, prefix_len, pad_mask, kv_cache, deterministic, prefix_keep_idx=None):
+    def _forward(self, x, prefix_len, pad_mask, kv_cache, deterministic,
+                 prefix_keep_idx=None, pos_offset=None):
         b, n = x.shape[0], x.shape[1]
         if not 0 <= prefix_len < n:
             raise ValueError(f"prefix_len ({prefix_len}) out of valid range [0..{n})")
@@ -998,6 +1014,11 @@ class PerceiverAR(nn.Module):
         dropout_active = (
             not deterministic and prefix_len > 0 and self.cross_attention_dropout > 0.0
         )
+        if pos_offset is not None and dropout_active:
+            # the compact embed route below draws its keep set over positions
+            # 0..prefix_len and would silently ignore the offset
+            raise ValueError("pos_offset is a serving-forward seam; "
+                             "cross-attention dropout is not supported with it")
         # static keep count (training/prefix_dropout.prefix_keep_count)
         keep = prefix_len - int(prefix_len * self.cross_attention_dropout)
         if dropout_active and prefix_keep_idx is not None:
@@ -1042,11 +1063,12 @@ class PerceiverAR(nn.Module):
         # then embeds positions via a table slice (scatter-free backward)
         with jax.named_scope("embed"):
             if pad_mask is None:
-                x_emb, frq = self.input_adapter(x, None)
+                pos = None if pos_offset is None else positions(b, n, offset=pos_offset)
+                x_emb, frq = self.input_adapter(x, pos)
                 pad_latent = pad_prefix = None
             else:
                 shift = pad_mask.sum(axis=1, keepdims=True).astype(jnp.int32)
-                x_emb, frq = self.input_adapter(x, positions(b, n, shift=shift))
+                x_emb, frq = self.input_adapter(x, positions(b, n, shift=shift, offset=pos_offset))
                 pad_latent, pad_prefix = pad_mask[:, prefix_len:], pad_mask[:, :prefix_len]
 
         x_emb = probe("perceiver_ar.embed", x_emb)
@@ -1490,6 +1512,7 @@ class CausalSequenceModel(nn.Module):
         sa_pad_mask=None,
         pos_shift=None,
         prefix_keep_idx=None,
+        pos_offset=None,
     ) -> CausalModelOutput:
         if prefix_len > self.max_prefix_len:
             raise ValueError(
@@ -1505,6 +1528,7 @@ class CausalSequenceModel(nn.Module):
             sa_pad_mask=sa_pad_mask,
             pos_shift=pos_shift,
             prefix_keep_idx=prefix_keep_idx,
+            pos_offset=pos_offset,
         )
         h = out.last_hidden_state
         with jax.named_scope("logits"):

@@ -814,6 +814,146 @@ def make_decode_fns(
     return jax.jit(prefill), jax.jit(step)
 
 
+def make_shared_prefill_fn(
+    model,
+    num_latents: int,
+    skip_tokens: int,
+    seq_len: int,
+    config: Optional[GenerationConfig] = None,
+    cache_dtype=jnp.float32,
+    probes: bool = False,
+):
+    """Prefill that SKIPS the first ``skip_tokens`` prompt tokens because
+    their cross-attention KV rows are already resident in shared pool pages
+    (Shareline, the radix prefix match): the rows are gathered from the pages
+    into the contiguous cache, and the model forward runs over the unshared
+    SUFFIX alone — prefill compute and TTFT collapse to the suffix.
+
+    Exactness conditions (the caller — ``serving/engine.py`` — enforces both
+    and falls back to the unshared prefill otherwise, so sharing is always a
+    no-op rather than an approximation):
+
+    - ``skip_tokens`` is a whole number of pages lying entirely inside the
+      request's CONTEXT region (``skip_tokens <= seq_len - num_latents``):
+      context rows are per-token functions of (token id, absolute position)
+      under rotate-at-write RoPE, so byte-identical across requests with the
+      same prefix — latent-region rows are not (they pass through ``q_norm``
+      and the SA stack), so a match never reaches into them;
+    - the suffix carries ALL ``num_latents`` latents, making the latent set
+      (and therefore the logits) identical to the full-prompt prefill's.
+
+    With byte-identical resident rows the suffix forward's attend inputs are
+    bitwise the full prefill's on the einsum attend route (the CPU tier-1
+    route — ``flash_enabled`` is TPU-only), so the sampled stream is
+    token-exact equal to the unshared one, rng chain included (pinned by
+    tests/test_pages.py ``decode_shared``).
+
+    Returns ``shared_prefill(params, suffix_ids, pool_k, pool_v, page_ids,
+    rng) -> (first_token, state)`` — jitted; ``state`` carries the same
+    cache/rng/done/slot-mask fields the unshared prefill's state does (the
+    engine's join seam reads exactly those; the decode params the unshared
+    state also carries are the ENGINE's to hold, so this state omits them —
+    no per-join params copy out of the compiled program). ``pool_k``/
+    ``pool_v`` are the paged CA pools ``(num_pages, page_size, C)`` and
+    ``page_ids`` the matched run ``(skip_tokens / page_size,)`` int32 —
+    page ids are traced, so one trace serves every match of this geometry.
+    """
+    config = config or GenerationConfig()
+    if config.max_new_tokens < 1:
+        raise ValueError("decode fns require max_new_tokens >= 1")
+    mcfg = model.config
+    suffix_len = seq_len - skip_tokens
+    if skip_tokens < 1:
+        raise ValueError(f"skip_tokens must be >= 1, got {skip_tokens}")
+    if suffix_len < num_latents:
+        raise ValueError(
+            f"matched run ({skip_tokens} tokens) reaches into the latent "
+            f"region of a {seq_len}-token prompt with {num_latents} latents: "
+            f"latent rows are not shareable"
+        )
+    _validate_window(mcfg, seq_len, num_latents)
+
+    from perceiver_io_tpu.core.modules import CausalSequenceModel
+
+    def shared_prefill(params, suffix_ids, pool_k, pool_v, page_ids, rng=None):
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        b, m = suffix_ids.shape
+        if m != suffix_len:
+            raise ValueError(f"suffix is {m} tokens; this fn skips "
+                             f"{skip_tokens} of {seq_len}")
+        page_size = pool_k.shape[1]
+        if page_ids.shape[0] * page_size != skip_tokens:
+            raise ValueError(
+                f"{page_ids.shape[0]} pages of {page_size} do not cover "
+                f"{skip_tokens} skipped tokens (whole pages only)"
+            )
+        ca_capacity = seq_len + config.max_new_tokens
+        sa_capacity = num_latents + config.max_new_tokens
+        cache = CausalSequenceModel.init_cache(
+            mcfg, b, ca_capacity=ca_capacity, sa_capacity=sa_capacity, dtype=cache_dtype
+        )
+        ca = cache[0]
+        if ca.quantized:
+            raise NotImplementedError(
+                "shared prefill over an int8 cache needs the scale-plane "
+                "gather; the engine gates sharing off for cache_dtype=int8"
+            )
+
+        # the resident prefix rows, pool pages -> contiguous slots [0, skip)
+        with jax.named_scope("shared_prefix_gather"):
+            rows_k = pool_k[page_ids].reshape(skip_tokens, -1)
+            rows_v = pool_v[page_ids].reshape(skip_tokens, -1)
+            seeded = KVCache(
+                k=ca.k.at[:, :skip_tokens].set(
+                    jnp.broadcast_to(rows_k[None], (b,) + rows_k.shape).astype(ca.k.dtype)
+                ),
+                v=ca.v.at[:, :skip_tokens].set(
+                    jnp.broadcast_to(rows_v[None], (b,) + rows_v.shape).astype(ca.v.dtype)
+                ),
+                length=jnp.full((), skip_tokens, jnp.int32),
+                k_scale=None,
+                v_scale=None,
+            )
+        cache = (seeded,) + tuple(cache[1:])
+
+        # suffix forward: NOT prefill_mode (the CA cache enters non-empty) —
+        # the generic cache-attend route appends the suffix rows at the fill
+        # level and right-aligns the causal mask, exactly the full prefill's
+        # einsum attend over the same bytes
+        with jax.named_scope("shared_prefill"):
+            out = model.apply(
+                params,
+                suffix_ids,
+                prefix_len=suffix_len - num_latents,
+                pad_mask=None,
+                kv_cache=cache,
+                pos_offset=skip_tokens,
+            )
+        rng, first_rng = jax.random.split(rng)
+        next_token = _sample(out.logits[:, -1], first_rng, config)
+        done = jnp.zeros((b,), bool)
+        if config.eos_token_id is not None:
+            done = next_token == config.eos_token_id
+
+        state = {
+            "cache": out.kv_cache,
+            "token": next_token,
+            "rng": rng,
+            "done": done,
+            "pad_slots": jnp.zeros((b, ca_capacity), bool),
+            "pos_shift": jnp.zeros((b, 1), jnp.int32),
+        }
+        if probes:
+            from perceiver_io_tpu.obs.probes import decode_health
+
+            state["probe"] = decode_health(
+                out.logits[:, -1], out.kv_cache[0], jnp.zeros((), jnp.int32)
+            )
+        return next_token, state
+
+    return jax.jit(shared_prefill)
+
+
 # ---------------------------------------------------------------------------
 # Specline — speculative self-drafting decode (draft k cheap tokens, verify
 # them in ONE flagship forward; arXiv:2603.09555 for the drafter-state

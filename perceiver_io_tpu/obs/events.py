@@ -368,6 +368,11 @@ _REQUIRED_FIELDS: Dict[str, tuple] = {
     "serve.evict": ("request_index", "tokens_out", "pages_freed"),
     "serve.resume": ("request_index", "tokens_out"),
     "serve.recover": ("request_index", "tokens_resumed"),
+    # Shareline (serving/prefix.py + serving/pages.py, docs/serving.md
+    # #prefix-sharing): a joining request's prompt matched a resident page
+    # run in the radix prefix index and its prefill skipped those pages —
+    # pages_matched of pages_total prompt pages came for free
+    "serve.prefix_hit": ("request_index", "pages_matched", "pages_total"),
     # Simline (serving/sim.py, docs/observability.md#sim-artifacts): one
     # summary row per discrete-event simulation run — the SIM_r* artifact
     # body's load-bearing fields (per-tenant detail rides `tenants`)
@@ -401,11 +406,17 @@ _OPTIONAL_FIELD_TYPES: Dict[str, Dict[str, tuple]] = {
         "evictions": (int, float),
         "resumes": (int, float),
         "parked_depth_peak": (int, float),
+        # Shareline: the prefix leg of tools/loadgen.py stamps its sharing
+        # figures (hit rate, shared/unshared TTFT ratio) into the summary
+        # row — optional so pre-Shareline streams stay valid
+        "prefix": (dict,),
     },
     # Simline tenant identity on the per-request preemption audit trail
     "serve.evict": {"tenant": (str,)},
     "serve.resume": {"tenant": (str,)},
     "serve.recover": {"tenant": (str,)},
+    # Shareline: tenant identity and the token count the skip saved
+    "serve.prefix_hit": {"tenant": (str,), "tokens_skipped": (int, float)},
 }
 
 # the closed terminal-outcome vocabulary of `request` rows (the serving

@@ -84,26 +84,48 @@ class WorkloadSpec:
     every distinct (prompt_len, max_new_tokens) pair is a distinct compiled
     prefill/step geometry, and the load generator's job is to measure warm
     serving, not to fuzz the compile cache.
+
+    ``shared_prefix_len > 0`` is the Shareline prompt-homogeneous mode:
+    every request's first ``shared_prefix_len`` tokens are ONE common
+    seeded preamble (drawn once, before the per-request stream, so the
+    stream stays prefix-stable in ``n``) — the system-prompt / few-shot
+    traffic shape whose prefill the engine's radix prefix sharing
+    collapses. Must be shorter than every prompt bucket: each request
+    still carries a unique tail.
     """
 
     seed: int = 0
     prompt_lens: Tuple[int, ...] = (8, 12)
     max_new_tokens: Tuple[int, ...] = (6, 10)
     batch: int = 1
+    shared_prefix_len: int = 0
 
     def __post_init__(self):
         if not self.prompt_lens or not self.max_new_tokens:
             raise ValueError("WorkloadSpec needs at least one prompt_len and max_new_tokens bucket")
         if min(self.prompt_lens) < 1 or min(self.max_new_tokens) < 1 or self.batch < 1:
             raise ValueError("WorkloadSpec buckets and batch must be >= 1")
+        if self.shared_prefix_len < 0:
+            raise ValueError("shared_prefix_len must be >= 0")
+        if self.shared_prefix_len and self.shared_prefix_len >= min(self.prompt_lens):
+            raise ValueError(
+                f"shared_prefix_len {self.shared_prefix_len} must be shorter "
+                f"than every prompt bucket {self.prompt_lens} (each request "
+                "needs a unique tail)"
+            )
 
     def to_dict(self) -> Dict:
-        return {
+        out = {
             "seed": self.seed,
             "prompt_lens": list(self.prompt_lens),
             "max_new_tokens": list(self.max_new_tokens),
             "batch": self.batch,
         }
+        # only stamped when active: pre-Shareline artifacts stay
+        # byte-comparable (diff_load keys comparability on this dict)
+        if self.shared_prefix_len:
+            out["shared_prefix_len"] = self.shared_prefix_len
+        return out
 
     def draw(self, n: int, vocab_size: int) -> List["RequestSpec"]:
         """The first ``n`` requests of this spec's stream (deterministic:
@@ -111,11 +133,18 @@ class WorkloadSpec:
         import numpy as np
 
         rng = np.random.default_rng(self.seed)
+        shared = (
+            rng.integers(0, vocab_size, size=self.shared_prefix_len, dtype=np.int32)
+            if self.shared_prefix_len
+            else None
+        )
         out = []
         for i in range(n):
             prompt_len = int(rng.choice(self.prompt_lens))
             max_new = int(rng.choice(self.max_new_tokens))
             ids = rng.integers(0, vocab_size, size=(self.batch, prompt_len), dtype=np.int32)
+            if shared is not None:
+                ids[:, : self.shared_prefix_len] = shared
             out.append(
                 RequestSpec(
                     index=i,

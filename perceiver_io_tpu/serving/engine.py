@@ -44,6 +44,23 @@ them through ONE compiled batched step:
   re-admits every journaled non-terminal request and resumes it from its
   journaled progress — ``tools/chaos.py serve_evict_storm`` /
   ``serve_crash_recover`` certify both.
+- **cross-request prefix sharing** (Shareline, docs/serving.md
+  #prefix-sharing): every unshared join publishes its prompt's full
+  context-region pages into a radix prefix index
+  (``serving.prefix.PrefixIndex``, page-size token chunks content-hashed);
+  a later request whose prompt matches a resident run joins through
+  ``generation.make_shared_prefill_fn`` — the matched pages' CA rows are
+  gathered straight out of the pool and prefill compute runs over the
+  unshared SUFFIX only, so TTFT collapses and the refcounted allocator
+  (``PageAllocator.alloc_tokens_shared``) holds ONE copy of the shared
+  run. Token-exactness is structural, not approximate: context-region KV
+  rows under rotate-at-write RoPE depend only on (token id, absolute
+  position), the suffix carries ALL latents, and anything outside those
+  conditions falls back to the unshared prefill. Eviction/recovery stay
+  correct for free — a freed sharer only decrements refcounts, a page
+  leaves the pool (and the index, via the ``free``→``expire_pages``
+  seam) at its LAST release — ``tools/chaos.py serve_prefix_storm``
+  certifies streams, single-prefill sharing, and refcount balance.
 """
 
 from __future__ import annotations
@@ -56,6 +73,7 @@ import numpy as np
 
 from perceiver_io_tpu.serving.frontend import FrontEndRecord, RequestFrontEnd, _Ticket
 from perceiver_io_tpu.serving.pages import PageAllocator
+from perceiver_io_tpu.serving.prefix import PrefixIndex
 
 
 @dataclass
@@ -84,6 +102,13 @@ class EngineConfig:
     # slots of slack for the transient pre-rollback span.
     spec_k: int = 0
     spec_depth: int = 1
+    # Shareline cross-request prefix sharing: joining prompts are matched
+    # against the radix prefix index and prefill skips resident pages
+    # (refcounted shared grants). Exactness-gated OFF automatically in
+    # speculative slot mode and for int8 caches (see _share_supported);
+    # this flag is the operator A/B seam — tools/loadgen.py's unshared
+    # baseline leg runs the SAME workload with sharing disabled.
+    prefix_sharing: bool = True
     # Evictline page-pressure preemption: when a queued request COULD fit
     # the pool but the free list is short, reclaim pages from the least-
     # progressed in-flight slot (parked resumable; resumed token-exactly by
@@ -151,6 +176,10 @@ class EngineFrontEnd(RequestFrontEnd):
         sa_pool = 1 + max(2, int(round(ec.slots * self._sa_pages_per_slot * ec.pool_headroom)))
         self.ca_alloc = PageAllocator(ca_pool, ps)
         self.sa_alloc = PageAllocator(sa_pool, ps)
+        # Shareline: the radix prefix index over CA pool pages (SA/latent
+        # rows are never shareable — they pass through q_norm and the SA
+        # stack, so they are request-specific by construction)
+        self.prefix_index = PrefixIndex(ps)
 
         from perceiver_io_tpu.core.modules import CausalSequenceModel
         from perceiver_io_tpu.generation import (
@@ -210,6 +239,15 @@ class EngineFrontEnd(RequestFrontEnd):
                 "engine_decode_step",
             )
         self._prefill_fns: Dict[tuple, object] = {}
+        self._shared_prefill_fns: Dict[tuple, object] = {}
+        # sharing is exactness-gated: OFF for int8 caches (the scale-plane
+        # gather is not implemented — make_shared_prefill_fn raises) and in
+        # speculative slot mode (the drafter pool's shared pages would need
+        # their own publish/commit discipline); both fall back to the
+        # unshared prefill, so sharing is a no-op there, never a risk
+        self._share_supported = (
+            ec.prefix_sharing and not self._spec and not caches[0].quantized
+        )
         self._join_fn = self._tracker.wrap(
             jax.jit(_join_state, donate_argnums=0), "engine_join"
         )
@@ -241,6 +279,13 @@ class EngineFrontEnd(RequestFrontEnd):
         self._m_resumes = r.counter("serve_resumes_total")
         self._m_recovered = r.counter("serve_recovered_total")
         self._m_parked = r.gauge("serve_parked_depth")
+        # Shareline counters (per-tenant labeled like the PR-16 set):
+        # hits = joins whose prefill skipped at least one resident page,
+        # pages_shared = pages those joins did NOT re-prefill
+        self._m_prefix_hits = r.counter("serve_prefix_hits_total")
+        self._m_prefix_pages = r.counter("serve_prefix_pages_shared")
+        self._n_prefix_hits = 0
+        self._n_prefix_pages_shared = 0
         if self._spec:
             # per-request drafter quality, recorded at retire: the A/B
             # inputs the graduation ledger and docs/performance.md cite
@@ -336,6 +381,75 @@ class EngineFrontEnd(RequestFrontEnd):
             self._prefill_fns[key] = self._prefill_fns.pop(key)
         return self._prefill_fns[key]
 
+    def _shared_prefill_for(self, skip_tokens: int, prompt_len: int, max_new: int):
+        """The committed SHARED prefill program for one (skip, prompt,
+        budget) geometry: gathers the matched run's CA rows from the pool
+        and prefills the suffix alone (``generation.make_shared_prefill_fn``
+        — page ids are traced, so one program serves every match of this
+        geometry). LRU-bounded alongside :attr:`_prefill_fns` for the same
+        reason: sustained mixed-geometry load must not grow it without
+        limit."""
+        key = (skip_tokens, prompt_len, max_new)
+        if key not in self._shared_prefill_fns:
+            import dataclasses as _dc
+
+            from perceiver_io_tpu.generation import make_shared_prefill_fn
+
+            cfg = _dc.replace(self._gen_config, max_new_tokens=max_new)
+            kwargs = {} if self.cache_dtype is None else {"cache_dtype": self.cache_dtype}
+            fn = make_shared_prefill_fn(
+                self.model, self.num_latents, skip_tokens, prompt_len, cfg, **kwargs
+            )
+            while len(self._shared_prefill_fns) >= self._PREFILL_CACHE_MAX:
+                self._shared_prefill_fns.pop(next(iter(self._shared_prefill_fns)))
+            self._shared_prefill_fns[key] = self._tracker.wrap(
+                fn, "engine_shared_prefill"
+            )
+        else:
+            self._shared_prefill_fns[key] = self._shared_prefill_fns.pop(key)
+        return self._shared_prefill_fns[key]
+
+    def _match_prefix(self, ticket: _Ticket) -> tuple:
+        """Longest shareable resident run for a joining prompt: the radix
+        match, CAPPED to whole pages inside the request's context region
+        (``skip <= prompt_len - num_latents``) — the suffix must carry ALL
+        latents or the latent set (and the logits) would differ from the
+        unshared prefill's. Empty tuple = join unshared."""
+        if not self._share_supported:
+            return ()
+        rec = ticket.record
+        max_pages = (rec.prompt_len - self.num_latents) // self.engine_config.page_size
+        if max_pages < 1:
+            return ()
+        prompt = np.asarray(ticket.spec.input_ids).reshape(-1).tolist()
+        return self.prefix_index.match(prompt)[:max_pages]
+
+    def _publish_prefix(self, ticket: _Ticket, ca_grant) -> None:
+        """Register a landed request's full context-region pages in the
+        prefix index so later arrivals can share them. Runs AFTER the join
+        committed the device rows (the pages hold real bytes the moment
+        they become matchable). A shared join publishes too: its fresh
+        suffix-context pages EXTEND the resident run; re-inserting the
+        matched head is a no-op."""
+        if not self._share_supported:
+            return
+        rec = ticket.record
+        ps = self.engine_config.page_size
+        n_ctx = (rec.prompt_len - self.num_latents) // ps
+        if n_ctx < 1:
+            return
+        prompt = np.asarray(ticket.spec.input_ids).reshape(-1).tolist()
+        self.prefix_index.insert(prompt[: n_ctx * ps], ca_grant.pages[:n_ctx])
+
+    def _free_ca(self, grant) -> None:
+        """Free a CA grant and EXPIRE the prefix-index entries of every page
+        whose last reference this was — the one seam that keeps a recycled
+        page from ever satisfying a future match. Every CA free in the
+        engine funnels through here (retire, evict, failed joins/resumes)."""
+        released = self.ca_alloc.free(grant)
+        if released:
+            self.prefix_index.expire_pages(released)
+
     def _try_join(self, ticket: _Ticket, slot_id: int) -> bool:
         """Prefill the ticket's request and land it in ``slot_id``. Returns
         False (ticket stays queued) when pages are short RIGHT NOW; raises
@@ -349,12 +463,17 @@ class EngineFrontEnd(RequestFrontEnd):
         # spec_k+1 tokens past the request's budget before rollback
         ca_tokens = rec.prompt_len + rec.max_new_tokens + self._spec_slack
         sa_tokens = self.num_latents + rec.max_new_tokens + self._spec_slack
-        ca_grant = self.ca_alloc.alloc_tokens(ca_tokens)
+        matched = self._match_prefix(ticket)
+        ca_grant = (
+            self.ca_alloc.alloc_tokens_shared(ca_tokens, matched)
+            if matched
+            else self.ca_alloc.alloc_tokens(ca_tokens)
+        )
         if ca_grant is None:
             return False
         sa_grant = self.sa_alloc.alloc_tokens(sa_tokens)
         if sa_grant is None:
-            self.ca_alloc.free(ca_grant)
+            self._free_ca(ca_grant)
             return False
         self._queue.remove(ticket)
         self._set_queue_gauge()
@@ -380,21 +499,38 @@ class EngineFrontEnd(RequestFrontEnd):
         try:
             if self._injector is not None:
                 self._injector.before_attempt(rec.index)
-            prefill = self._prefill_for(rec.max_new_tokens)
             serve_params = (
                 self._injector.params_for(rec.index, self.params)
                 if self._injector is not None
                 else self.params
             )
-            token, pstate = prefill(
-                serve_params,
-                jnp.asarray(ticket.spec.input_ids),
-                None,
-                jax.random.PRNGKey(int(ticket.spec.rng_seed)),
-            )
+            rng = jax.random.PRNGKey(int(ticket.spec.rng_seed))
+            if matched:
+                # Shareline: the matched run's CA rows are already resident
+                # in pool pages — gather them and prefill the suffix alone.
+                # rng handling is IDENTICAL to the unshared prefill (one
+                # split for the first sample), so the stream is token-exact.
+                skip = len(matched) * self.engine_config.page_size
+                shared_prefill = self._shared_prefill_for(
+                    skip, rec.prompt_len, rec.max_new_tokens
+                )
+                ca_pool = self._state["cache"][0]
+                token, pstate = shared_prefill(
+                    serve_params,
+                    jnp.asarray(ticket.spec.input_ids)[:, skip:],
+                    ca_pool.k,
+                    ca_pool.v,
+                    jnp.asarray(matched, jnp.int32),
+                    rng,
+                )
+            else:
+                prefill = self._prefill_for(rec.max_new_tokens)
+                token, pstate = prefill(
+                    serve_params, jnp.asarray(ticket.spec.input_ids), None, rng
+                )
             first = int(token[0])
         except Exception as e:  # noqa: BLE001 — books close, pages return
-            self.ca_alloc.free(ca_grant)
+            self._free_ca(ca_grant)
             self.sa_alloc.free(sa_grant)
             self._tenant_pages_delta(rec, -(ca_grant.n_pages + sa_grant.n_pages))
             rec.error = repr(e)
@@ -420,6 +556,30 @@ class EngineFrontEnd(RequestFrontEnd):
         )
         self._slots[slot_id] = slot
         self._in_flight += 1
+        # publish AFTER the join committed the device rows; a shared join
+        # publishes its suffix-context pages, extending the resident run
+        self._publish_prefix(ticket, ca_grant)
+        if matched:
+            ps = self.engine_config.page_size
+            self._n_prefix_hits += 1
+            self._n_prefix_pages_shared += len(matched)
+            self._m_prefix_hits.inc()
+            self._m_prefix_pages.inc(len(matched))
+            if rec.tenant is not None:
+                self._m_prefix_hits.labels(tenant=rec.tenant).inc()
+                self._m_prefix_pages.labels(tenant=rec.tenant).inc(len(matched))
+            if self.events is not None:
+                row = dict(
+                    request_index=rec.index,
+                    pages_matched=len(matched),
+                    pages_total=-(-rec.prompt_len // ps),
+                    tokens_skipped=len(matched) * ps,
+                )
+                if rec.tenant is not None:
+                    row["tenant"] = rec.tenant
+                if slot.span is not None:
+                    row["span_id"] = slot.span.span_id
+                self.events.emit("serve.prefix_hit", **row)
         if not slot.compiled:
             self._m_ttft.record(slot.ttft_s)
         # the per-token seam fires for token 0 exactly like the sequential
@@ -516,7 +676,7 @@ class EngineFrontEnd(RequestFrontEnd):
         slot = self._slots[slot_id]
         self._slots[slot_id] = None
         self._in_flight -= 1
-        self.ca_alloc.free(slot.ca_grant)
+        self._free_ca(slot.ca_grant)
         self.sa_alloc.free(slot.sa_grant)
         self._tenant_pages_delta(slot.ticket.record,
                                  -(slot.ca_grant.n_pages + slot.sa_grant.n_pages))
@@ -550,7 +710,10 @@ class EngineFrontEnd(RequestFrontEnd):
         self._slots[slot_id] = None
         self._in_flight -= 1
         pages_freed = slot.ca_grant.n_pages + slot.sa_grant.n_pages
-        self.ca_alloc.free(slot.ca_grant)
+        # refcount-aware: a freed sharer only DROPS references — a page
+        # still held by sibling grants stays resident (and indexed), so
+        # evicting one sharer never invalidates the others' page tables
+        self._free_ca(slot.ca_grant)
         self.sa_alloc.free(slot.sa_grant)
         self._tenant_pages_delta(slot.ticket.record, -pages_freed)
         slot.ca_grant = slot.sa_grant = None
@@ -640,7 +803,7 @@ class EngineFrontEnd(RequestFrontEnd):
             return False
         sa_grant = self.sa_alloc.alloc_tokens(sa_tokens)
         if sa_grant is None:
-            self.ca_alloc.free(ca_grant)
+            self._free_ca(ca_grant)
             return False
         slot.ca_grant, slot.sa_grant = ca_grant, sa_grant
         self._tenant_pages_delta(rec, ca_grant.n_pages + sa_grant.n_pages)
@@ -673,7 +836,7 @@ class EngineFrontEnd(RequestFrontEnd):
             token, pstate = prefill(serve_params, jnp.asarray(replay_ids), None, rng)
             first = int(token[0])
         except Exception as e:  # noqa: BLE001 — books close, pages return
-            self.ca_alloc.free(ca_grant)
+            self._free_ca(ca_grant)
             self.sa_alloc.free(sa_grant)
             self._tenant_pages_delta(rec, -(ca_grant.n_pages + sa_grant.n_pages))
             slot.ca_grant = slot.sa_grant = None
@@ -697,6 +860,12 @@ class EngineFrontEnd(RequestFrontEnd):
         )
         self._slots[slot_id] = slot
         self._in_flight += 1
+        # the replay's first (prompt_len - num_latents) rows ARE the fresh
+        # join's context rows (same tokens, same absolute positions), so a
+        # resumed request republishes its prefix run — this is also how
+        # crash RECOVERY rebuilds the index: recovered requests re-enter
+        # through this seam (or a plain join) and repopulate it
+        self._publish_prefix(slot.ticket, ca_grant)
         self._n_resumes += 1
         self._m_resumes.inc()
         if self.journal is not None:
@@ -936,6 +1105,25 @@ class EngineFrontEnd(RequestFrontEnd):
                         return  # keep the queue; pages will come back
                 break  # joined (or terminally booked) — next slot
         self._update_gauges()
+
+    def sharing_audit(self) -> List[str]:
+        """Cross-layer sharing invariants (empty = clean): both allocators'
+        page books — refcount balance included — the prefix index's own
+        structure, and the seam between them: every page the index names
+        must be LIVE in the CA allocator (``free``'s released list drives
+        :meth:`PrefixIndex.expire_pages`, so an indexed page with refcount
+        0 is a leak of exactly that seam). ``serve_prefix_storm`` asserts
+        this both mid-storm and at drain."""
+        problems = (
+            self.ca_alloc.audit() + self.sa_alloc.audit() + self.prefix_index.audit()
+        )
+        for page in self.prefix_index.pages():
+            if self.ca_alloc.refcount(page) < 1:
+                problems.append(
+                    f"prefix index names page {page} with refcount 0 "
+                    "(expire-on-release seam leaked)"
+                )
+        return problems
 
     def _update_gauges(self) -> None:
         active = len(self._active_ids())

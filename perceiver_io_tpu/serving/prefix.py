@@ -1,0 +1,182 @@
+"""Radix prefix index for cross-request KV sharing (Shareline).
+
+Host-side companion to the refcounted ``PageAllocator``: prompts are chunked
+at **page-size granularity**, each full chunk is content-hashed, and the hash
+path is walked through a radix tree whose nodes name the resident pool page
+holding that chunk's cross-attention KV rows. Admission matches an incoming
+prompt against the tree (:meth:`PrefixIndex.match`) and the engine's prefill
+skips every matched page; a request that prefilled unshared publishes its
+context-region pages back (:meth:`PrefixIndex.insert`) so later arrivals can
+share them.
+
+Why page granularity: the paged cache shares whole pages or nothing — a
+page-table entry points at an entire page, so a partially-matching chunk
+cannot be referenced without also aliasing the mismatched tail rows. The
+partial tail chunk of a prompt is therefore never indexed and never matched
+(pinned by tests/test_pages.py).
+
+Why content hashes and not token tuples as keys: the digest is fixed-width
+regardless of page size (the tree stays cheap at page_size 128), and the
+chunk bytes feed ``blake2b`` so two different chunks practically cannot
+collide; the engine additionally only ever shares pages that are live in the
+allocator's books, so a stale match can at worst waste a lookup, never alias
+freed content — :meth:`expire_pages` removes every node naming a page the
+moment the allocator reports it released (``PageAllocator.free`` returns the
+newly-released ids exactly for this call).
+
+Pure bookkeeping: no device arrays, no clocks — like the allocator, the
+index state is a pure function of the insert/match/expire history, which is
+what lets chaos assert index/books agreement at drain.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, Iterable, List, Sequence, Tuple
+
+
+def chunk_key(tokens: Sequence[int]) -> bytes:
+    """Content hash of one page-size token chunk (the radix edge label)."""
+    h = hashlib.blake2b(digest_size=16)
+    for t in tokens:
+        h.update(int(t).to_bytes(8, "little", signed=True))
+    return h.digest()
+
+
+class _Node:
+    __slots__ = ("page", "children", "level", "key")
+
+    def __init__(self, page: int, level: Dict[bytes, "_Node"], key: bytes):
+        self.page = page
+        self.children: Dict[bytes, "_Node"] = {}
+        self.level = level  # the dict this node is registered in
+        self.key = key
+
+
+class PrefixIndex:
+    """Radix tree over page-size chunk hashes -> resident page runs."""
+
+    def __init__(self, page_size: int):
+        if page_size < 1:
+            raise ValueError("page_size must be >= 1")
+        self.page_size = int(page_size)
+        self._root: Dict[bytes, _Node] = {}
+        # page id -> the nodes naming it (a page appears once per distinct
+        # chunk path; republishing the same chunk under a new page moves the
+        # node, so this is a one-to-many map only across paths)
+        self._by_page: Dict[int, List[_Node]] = {}
+        self._nodes = 0
+
+    def __len__(self) -> int:
+        return self._nodes
+
+    def pages(self) -> Tuple[int, ...]:
+        """Pool pages the index currently names (sorted) — the engine's
+        sharing audit cross-checks each against the allocator's refcounts."""
+        return tuple(sorted(self._by_page))
+
+    def chunks(self, tokens: Sequence[int]) -> List[bytes]:
+        """Hash keys of every FULL page-size chunk of ``tokens`` (the
+        partial tail chunk is dropped — page-granularity sharing)."""
+        ps = self.page_size
+        n_full = len(tokens) // ps
+        return [chunk_key(tokens[i * ps : (i + 1) * ps]) for i in range(n_full)]
+
+    def insert(self, tokens: Sequence[int], page_ids: Sequence[int]) -> int:
+        """Register a resident run: chunk ``i`` of ``tokens`` lives in pool
+        page ``page_ids[i]``. Only the covered full chunks are indexed
+        (callers pass the context-region pages of a committed grant).
+        Returns the number of NEW nodes created (0 = the whole run was
+        already indexed). Re-inserting a chunk path under a different page
+        repoints the node at the newer copy."""
+        keys = self.chunks(tokens)[: len(page_ids)]
+        if len(keys) < len(page_ids):
+            raise ValueError(
+                f"{len(page_ids)} pages cover more tokens than the "
+                f"{len(keys)} full chunks of the prompt"
+            )
+        created = 0
+        level = self._root
+        for key, page in zip(keys, page_ids):
+            page = int(page)
+            node = level.get(key)
+            if node is None:
+                node = _Node(page, level, key)
+                level[key] = node
+                self._by_page.setdefault(page, []).append(node)
+                self._nodes += 1
+                created += 1
+            elif node.page != page:
+                old = self._by_page.get(node.page)
+                if old is not None:
+                    old[:] = [n for n in old if n is not node]
+                    if not old:
+                        del self._by_page[node.page]
+                node.page = page
+                self._by_page.setdefault(page, []).append(node)
+            level = node.children
+        return created
+
+    def match(self, tokens: Sequence[int]) -> Tuple[int, ...]:
+        """Longest resident prefix run: pool page ids covering the leading
+        full chunks of ``tokens``, stopping at the first unindexed chunk.
+        Empty tuple = nothing resident (sharing is a no-op)."""
+        pages: List[int] = []
+        level = self._root
+        for key in self.chunks(tokens):
+            node = level.get(key)
+            if node is None:
+                break
+            pages.append(node.page)
+            level = node.children
+        return tuple(pages)
+
+    def expire_pages(self, page_ids: Iterable[int]) -> int:
+        """Remove every run that references a released page: the node naming
+        it AND its whole subtree (deeper chunks are unreachable for matching
+        once an ancestor is gone — a match cannot skip a chunk). Call with
+        ``PageAllocator.free``'s return value so recycled pages can never
+        satisfy a future match. Returns the number of nodes removed."""
+        removed = 0
+        for page in page_ids:
+            for node in list(self._by_page.get(int(page), ())):
+                removed += self._drop_subtree(node)
+            # the nodes dropped their _by_page entries in _drop_subtree
+        return removed
+
+    def _drop_subtree(self, node: _Node) -> int:
+        if node.level.get(node.key) is node:
+            del node.level[node.key]
+        removed = 0
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            refs = self._by_page.get(n.page)
+            if refs is not None:
+                refs[:] = [r for r in refs if r is not n]
+                if not refs:
+                    del self._by_page[n.page]
+            stack.extend(n.children.values())
+            n.children.clear()
+            self._nodes -= 1
+            removed += 1
+        return removed
+
+    def audit(self) -> List[str]:
+        """Index invariants (empty = clean): node count agrees with the
+        tree, and the page map names exactly the pages in the tree."""
+        problems: List[str] = []
+        seen = 0
+        pages: Dict[int, int] = {}
+        stack = list(self._root.values())
+        while stack:
+            n = stack.pop()
+            seen += 1
+            pages[n.page] = pages.get(n.page, 0) + 1
+            stack.extend(n.children.values())
+        if seen != self._nodes:
+            problems.append(f"node counter {self._nodes} != {seen} tree nodes")
+        mapped = {p: len(v) for p, v in self._by_page.items()}
+        if mapped != pages:
+            problems.append(f"page map {mapped} != tree pages {pages}")
+        return problems

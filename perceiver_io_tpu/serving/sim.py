@@ -20,6 +20,13 @@ under a :class:`~perceiver_io_tpu.serving.faultinject.ManualClock`:
   PageAllocator` pair at the engine's pool formulas, so page backpressure,
   Evictline eviction/park/resume and the per-tenant pages-held gauge all
   exercise the shipping allocator;
+- **prefix sharing** — the real Shareline admission path
+  (docs/serving.md#prefix-sharing): the radix :class:`~perceiver_io_tpu.
+  serving.prefix.PrefixIndex`, refcounted shared grants
+  (``alloc_tokens_shared``) and the expire-on-release seam all run
+  verbatim; only the *service charge* is simulated — a matched join's
+  prefill sample is scaled to the UNMATCHED token fraction, because the
+  real engine's shared prefill skips exactly the matched pages' compute;
 - **accounting** — the real books identity (``submitted == terminal +
   queued + in_flight + parked``), journal records, spans and the standard
   event stream, so ``obs_report``/``obs_diff``/``slo`` read a simulated
@@ -128,15 +135,25 @@ class TenantSpec:
     prompt_lens: Tuple[int, ...] = (8, 12)
     max_new_tokens: Tuple[int, ...] = (6, 10)
     seed: int = 0
+    # Shareline: every request of this tenant opens with the same
+    # seeded token run (WorkloadSpec.shared_prefix_len) — the sim's
+    # prefix-skew scenarios model an agent/template tenant whose prompts
+    # share a system preamble
+    shared_prefix_len: int = 0
 
     def __post_init__(self):
         if not self.name:
             raise ValueError("TenantSpec needs a non-empty name")
         if self.rate_rps <= 0 or self.n_requests < 1:
             raise ValueError("TenantSpec needs rate_rps > 0 and n_requests >= 1")
+        if not 0 <= self.shared_prefix_len < min(self.prompt_lens):
+            raise ValueError(
+                f"shared_prefix_len {self.shared_prefix_len} must be >= 0 and "
+                f"< the shortest prompt ({min(self.prompt_lens)})"
+            )
 
     def to_dict(self) -> Dict:
-        return {
+        d = {
             "name": self.name,
             "rate_rps": self.rate_rps,
             "n_requests": self.n_requests,
@@ -144,6 +161,11 @@ class TenantSpec:
             "max_new_tokens": list(self.max_new_tokens),
             "seed": self.seed,
         }
+        # only stamped when set: pre-Shareline SIM artifacts (and their
+        # comparability identities) stay byte-identical
+        if self.shared_prefix_len:
+            d["shared_prefix_len"] = self.shared_prefix_len
+        return d
 
 
 def build_multi_tenant_workload(
@@ -165,7 +187,9 @@ def build_multi_tenant_workload(
     merged: List[Tuple[float, int, object]] = []
     for ti, t in enumerate(tenants):
         wspec = WorkloadSpec(
-            seed=t.seed, prompt_lens=t.prompt_lens, max_new_tokens=t.max_new_tokens
+            seed=t.seed, prompt_lens=t.prompt_lens,
+            max_new_tokens=t.max_new_tokens,
+            shared_prefix_len=t.shared_prefix_len,
         )
         specs = wspec.draw(t.n_requests, vocab_size)
         offsets = arrival_schedule(t.n_requests, t.rate_rps, seed=t.seed + 1)
@@ -255,6 +279,13 @@ class SimEngineFrontEnd(EngineFrontEnd):
         sa_pool = 1 + max(2, int(round(ec.slots * self._sa_pages_per_slot * ec.pool_headroom)))
         self.ca_alloc = PageAllocator(ca_pool, ps)
         self.sa_alloc = PageAllocator(sa_pool, ps)
+        # the real Shareline admission surface (module docstring): radix
+        # index, refcounted shared grants, expire-on-release — the
+        # inherited _match_prefix/_publish_prefix/_free_ca run verbatim
+        from perceiver_io_tpu.serving.prefix import PrefixIndex
+
+        self.prefix_index = PrefixIndex(ps)
+        self._share_supported = bool(ec.prefix_sharing)
         # stubs for the device half the inherited retire/evict paths call
         self._jnp = _StubJnp()
         self._state = None
@@ -283,6 +314,10 @@ class SimEngineFrontEnd(EngineFrontEnd):
         self._m_resumes = r.counter("serve_resumes_total")
         self._m_recovered = r.counter("serve_recovered_total")
         self._m_parked = r.gauge("serve_parked_depth")
+        self._m_prefix_hits = r.counter("serve_prefix_hits_total")
+        self._m_prefix_pages = r.counter("serve_prefix_pages_shared")
+        self._n_prefix_hits = 0
+        self._n_prefix_pages_shared = 0
         self._tenant_pages: Dict[str, int] = {}
         self._admission_checks.append(self._page_fit_check)
 
@@ -297,12 +332,19 @@ class SimEngineFrontEnd(EngineFrontEnd):
 
     def _try_join(self, ticket, slot_id: int) -> bool:
         rec = ticket.record
-        ca_grant = self.ca_alloc.alloc_tokens(rec.prompt_len + rec.max_new_tokens)
+        matched = self._match_prefix(ticket)
+        ca_grant = (
+            self.ca_alloc.alloc_tokens_shared(
+                rec.prompt_len + rec.max_new_tokens, matched
+            )
+            if matched
+            else self.ca_alloc.alloc_tokens(rec.prompt_len + rec.max_new_tokens)
+        )
         if ca_grant is None:
             return False
         sa_grant = self.sa_alloc.alloc_tokens(self.num_latents + rec.max_new_tokens)
         if sa_grant is None:
-            self.ca_alloc.free(ca_grant)
+            self._free_ca(ca_grant)
             return False
         self._queue.remove(ticket)
         self._set_queue_gauge()
@@ -320,8 +362,14 @@ class SimEngineFrontEnd(EngineFrontEnd):
             if rec.tenant is not None:
                 attrs["tenant"] = rec.tenant
             slot.span = Span(name="request", parent_id=None, attrs=attrs)
-        # the sampled prefill IS the service: it advances the timeline
+        # the sampled prefill IS the service: it advances the timeline. A
+        # matched join is charged only the UNMATCHED token fraction — the
+        # real shared prefill skips exactly the matched pages' embed +
+        # CA k/v compute, so its service span shrinks proportionally
         ttft = self.service_model.sample_prefill(self._rng)
+        if matched:
+            skip = len(matched) * self.engine_config.page_size
+            ttft *= (rec.prompt_len - skip) / rec.prompt_len
         self.clock.advance(ttft)
         slot.ttft_s = ttft
         rec.attempts += 1
@@ -332,6 +380,28 @@ class SimEngineFrontEnd(EngineFrontEnd):
             self.journal.append("progress", rec.index, tokens=[0])
         self._slots[slot_id] = slot
         self._in_flight += 1
+        self._publish_prefix(ticket, ca_grant)
+        if matched:
+            ps = self.engine_config.page_size
+            self._n_prefix_hits += 1
+            self._n_prefix_pages_shared += len(matched)
+            self._m_prefix_hits.inc()
+            self._m_prefix_pages.inc(len(matched))
+            if rec.tenant is not None:
+                self._m_prefix_hits.labels(tenant=rec.tenant).inc()
+                self._m_prefix_pages.labels(tenant=rec.tenant).inc(len(matched))
+            if self.events is not None:
+                row = dict(
+                    request_index=rec.index,
+                    pages_matched=len(matched),
+                    pages_total=-(-rec.prompt_len // ps),
+                    tokens_skipped=len(matched) * ps,
+                )
+                if rec.tenant is not None:
+                    row["tenant"] = rec.tenant
+                if slot.span is not None:
+                    row["span_id"] = slot.span.span_id
+                self.events.emit("serve.prefix_hit", **row)
         self._m_ttft.record(ttft)
         self._token_seam(slot, 0)
         return True
@@ -378,7 +448,7 @@ class SimEngineFrontEnd(EngineFrontEnd):
             return False
         sa_grant = self.sa_alloc.alloc_tokens(self.num_latents + rec.max_new_tokens)
         if sa_grant is None:
-            self.ca_alloc.free(ca_grant)
+            self._free_ca(ca_grant)
             return False
         slot.ca_grant, slot.sa_grant = ca_grant, sa_grant
         self._tenant_pages_delta(rec, ca_grant.n_pages + sa_grant.n_pages)
@@ -399,6 +469,9 @@ class SimEngineFrontEnd(EngineFrontEnd):
         self.served_tokens[rec.index].append(0)
         self._slots[slot_id] = slot
         self._in_flight += 1
+        # a resumed request's replayed context is resident again — publish
+        # it, exactly like the real engine's resume path
+        self._publish_prefix(slot.ticket, ca_grant)
         self._n_resumes += 1
         self._m_resumes.inc()
         if self.journal is not None:
@@ -506,6 +579,11 @@ def summarize_sim(
         "books_balanced": books["balanced"],
         "tenants": per_tenant,
     }
+    # Shareline: only stamped when sharing actually happened, so
+    # pre-Shareline SIM artifacts stay byte-identical
+    if fe._n_prefix_hits:
+        summary["prefix_hits"] = fe._n_prefix_hits
+        summary["prefix_pages_shared"] = fe._n_prefix_pages_shared
     ttfts = [float(r.ttft_s) for r in served if r.ttft_s is not None]
     if ttfts:
         summary["ttft_s"] = _pct(ttfts)

@@ -152,9 +152,12 @@ def chaos(args):
     Evictline pair: serve_evict_storm, page-pressure preemption with
     token-exact resume, and serve_crash_recover, a journal-backed engine
     restart with books balanced across it,
-    docs/robustness.md#engine-eviction-and-recovery). Extra args go
-    to tools/chaos.py; ``--scenarios`` takes names or fnmatch globs
-    (e.g. ``--scenarios 'serve_*'``)."""
+    docs/robustness.md#engine-eviction-and-recovery — and the Shareline
+    storm: serve_prefix_storm, N same-prefix requests served off ONE
+    prefill of the shared run, token-exact vs the unshared reference with
+    refcounts balanced at drain, docs/serving.md#prefix-sharing). Extra
+    args go to tools/chaos.py; ``--scenarios`` takes names or fnmatch
+    globs (e.g. ``--scenarios 'serve_*'``)."""
     run(sys.executable, "tools/chaos.py", *args.rest)
 
 
@@ -248,17 +251,24 @@ def perf(args):
     # audits, a planted mid-decode kill inside a live batch, engine gauges
     # on /metrics, and the engine throughput/p99-TPOT ledger floors
     run(sys.executable, "tools/loadgen.py", "--smoke", "--engine")
+    # prefix-sharing leg (Shareline, docs/serving.md#prefix-sharing): the
+    # shared-vs-unshared two-leg A/B in smoke size on the wide gate model —
+    # legs token-bit-exact, refcounts/index drained clean, sharing counters
+    # on /metrics (the full-size measured round is `tasks.py load --prefix`)
+    run(sys.executable, "tools/loadgen.py", "--smoke", "--prefix")
     # spec-decode smoke leg (Specline): greedy token-exactness + rng-chain
     # alignment + acceptance-rate sanity of the speculative draft/verify
     # pair on the tiny gate model (tools/spec_smoke.py)
     run(sys.executable, "tools/spec_smoke.py")
     # serve-chaos smoke leg: kill a request mid-decode through the hardened
-    # front end and audit the books, then tear the ENGINE down mid-decode
-    # and recover it token-exactly from the write-ahead journal (Evictline;
-    # --smoke keeps the recovery leg greedy-only/CI-fast — the full serve_*
-    # family incl. serve_evict_storm runs under `tasks.py chaos`)
+    # front end and audit the books, tear the ENGINE down mid-decode and
+    # recover it token-exactly from the write-ahead journal (Evictline),
+    # and serve a same-prefix storm off ONE shared prefill with refcounts
+    # balanced at drain (Shareline; --smoke keeps the legs greedy-only/
+    # CI-fast — the full serve_* family runs under `tasks.py chaos`)
     run(sys.executable, "tools/chaos.py", "--scenarios",
-        "serve_kill_mid_decode,serve_crash_recover", "--smoke")
+        "serve_kill_mid_decode,serve_crash_recover,serve_prefix_storm",
+        "--smoke")
     # simulation smoke leg (Simline): two tenants at ~1k simulated req/s
     # through the REAL engine front end under a ManualClock — books +
     # fairness + per-tenant /metrics///slo + self-diff, SIM ledger floors

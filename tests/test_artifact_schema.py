@@ -589,6 +589,68 @@ def test_sim_event_vocabulary_and_tenant_pinned(tmp_path):
     assert any("tenant" in p and "string" in p for p in problems)
 
 
+def test_shareline_event_vocabulary_pinned(tmp_path):
+    """The Shareline vocabulary (ISSUE 17): ``serve.prefix_hit`` is a KNOWN
+    kind requiring ``request_index`` / ``pages_matched`` / ``pages_total``
+    (the hit's shape — what fraction of the prompt came for free), with
+    ``tenant`` and ``tokens_skipped`` optional-and-typed, and the prefix leg
+    of ``load.summary`` rides an optional ``prefix`` dict — pre-Shareline
+    streams stay valid, missing required fields fail hard."""
+    from perceiver_io_tpu.obs.events import (
+        _OPTIONAL_FIELD_TYPES,
+        _REQUIRED_FIELDS,
+        EVENT_SCHEMA_VERSION,
+        KNOWN_EVENT_KINDS,
+        validate_events,
+    )
+
+    assert "serve.prefix_hit" in KNOWN_EVENT_KINDS
+    assert set(_REQUIRED_FIELDS["serve.prefix_hit"]) == {
+        "request_index", "pages_matched", "pages_total"
+    }
+    assert _OPTIONAL_FIELD_TYPES["serve.prefix_hit"]["tenant"] == (str,)
+    assert "tokens_skipped" in _OPTIONAL_FIELD_TYPES["serve.prefix_hit"]
+    assert "tokens_skipped" not in _REQUIRED_FIELDS["serve.prefix_hit"]
+    assert _OPTIONAL_FIELD_TYPES["load.summary"]["prefix"] == (dict,)
+    assert "prefix" not in _REQUIRED_FIELDS["load.summary"]
+
+    def write_stream(rows):
+        path = tmp_path / "events.jsonl"
+        with open(path, "w") as f:
+            for row in rows:
+                f.write(json.dumps({"ts": 1.0, "schema_version": EVENT_SCHEMA_VERSION, **row}) + "\n")
+        return str(path)
+
+    summary = {"event": "load.summary", "mode": "closed", "n_requests": 200,
+               "achieved_rps": 100.0}
+    good = write_stream(
+        [
+            {"event": "serve.prefix_hit", "request_index": 7,
+             "pages_matched": 55, "pages_total": 56,
+             "tokens_skipped": 440, "tenant": "acme"},
+            {"event": "serve.prefix_hit", "request_index": 8,
+             "pages_matched": 1, "pages_total": 2},  # bare hit stays valid
+            {**summary, "prefix": {"hit_rate": 0.995, "ttft_p50_ratio": 0.38}},
+            summary,  # pre-Shareline summaries (no prefix block) stay valid
+        ]
+    )
+    warnings_out = []
+    assert validate_events(good, strict_spans=False, warnings_out=warnings_out) == []
+    assert warnings_out == []
+    bad = write_stream([
+        {"event": "serve.prefix_hit", "request_index": 7},
+        {"event": "serve.prefix_hit", "pages_matched": 1, "pages_total": 2,
+         "tenant": 9},
+        {**summary, "prefix": 0.995},
+    ])
+    problems = validate_events(bad, strict_spans=False)
+    assert any("[serve.prefix_hit]: missing field 'pages_matched'" in p for p in problems)
+    assert any("[serve.prefix_hit]: missing field 'pages_total'" in p for p in problems)
+    assert any("[serve.prefix_hit]: missing field 'request_index'" in p for p in problems)
+    assert any("tenant" in p for p in problems), problems
+    assert any("prefix" in p for p in problems), problems
+
+
 def test_sim_rounds_monotone_and_well_formed():
     """SIM_r*.json — the committed discrete-event certification artifacts
     (ISSUE 16): contiguous round numbering and the machine-read surface

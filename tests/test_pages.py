@@ -1,0 +1,428 @@
+"""Shareline tests (ISSUE 17): the refcounted sharing laws of the page
+allocator (shared grants, copy-on-write forks, last-holder-frees, loud
+double-free forensics), the radix prefix index's page-granularity match /
+expire discipline, the engine-level isolation and crash-recovery behavior of
+shared pages, and the ``decode_shared`` pin — the shared-prefill route
+(pool-page gather + suffix-only forward) is BIT-exact equal to the unshared
+full-prompt prefill on the einsum attend route, cache bytes, rng chain and
+sampled stream included (the claim generation.py's ``make_shared_prefill_fn``
+and core/modules.py's ``pos_offset`` seam document)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from perceiver_io_tpu.generation import (
+    GenerationConfig,
+    make_decode_fns,
+    make_shared_prefill_fn,
+)
+from perceiver_io_tpu.models.text import CausalLanguageModel, CausalLanguageModelConfig
+from perceiver_io_tpu.obs.loadgen import WorkloadSpec
+from perceiver_io_tpu.serving import (
+    EngineConfig,
+    EngineFrontEnd,
+    EngineCrash,
+    FaultInjector,
+    PageAllocator,
+    RequestJournal,
+)
+from perceiver_io_tpu.serving.prefix import PrefixIndex
+
+NUM_LATENTS = 4
+VOCAB = 64
+
+
+# ------------------------------------------------------- allocator sharing
+
+
+def test_shared_alloc_refcounts_and_stats():
+    """``alloc_tokens_shared`` bumps each shared page's refcount and takes
+    only the remainder off the free list; the stats surface counts the
+    physically-shared pages; ``refcount``/``holders`` answer per page."""
+    a = PageAllocator(10, 8)
+    g1 = a.alloc_tokens(24)  # 3 pages, sole owner
+    assert g1.n_pages == 3 and g1.shared_pages == ()
+    free0 = a.pages_free
+    g2 = a.alloc_tokens_shared(40, g1.pages[:2])  # 5 pages: 2 shared + 3 fresh
+    assert g2.n_pages == 5 and g2.shared_pages == g1.pages[:2]
+    assert g2.pages[:2] == g1.pages[:2]
+    assert a.pages_free == free0 - 3  # the shared head cost nothing
+    for p in g1.pages[:2]:
+        assert a.refcount(p) == 2
+        assert a.holders(p) == sorted([g1.grant_id, g2.grant_id])
+    assert a.refcount(g1.pages[2]) == 1
+    s = a.stats()
+    assert s.pages_shared == 2 and s.grants == 2
+    assert s.pages_used == 6  # 3 + 3 fresh: shared pages counted once
+    assert a.audit() == []
+
+
+def test_share_append_fork_release():
+    """The share -> append -> fork law: a writer about to dirty a shared
+    tail page forks it (``cow_fork``) — a fresh page lands in the SAME grant
+    position, the original drops to its remaining holder, and the forked
+    grant no longer calls the page shared. Frees then release everything."""
+    a = PageAllocator(10, 8)
+    g1 = a.alloc_tokens(16)
+    g2 = a.alloc_tokens_shared(24, g1.pages)  # shares both, one fresh tail
+    tail = g2.pages[1]  # shared page g2 would append into
+    assert a.refcount(tail) == 2
+    g2b = a.cow_fork(g2, tail)
+    assert g2b is not None and g2b.grant_id == g2.grant_id
+    assert g2b.pages[0] == g2.pages[0] and g2b.pages[2] == g2.pages[2]
+    assert g2b.pages[1] != tail  # fresh page, same position
+    assert g2b.shared_pages == (g2.pages[0],)
+    assert a.refcount(tail) == 1 and a.holders(tail) == [g1.grant_id]
+    assert a.refcount(g2b.pages[1]) == 1
+    assert a.audit() == []
+    # the PRE-fork handle drifted from the books: its free is refused loudly
+    with pytest.raises(ValueError, match="drifted"):
+        a.free(g2)
+    a._violations.clear()  # the rejection above was the point, not a leak
+    released = a.free(g2b)
+    assert set(released) == {g2b.pages[1], g2b.pages[2]}  # g1 still holds [0]
+    assert a.free(g1) and a.pages_used == 0
+    assert a.audit() == [] and a.stats().pages_shared == 0
+
+
+def test_shared_pages_survive_sibling_free():
+    """Share -> evict-sibling isolation: freeing the PUBLISHER releases only
+    its exclusively-held pages — the shared run stays resident (and off the
+    free list) until the last sharer drops it, so a sibling's eviction can
+    never recycle bytes under a live reader."""
+    a = PageAllocator(10, 8)
+    g1 = a.alloc_tokens(24)  # publisher: 3 pages
+    g2 = a.alloc_tokens_shared(16, g1.pages[:2])  # sharer holds the first 2
+    released = a.free(g1)
+    assert released == [g1.pages[2]]  # ONLY the unshared page came back
+    for p in g2.pages:
+        assert a.refcount(p) == 1 and a.holders(p) == [g2.grant_id]
+    assert p not in a._free
+    assert a.audit() == []
+    released = a.free(g2)
+    assert set(released) == set(g2.pages)
+    assert a.pages_used == 0 and a._rc == {}
+
+
+def test_cow_fork_exhausted_pool_is_clean():
+    """A fork with an EMPTY free list cannot proceed: ``None``, never a torn
+    grant — books, refcounts and audit identical before and after (the
+    engine maps this answer to a clean ``kv_pages_exhausted`` shed)."""
+    a = PageAllocator(5, 8)  # 4 allocatable pages
+    g1 = a.alloc_tokens(16)
+    g3 = a.alloc_tokens(8)  # an unrelated neighbor holding headroom
+    g2 = a.alloc_tokens_shared(24, g1.pages)  # takes the last free page
+    assert a.pages_free == 0
+    shared = g2.pages[0]
+    rc_before = dict(a._rc)
+    assert a.cow_fork(g2, shared) is None
+    assert a._rc == rc_before and a._grants[g2.grant_id]["pages"] == list(g2.pages)
+    assert a.audit() == []
+    # the neighbor retiring opens headroom and the same fork now succeeds
+    # (the page is still shared: g1 AND g2 hold it)
+    a.free(g3)
+    assert a.cow_fork(g2, shared) is not None
+    assert a.audit() == []
+
+
+def test_cow_fork_rejects_unshared_and_foreign_pages():
+    a = PageAllocator(10, 8)
+    g1 = a.alloc_tokens(16)
+    with pytest.raises(ValueError, match="not shared"):
+        a.cow_fork(g1, g1.pages[0])  # sole holder appends in place
+    g2 = a.alloc_tokens(8)
+    with pytest.raises(ValueError, match="does not hold"):
+        a.cow_fork(g1, g2.pages[0])
+    assert a.audit() == []
+
+
+def test_double_free_names_pages_and_holders():
+    """The ISSUE 17 forensics fix: a double free is rejected (raised AND
+    recorded) with the grant's PAGE INDICES and each page's CURRENT holders
+    in the violation — the post-mortem reads which sharer still owns what
+    instead of a bare grant id."""
+    a = PageAllocator(10, 8)
+    g1 = a.alloc_tokens(16)
+    g2 = a.alloc_tokens_shared(16, g1.pages[:1])
+    a.free(g1)
+    with pytest.raises(ValueError, match="double free"):
+        a.free(g1)
+    problems = a.audit()
+    assert len(problems) == 1
+    v = problems[0]
+    assert f"pages {list(g1.pages)}" in v
+    # the still-shared page names its surviving holder; the released page
+    # reads as free
+    assert f"page {g1.pages[0]} held by grants [{g2.grant_id}]" in v
+    assert f"page {g1.pages[1]} free" in v
+
+
+def test_shared_alloc_rejections_and_shortfall():
+    """Matcher bugs are loud (shared run too long / duplicated / dead pages);
+    a FRESH-page shortfall is backpressure: ``None`` with nothing bumped."""
+    a = PageAllocator(6, 8)  # 5 allocatable
+    g1 = a.alloc_tokens(16)
+    with pytest.raises(ValueError, match="exceeds the grant"):
+        a.alloc_tokens_shared(8, g1.pages)  # 2 shared into a 1-page grant
+    with pytest.raises(ValueError, match="duplicate"):
+        a.alloc_tokens_shared(24, (g1.pages[0], g1.pages[0]))
+    with pytest.raises(ValueError, match="not live"):
+        a.alloc_tokens_shared(16, (5,))  # free page: recycled-content alias
+    with pytest.raises(ValueError, match="not live"):
+        a.alloc_tokens_shared(16, (0,))  # scratch
+    rc_before = dict(a._rc)
+    free_before = list(a._free)
+    # 2 shared + 4 fresh needed, only 3 free: all-or-nothing None
+    assert a.alloc_tokens_shared(48, g1.pages) is None
+    assert a._rc == rc_before and a._free == free_before
+    assert a.audit() == []
+
+
+# ------------------------------------------------------------- radix index
+
+
+def test_prefix_index_insert_match_roundtrip():
+    idx = PrefixIndex(8)
+    prompt = list(range(20))  # 2 full chunks + a 4-token partial tail
+    assert idx.insert(prompt[:16], [5, 6]) == 2
+    assert idx.match(prompt) == (5, 6)
+    assert idx.match(prompt[:16]) == (5, 6)
+    assert idx.match(prompt[:12]) == (5,)  # one full chunk resident
+    assert idx.pages() == (5, 6)
+    assert len(idx) == 2 and idx.audit() == []
+    # re-inserting the same run creates nothing
+    assert idx.insert(prompt[:16], [5, 6]) == 0
+
+
+def test_prefix_index_partial_tail_never_matches():
+    """Page-granularity sharing: the partial tail chunk is neither indexed
+    nor matched — a prompt agreeing only inside a chunk (or a sub-page
+    prompt) shares nothing, and covering the tail with a page is an error."""
+    idx = PrefixIndex(8)
+    prompt = list(range(20))
+    with pytest.raises(ValueError, match="full chunks"):
+        idx.insert(prompt, [5, 6, 7])  # page 7 would cover the 4-token tail
+    idx.insert(prompt[:16], [5, 6])
+    assert idx.match(prompt[:8] + [99] * 8) == (5,)  # diverges in chunk 2
+    assert idx.match(prompt[:4]) == ()  # sub-page prompt: no full chunk
+    assert idx.match(prompt[:4] + [99] * 8) == ()  # agrees only inside chunk 1
+    assert idx.match([99] + prompt[:8]) == ()  # shifted: different chunk bytes
+
+
+def test_prefix_index_expire_drops_subtree():
+    """Expiring a released page removes its node AND the whole subtree under
+    it (a match cannot skip a chunk), and unknown pages are a no-op — the
+    ``PageAllocator.free`` -> ``expire_pages`` seam."""
+    idx = PrefixIndex(8)
+    prompt = list(range(24))
+    idx.insert(prompt, [3, 4, 5])
+    assert idx.match(prompt) == (3, 4, 5)
+    assert idx.expire_pages([4]) == 2  # the node and its child
+    assert idx.match(prompt) == (3,)
+    assert idx.pages() == (3,) and len(idx) == 1
+    assert idx.expire_pages([99]) == 0
+    assert idx.audit() == []
+
+
+def test_prefix_index_reinsert_repoints_page():
+    """Republishing a chunk path under a NEWER resident page repoints the
+    node (the old copy was released); the page map follows."""
+    idx = PrefixIndex(8)
+    prompt = list(range(16))
+    idx.insert(prompt, [3, 4])
+    assert idx.insert(prompt, [7, 4]) == 0  # repoint, no new nodes
+    assert idx.match(prompt) == (7, 4)
+    assert idx.pages() == (4, 7)
+    assert idx.audit() == []
+
+
+# --------------------------------------------------- engine-level sharing
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    config = CausalLanguageModelConfig(
+        vocab_size=VOCAB, max_seq_len=24, max_latents=8, num_channels=32,
+        num_heads=4, num_self_attention_layers=2, cross_attention_dropout=0.5,
+    )
+    model = CausalLanguageModel(config)
+    ids = np.random.default_rng(0).integers(0, VOCAB, size=(1, 12))
+    params = model.init(jax.random.PRNGKey(0), jnp.asarray(ids), prefix_len=8)
+    return model, params
+
+
+def _engine(model, params, base_config=None, *, max_sa_tokens=16, **kw):
+    # journal/eviction engines need the no-slide bound max_sa_tokens <=
+    # max_latents (8) — budgets <= 4 keep sa_tokens within it
+    return EngineFrontEnd(
+        model, params, num_latents=NUM_LATENTS, base_config=base_config,
+        engine_config=EngineConfig(slots=4, page_size=8, max_ca_tokens=24,
+                                   max_sa_tokens=max_sa_tokens),
+        **kw,
+    )
+
+
+def _shared_specs(n, seed=21):
+    # prompt 16, latents 4 -> context 12: exactly ONE full shareable page
+    wspec = WorkloadSpec(seed=seed, prompt_lens=(16,), max_new_tokens=(3, 4),
+                         shared_prefix_len=8)
+    return wspec.draw(n, VOCAB)
+
+
+def _sequential_tokens(model, params, spec, base_config=None):
+    cfg = dataclasses.replace(
+        base_config or GenerationConfig(), max_new_tokens=spec.max_new_tokens
+    )
+    prefill, step = make_decode_fns(model, NUM_LATENTS, cfg)
+    tok, state = prefill(
+        params, jnp.asarray(spec.input_ids), None, jax.random.PRNGKey(spec.rng_seed)
+    )
+    out = [int(tok[0])]
+    for _ in range(spec.max_new_tokens - 1):
+        state, tok = step(state)
+        out.append(int(tok[0]))
+    return out
+
+
+def test_engine_sharing_token_exact_and_isolated(model_and_params):
+    """Requests sharing a prefix serve token-exact (each equals ITS OWN
+    sequential stream), with the publisher retiring before its sharers —
+    the shared page survives the sibling's retire (refcount, not ownership)
+    and everything drains clean: refcounts balanced, index expired."""
+    model, params = model_and_params
+    fe = _engine(model, params)
+    specs = _shared_specs(6)
+    recs = fe.run_closed(specs, concurrency=6)
+    assert all(r.outcome == "ok" for r in recs), [vars(r) for r in recs]
+    assert fe._n_prefix_hits >= 1, "nothing shared — the test is vacuous"
+    for spec in specs:
+        want = _sequential_tokens(model, params, spec)
+        assert fe.served_tokens[spec.index] == want, spec.index
+    assert fe.books()["balanced"] and fe.audit() == []
+    assert fe.sharing_audit() == []
+    assert fe.ca_alloc.pages_used == 0 and fe.ca_alloc._rc == {}
+    assert fe.prefix_index.pages() == ()
+
+
+def test_recovery_rebuilds_refcounts(model_and_params, tmp_path):
+    """Crash mid-flight with shared-prefix requests in every state (live,
+    queued): the second engine's recovery re-admits them into a FRESH
+    allocator/index and the sharing machinery rebuilds its refcounts from
+    the replays — streams token-exact, refcounts balanced at drain, and the
+    re-served requests SHARE AGAIN (queued recoveries go through the
+    matching join)."""
+    model, params = model_and_params
+    jpath = str(tmp_path / "journal.jsonl")
+    specs = _shared_specs(6, seed=23)
+    fe1 = _engine(model, params, max_sa_tokens=8, journal=jpath,
+                  injector=FaultInjector().crash_at(2, 1))
+    with pytest.raises(EngineCrash):
+        fe1.run_closed(specs, concurrency=6)
+    journal = RequestJournal(jpath)
+    owed = journal.pending()
+    assert len(owed) >= 2, "crash too late — nothing left to share on replay"
+
+    fe2 = _engine(model, params, max_sa_tokens=8)
+    info = fe2.recover(journal)
+    assert info["recovered"] == len(owed)
+    fe2.pump()
+    books = fe2.books()
+    assert books["balanced"] and books["parked"] == 0, books
+    assert fe2.audit() == [] and fe2.sharing_audit() == []
+    assert fe2.ca_alloc.pages_used == 0 and fe2.ca_alloc._rc == {}
+    assert fe2.prefix_index.pages() == ()
+    assert fe2._n_prefix_hits >= 1, "recovered requests never re-shared"
+    served = dict(fe1.served_tokens)
+    served.update(fe2.served_tokens)
+    for spec in specs:
+        want = _sequential_tokens(model, params, spec)
+        assert served.get(spec.index) == want, spec.index
+
+
+# -------------------------------------------------- decode_shared pin
+
+
+@pytest.mark.parametrize("sampling", ["greedy", "temperature"])
+def test_decode_shared_bit_exact(model_and_params, sampling):
+    """THE exactness pin behind Shareline: prefilling only the suffix over
+    CA rows gathered from shared pool pages produces a state BITWISE equal
+    to the full-prompt prefill's — cache bytes, first token, rng — and the
+    decode stream continued from it is token-exact equal, greedy AND
+    temperature. Holds because context-region rows under rotate-at-write
+    RoPE depend only on (token id, absolute position) and both routes run
+    the same einsum attend (``pos_offset`` right-aligns the suffix's
+    positions and causal mask)."""
+    model, params = model_and_params
+    cfg = (
+        GenerationConfig(max_new_tokens=4)
+        if sampling == "greedy"
+        else GenerationConfig(max_new_tokens=4, do_sample=True,
+                              temperature=0.8, top_k=10)
+    )
+    prompt = np.random.default_rng(3).integers(0, VOCAB, size=(1, 20))
+    skip, ps = 16, 8  # 2 full pages, inside the 16-token context region
+    rng = jax.random.PRNGKey(42)
+
+    prefill, step = make_decode_fns(model, NUM_LATENTS, cfg)
+    tok_ref, state_ref = prefill(params, jnp.asarray(prompt), None, rng)
+
+    # the resident pool: the reference's context rows parked in pages 1, 3
+    # of a 5-page pool (id order scrambled on purpose — the gather must
+    # follow page_ids, not arithmetic)
+    ca_ref = state_ref["cache"][0]
+    n_ch = ca_ref.k.shape[-1]
+    pool_k = jnp.zeros((5, ps, n_ch), ca_ref.k.dtype)
+    pool_v = jnp.zeros((5, ps, n_ch), ca_ref.v.dtype)
+    page_ids = jnp.asarray([3, 1], jnp.int32)
+    rows_k = ca_ref.k[0, :skip].reshape(2, ps, n_ch)
+    rows_v = ca_ref.v[0, :skip].reshape(2, ps, n_ch)
+    pool_k = pool_k.at[page_ids].set(rows_k)
+    pool_v = pool_v.at[page_ids].set(rows_v)
+
+    shared_prefill = make_shared_prefill_fn(model, NUM_LATENTS, skip, 20, cfg)
+    tok_sh, state_sh = shared_prefill(
+        params, jnp.asarray(prompt)[:, skip:], pool_k, pool_v, page_ids, rng
+    )
+    assert int(tok_sh[0]) == int(tok_ref[0])
+    # the caches agree BITWISE, CA and every SA layer (exactness, not
+    # tolerance: same bytes in, same einsum, same bytes out)
+    for c_sh, c_ref in zip(state_sh["cache"], state_ref["cache"]):
+        assert np.array_equal(np.asarray(c_sh.k), np.asarray(c_ref.k))
+        assert np.array_equal(np.asarray(c_sh.v), np.asarray(c_ref.v))
+        assert int(c_sh.length) == int(c_ref.length)
+    assert np.array_equal(np.asarray(state_sh["rng"]), np.asarray(state_ref["rng"]))
+
+    # continue decoding from the shared state through the UNSHARED step fn
+    # (the engine's decode path): the streams stay token-exact to the end
+    full_state = dict(
+        state_sh,
+        params=state_ref["params"],
+        ca_start=state_ref["ca_start"],
+        sa_start=state_ref["sa_start"],
+    )
+    ref_state, got, want = state_ref, [int(tok_sh[0])], [int(tok_ref[0])]
+    for _ in range(cfg.max_new_tokens - 1):
+        full_state, tok_s = step(full_state)
+        ref_state, tok_r = step(ref_state)
+        got.append(int(tok_s[0]))
+        want.append(int(tok_r[0]))
+    assert got == want, f"{sampling}: shared {got} != unshared {want}"
+
+
+def test_shared_prefill_rejects_latent_region_match():
+    """A matched run reaching into the latent region is a constructor-time
+    error (latent rows pass through q_norm + the SA stack and are NOT
+    per-token): the engine's match cap makes this unreachable, the fn
+    refuses to exist for such a geometry anyway."""
+    config = CausalLanguageModelConfig(
+        vocab_size=VOCAB, max_seq_len=24, max_latents=8, num_channels=32,
+        num_heads=4, num_self_attention_layers=2, cross_attention_dropout=0.5,
+    )
+    model = CausalLanguageModel(config)
+    with pytest.raises(ValueError, match="latent"):
+        make_shared_prefill_fn(model, NUM_LATENTS, 16, 18,
+                               GenerationConfig(max_new_tokens=2))

@@ -247,13 +247,14 @@ def _events(run_dir, kind):
 
 
 def _assert_span_attributed(run_dir):
-    """Spanline contract (ISSUE 8, extended by Evictline): every
-    fault.*/resume — and every per-request preemption event
-    (``serve.evict``/``serve.resume``/``serve.recover``) — in a chaos run
-    must carry a span_id whose span row is in the same stream: an incident
-    nobody can attribute to its step/request is an incident half-logged.
-    Accepts both layouts (training runs log under ``logs/``, serving
-    scenarios at the run dir root)."""
+    """Spanline contract (ISSUE 8, extended by Evictline and Shareline):
+    every fault.*/resume — and every per-request preemption or sharing
+    event (``serve.evict``/``serve.resume``/``serve.recover``/
+    ``serve.prefix_hit``) — in a chaos run must carry a span_id whose span
+    row is in the same stream: an incident nobody can attribute to its
+    step/request is an incident half-logged. Accepts both layouts
+    (training runs log under ``logs/``, serving scenarios at the run dir
+    root)."""
     path = os.path.join(run_dir, "logs", "events.jsonl")
     if not os.path.exists(path):
         path = os.path.join(run_dir, "events.jsonl")
@@ -264,7 +265,8 @@ def _assert_span_attributed(run_dir):
         r for r in rows
         if r.get("event", "").startswith("fault.")
         or r.get("event") in ("resume", "resume.reshard", "probe.blast",
-                              "serve.evict", "serve.resume", "serve.recover")
+                              "serve.evict", "serve.resume", "serve.recover",
+                              "serve.prefix_hit")
     ]
     for r in audited:
         assert r.get("span_id") in span_ids, (
@@ -1233,6 +1235,77 @@ def scenario_serve_evict_storm(tmp):
         )
 
 
+def scenario_serve_prefix_storm(tmp):
+    """Shareline prefix storm: N requests sharing one page-aligned prompt
+    prefix hit the engine together. Exactly ONE of them prefills the
+    shared run (counter-asserted: N-1 admission hits — the queue never
+    drains mid-storm, so the run stays resident from first publish to
+    last release), every stream is token-exact vs the uninterrupted
+    UNSHARED sequential reference (greedy AND temperature — sharing is an
+    allocator optimization, never an approximation), every hit lands a
+    span-attributed ``serve.prefix_hit`` row, and at drain the refcounts
+    balance: zero pages used, sharing audit clean, the radix index fully
+    expired (no node outlives its pages)."""
+    from perceiver_io_tpu.obs.loadgen import WorkloadSpec
+    from perceiver_io_tpu.serving import EngineConfig, EngineFrontEnd
+
+    model, params = _serving_model()
+    n = 6 if SMOKE else 8
+    for tag, base in _evict_gen_configs():
+        recorder, clock, run_dir = _serve_env(tmp, f"serve_prefix_storm_{tag}")
+        fe = EngineFrontEnd(
+            model, params, num_latents=4, base_config=base,
+            engine_config=EngineConfig(slots=4, page_size=8,
+                                       max_ca_tokens=24, max_sa_tokens=16),
+            events=recorder, clock=clock, sleep=clock.sleep,
+        )
+        # prompt 16, latents 4 => context region 12 tokens => exactly one
+        # full page (8 tokens) is shareable; the 8-token shared prefix
+        # covers it, the 8-token unique tail keeps every stream distinct
+        specs = WorkloadSpec(seed=31, prompt_lens=(16,), max_new_tokens=(3, 4),
+                             shared_prefix_len=8).draw(n, 64)
+        assert len({tuple(s.input_ids[0]) for s in specs}) == n
+        recs = fe.run_closed(specs, concurrency=n)
+        books = _audit_serving(fe, run_dir, f"serve_prefix_storm_{tag}")
+        assert books["ok"] == n and books["shed"] == 0, books
+        assert all(r.outcome == "ok" for r in recs), [vars(r) for r in recs]
+        # exactly one prefill of the shared run: the first join published,
+        # every other admission matched (concurrency == n keeps the run
+        # refcounted end to end — no drain gap, no republish)
+        assert fe._n_prefix_hits == n - 1, (
+            f"serve_prefix_storm[{tag}]: {fe._n_prefix_hits} admission hits "
+            f"for {n} same-prefix requests, want {n - 1} (one publisher)"
+        )
+        assert fe._n_prefix_pages_shared == n - 1, fe._n_prefix_pages_shared
+        # token-exactness: every stream equals the unshared sequential
+        # reference — shared-prefix prefill changed nothing observable
+        for spec in specs:
+            want = _sequential_reference(model, params, spec, base)
+            got = fe.served_tokens[spec.index]
+            assert got == want, (
+                f"serve_prefix_storm[{tag}] request {spec.index}: "
+                f"shared {got} != unshared reference {want}"
+            )
+        # refcounts balanced at drain: nothing leaked, nothing double-freed,
+        # and the index expired with its pages (stale matches impossible)
+        assert fe.sharing_audit() == [], fe.sharing_audit()
+        assert fe.ca_alloc.pages_used == 0 and fe.sa_alloc.pages_used == 0
+        assert fe.ca_alloc.stats().pages_shared == 0
+        assert fe.prefix_index.pages() == (), fe.prefix_index.pages()
+        stream = _stream(run_dir)
+        hit_rows = [e for e in stream if e.get("event") == "serve.prefix_hit"]
+        assert len(hit_rows) == n - 1, (len(hit_rows), n - 1)
+        assert all(0 < e["pages_matched"] <= e["pages_total"] for e in hit_rows)
+        n_attr = _assert_span_attributed(run_dir)
+        assert n_attr >= n - 1, (n_attr, n - 1)
+        print(
+            f"chaos: serve_prefix_storm[{tag}] ok — {n} same-prefix requests, "
+            f"1 prefill of the shared run + {fe._n_prefix_hits} admission "
+            f"hits, all streams token-exact vs the unshared reference, "
+            f"refcounts balanced at drain ({n_attr} events span-attributed)"
+        )
+
+
 def scenario_serve_crash_recover(tmp):
     """Evictline crash recovery: the engine is torn down mid-decode by an
     injected ``EngineCrash`` (a BaseException no accounting seam catches —
@@ -1504,6 +1577,88 @@ def scenario_sim_noisy_neighbor(tmp):
     )
 
 
+def scenario_sim_prefix_skew(tmp):
+    """Simline prefix skew (Shareline at simulated scale): an "agent"
+    tenant whose prompts all open with one shared template prefix shares
+    the engine with an "adhoc" tenant of unique prompts, both offered
+    over the join capacity. The REAL sharing machinery runs (radix index,
+    refcounted grants, expire-on-release) with the service model charging
+    a matched join only its unmatched tokens — so the agent tenant's
+    joins are structurally cheaper. The certification: that cheapness
+    must show up WHERE it belongs (agent TTFT p50 well under adhoc's,
+    every hit tenant-stamped + span-attributed) and NOWHERE else —
+    admission stays demand-proportional (Jain >= 0.9, the
+    ``sim_fairness_jain`` floor's bar), the adhoc tenant is not starved,
+    refcounts balance and the index drains with its pages."""
+    from perceiver_io_tpu.serving import EngineConfig, FrontEndConfig
+    from perceiver_io_tpu.serving.sim import TenantSpec, run_sim
+
+    window = 1.0 if SMOKE else 2.0
+    tenants = [
+        TenantSpec("agent", rate_rps=400.0, n_requests=int(400 * window),
+                   prompt_lens=(16,), max_new_tokens=(4,), seed=71,
+                   shared_prefix_len=8),
+        TenantSpec("adhoc", rate_rps=400.0, n_requests=int(400 * window),
+                   prompt_lens=(16,), max_new_tokens=(4,), seed=72),
+    ]
+    recorder, clock, run_dir = _serve_env(tmp, "sim_prefix_skew")
+    report = run_sim(
+        tenants, service_model=_sim_service_model(),
+        engine_config=EngineConfig(slots=8, page_size=8, max_ca_tokens=24,
+                                   max_sa_tokens=8),
+        config=FrontEndConfig(max_queue=64, admission_projection=False),
+        events=recorder, clock=clock, seed=9,
+    )
+    s = report.summary
+    fe = report.frontend
+    books = _audit_serving(fe, run_dir, "sim_prefix_skew")
+    assert s["books_balanced"] and s["error_rate"] == 0.0, books
+    assert s["shed_rate"] > 0.1, f"no real pressure: shed_rate {s['shed_rate']}"
+    # the sharing was real: most of the agent tenant's admitted requests
+    # matched at admission (the template run stays resident under
+    # continuous pressure; a full-drain republish is the only miss)
+    agent_ok = s["tenants"]["agent"]["ok"]
+    assert fe._n_prefix_hits >= 0.5 * agent_ok, (fe._n_prefix_hits, agent_ok)
+    assert s.get("prefix_hits") == fe._n_prefix_hits, s.get("prefix_hits")
+    # ...attributed to the right tenant: every hit is the agent's, none
+    # the adhoc tenant's (its unique prompts can never match)
+    hits_c = fe.registry.counter("serve_prefix_hits_total")
+    assert hits_c.labels(tenant="agent").value == fe._n_prefix_hits
+    assert hits_c.labels(tenant="adhoc").value == 0
+    # the service-time skew lands where it belongs: matched joins are
+    # charged only their unmatched tokens, so agent TTFT p50 runs well
+    # under adhoc's on the same engine
+    agent_p50 = s["tenants"]["agent"]["ttft_s"]["p50"]
+    adhoc_p50 = s["tenants"]["adhoc"]["ttft_s"]["p50"]
+    assert agent_p50 <= 0.75 * adhoc_p50, (agent_p50, adhoc_p50)
+    # ...and NOT in admission: cheaper joins must not skew fairness below
+    # the committed sim_fairness_jain bar, nor starve the unique tenant
+    assert s["fairness_jain"] >= 0.9, (
+        f"prefix sharing skewed admission: fairness {s['fairness_jain']}, "
+        f"tenants {s['tenants']}"
+    )
+    agent_share = s["tenants"]["agent"]["achieved_rps"] / 400.0
+    adhoc_share = s["tenants"]["adhoc"]["achieved_rps"] / 400.0
+    assert adhoc_share >= 0.65 * agent_share, (
+        f"adhoc tenant starved: share {adhoc_share:.3f} vs agent {agent_share:.3f}"
+    )
+    # refcounts balanced at drain, index expired with its pages
+    assert fe.sharing_audit() == [], fe.sharing_audit()
+    assert fe.ca_alloc.pages_used == 0 and fe.prefix_index.pages() == ()
+    stream = _stream(run_dir)
+    hit_rows = [e for e in stream if e.get("event") == "serve.prefix_hit"]
+    assert len(hit_rows) == fe._n_prefix_hits, (len(hit_rows), fe._n_prefix_hits)
+    assert all(e.get("tenant") == "agent" for e in hit_rows)
+    n_attr = _assert_span_attributed(run_dir)
+    print(
+        f"chaos: sim_prefix_skew ok — {s['n_requests']} requests "
+        f"(shed_rate {s['shed_rate']}), agent hit {fe._n_prefix_hits}x "
+        f"(ttft p50 {agent_p50 * 1e3:.2f}ms vs adhoc {adhoc_p50 * 1e3:.2f}ms), "
+        f"fairness {s['fairness_jain']} held, refcounts balanced, "
+        f"{n_attr} events span-attributed"
+    )
+
+
 SCENARIOS = {
     "preempt": scenario_preempt,
     "preempt_mesh": scenario_preempt_mesh,
@@ -1524,9 +1679,11 @@ SCENARIOS = {
     "serve_engine_pages": scenario_serve_engine_pages,
     "serve_spec_kill_mid_span": scenario_serve_spec_kill_mid_span,
     "serve_evict_storm": scenario_serve_evict_storm,
+    "serve_prefix_storm": scenario_serve_prefix_storm,
     "serve_crash_recover": scenario_serve_crash_recover,
     "sim_tenant_storm": scenario_sim_tenant_storm,
     "sim_noisy_neighbor": scenario_sim_noisy_neighbor,
+    "sim_prefix_skew": scenario_sim_prefix_skew,
 }
 
 

@@ -583,6 +583,325 @@ def run_engine_gate(args) -> int:
             shutil.rmtree(out_dir, ignore_errors=True)
 
 
+def build_prefix_workload():
+    """The prefix gate's model: a WIDE flagship-family geometry (256
+    channels, 8 latents, 448-token prompts). Sharing pays in skipped
+    prefill compute — embed + CA k/v projections over the matched context
+    run — and on the tiny c32 gate model that compute is dispatch noise,
+    so a shared-vs-unshared TTFT ratio measured there would certify
+    nothing. At c256 the unshared prefill is genuinely compute-bound and
+    the 0.5x ratio floor measures the sharing win, not jit overhead."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from perceiver_io_tpu.models.text import CausalLanguageModel, CausalLanguageModelConfig
+
+    config = CausalLanguageModelConfig(
+        vocab_size=256, max_seq_len=512, max_latents=32, num_channels=256,
+        num_heads=8, num_self_attention_layers=2, cross_attention_dropout=0.5,
+    )
+    model = CausalLanguageModel(config)
+    ids = np.random.default_rng(0).integers(0, config.vocab_size, size=(1, 64))
+    params = model.init(jax.random.PRNGKey(0), jnp.asarray(ids), prefix_len=56)
+    return model, params, config
+
+
+def run_prefix_gate(args) -> int:
+    """The PREFIX-SHARING leg (``--prefix``): the Shareline certification
+    run (docs/serving.md#prefix-sharing). A closed-loop workload whose
+    requests share a 440-token prompt prefix is served twice on the same
+    wide-model geometry — once with ``EngineConfig.prefix_sharing`` on
+    (the measured/artifact leg) and once with it off (the baseline leg) —
+    and the gate asserts the sharing machinery end to end:
+
+    1. every request served ok in BOTH legs, and the two legs'
+       token streams are **bit-exact identical** per request (sharing is
+       an allocator/prefill optimization, never an approximation);
+    2. the measured leg actually shared: prefix hit rate >= the
+       ``load_prefix_hit_rate`` ledger floor, ``serve.prefix_hit`` events
+       span-attributed in the validated stream, ``serve_prefix_hits_total``
+       live on ``/metrics``;
+    3. books balanced, page audits clean, the SHARING audit clean
+       (refcount balance + index/books agreement), and the prefix index
+       fully expired at drain — no node may outlive its pages;
+    4. the artifact body carries a ``summary.prefix`` block whose
+       ``ttft_p50_ratio`` (shared / unshared TTFT p50, same geometry)
+       holds the <= 0.5 ``load_shared_ttft_ratio`` ceiling.
+
+    The committed doc deliberately does NOT carry ``summary.engine``: the
+    engine-gate floors (throughput >= 621 tok/s, p99-TPOT <= 5ms) were
+    calibrated on the tiny c32 gate model and keep reading the ``--engine``
+    rounds (LOAD_r02/r03); the wide-model prefix round is judged by its own
+    ``summary.prefix``-matched floors plus the family-wide ok-rate/size
+    floors. The engine figures are still recorded under
+    ``summary.prefix.engine`` for the record."""
+    import dataclasses
+    import time as _time
+
+    from perceiver_io_tpu.obs.events import EventLog, validate_events, write_run_manifest
+    from perceiver_io_tpu.obs.flightrec import FlightRecorder, SLOBounds
+    from perceiver_io_tpu.obs.loadgen import (
+        RequestRecord,
+        WorkloadSpec,
+        build_load_doc,
+        diff_load,
+        format_load_diff,
+        summarize_load,
+    )
+    from perceiver_io_tpu.obs.metrics import MetricsRegistry
+    from perceiver_io_tpu.obs.server import ObsServer
+    from perceiver_io_tpu.serving import EngineConfig, EngineFrontEnd, FrontEndConfig
+
+    out_dir = args.out or tempfile.mkdtemp(prefix="loadgen_prefix_")
+    keep = args.keep or args.out is not None
+    problems: list = []
+    try:
+        n_requests = args.requests
+        spec = WorkloadSpec(
+            seed=args.seed, prompt_lens=(448,), max_new_tokens=(8, 12),
+            shared_prefix_len=440,
+        )
+        print(
+            f"loadgen: PREFIX closed-loop, concurrency {args.concurrency}, "
+            f"{n_requests} requests (prompt 448, shared prefix 440) -> {out_dir}"
+        )
+        model, params, config = build_prefix_workload()
+        specs = spec.draw(n_requests, int(config.vocab_size))
+
+        def engine_cfg(sharing: bool) -> EngineConfig:
+            return EngineConfig(
+                slots=3, page_size=8, max_ca_tokens=460, max_sa_tokens=20,
+                prefix_sharing=sharing,
+            )
+
+        def warm_specs():
+            # per-budget SHARED waves (not one lone request per geometry):
+            # the shared-prefill program only compiles when a wave actually
+            # shares, and warm residency must not leak into the measured
+            # window — the waves drain fully, their run expires, and the
+            # first measured request republishes (hit_rate = (N-1)/N)
+            warm = []
+            for j, m in enumerate(spec.max_new_tokens):
+                ws = WorkloadSpec(
+                    seed=args.seed + 9000 + j, prompt_lens=spec.prompt_lens,
+                    max_new_tokens=(m,), shared_prefix_len=spec.shared_prefix_len,
+                ).draw(3, int(config.vocab_size))
+                warm += [dataclasses.replace(s, index=1_000_000 + 10 * j + k)
+                         for k, s in enumerate(ws)]
+            return warm
+
+        # --- measured leg: sharing ON, fully instrumented -----------------
+        events = EventLog(out_dir, main_process=True)
+        manifest = write_run_manifest(
+            out_dir, model_config=config,
+            extra={"workload_spec": spec.to_dict(), "engine": True, "prefix": True},
+            main_process=True,
+        )
+        recorder = FlightRecorder(
+            events, out_dir=out_dir,
+            slo=SLOBounds(ttft_s=args.ttft_slo, tpot_p99_s=args.tpot_slo),
+        )
+        registry = MetricsRegistry()
+        fe = EngineFrontEnd(
+            model, params, num_latents=8, engine_config=engine_cfg(True),
+            config=FrontEndConfig(snapshot_interval_s=0.25),
+            events=recorder, registry=registry,
+        )
+        warm = warm_specs()
+        fe.run_closed(warm, concurrency=len(warm))
+        n_warm = len(warm)
+        registry.histogram("generate_tpot_s").reset()
+        warm_steps, warm_fill = fe._engine_steps, fe._fill_sum
+        hits0, pages0 = fe._n_prefix_hits, fe._n_prefix_pages_shared
+        with ObsServer(registry=registry, run_dir=out_dir, health=fe.health) as server:
+            t0 = _time.perf_counter()
+            recs = fe.run_closed(specs, concurrency=args.concurrency)
+            duration_s = _time.perf_counter() - t0
+            metrics_text = _fetch(server.url + "/metrics")
+            for counter in ("serve_prefix_hits_total", "serve_prefix_pages_shared"):
+                if counter not in metrics_text:
+                    problems.append(f"/metrics lacks the {counter} counter")
+        hits = fe._n_prefix_hits - hits0
+        pages_shared = fe._n_prefix_pages_shared - pages0
+
+        problems += [f"engine books: {p}" for p in fe.audit()]
+        problems += [f"sharing audit: {p}" for p in fe.sharing_audit()]
+        if fe.ca_alloc.pages_used or fe.sa_alloc.pages_used:
+            problems.append(
+                f"pages leaked after drain: ca={fe.ca_alloc.pages_used} "
+                f"sa={fe.sa_alloc.pages_used}"
+            )
+        if fe.prefix_index.pages():
+            problems.append(
+                f"prefix index names pages after drain: {fe.prefix_index.pages()}"
+            )
+        books = fe.books()
+        if books["ok"] != n_requests + n_warm:
+            problems.append(
+                f"served {books['ok']}/{n_requests} (+{n_warm} warmup) ok: {books}"
+            )
+
+        # --- baseline leg: sharing OFF, same geometry, same workload ------
+        base_reg = MetricsRegistry()
+        fe_base = EngineFrontEnd(
+            model, params, num_latents=8, engine_config=engine_cfg(False),
+            registry=base_reg,
+        )
+        fe_base.run_closed(warm_specs(), concurrency=n_warm)
+        base_reg.histogram("generate_tpot_s").reset()
+        bt0 = _time.perf_counter()
+        base_recs = fe_base.run_closed(specs, concurrency=args.concurrency)
+        base_duration_s = _time.perf_counter() - bt0
+        if fe_base._n_prefix_hits:
+            problems.append(
+                f"baseline leg shared anyway: {fe_base._n_prefix_hits} hits"
+            )
+        base_books = fe_base.books()
+        if base_books["ok"] != n_requests + n_warm:
+            problems.append(f"baseline leg not clean: {base_books}")
+
+        # --- decode_shared consistency: the two legs are bit-exact --------
+        diverged = [
+            s.index for s in specs
+            if fe.served_tokens.get(s.index) != fe_base.served_tokens.get(s.index)
+        ]
+        if diverged:
+            problems.append(
+                f"shared vs unshared token streams diverge for "
+                f"{len(diverged)} requests (first: {diverged[:5]}) — "
+                "prefix sharing must be exact, not approximate"
+            )
+        else:
+            print(
+                f"loadgen: decode_shared consistency — {n_requests} request "
+                "token streams bit-exact across shared/unshared legs"
+            )
+
+        def to_records(raw):
+            return [
+                RequestRecord(
+                    index=r.index, prompt_len=r.prompt_len,
+                    max_new_tokens=r.max_new_tokens, batch=r.batch,
+                    queue_wait_s=r.queue_wait_s or 0.0,
+                    outcome="ok" if r.outcome == "ok" else "error",
+                    compiled=r.compiled, ttft_s=r.ttft_s, decode_s=r.decode_s,
+                    tokens_out=r.tokens_out,
+                )
+                for r in raw
+            ]
+
+        summary = summarize_load(
+            to_records(recs), duration_s, registry=registry, mode="closed",
+            concurrency=args.concurrency,
+        )
+        base_summary = summarize_load(
+            to_records(base_recs), base_duration_s, registry=base_reg,
+            mode="closed", concurrency=args.concurrency,
+        )
+        steps = fe._engine_steps - warm_steps
+        cfg = engine_cfg(True)
+        ratio = summary["ttft_s"]["p50"] / base_summary["ttft_s"]["p50"]
+        summary["prefix"] = {
+            "hit_rate": round(hits / n_requests, 6),
+            "hits": hits,
+            "pages_shared": pages_shared,
+            "tokens_skipped": pages_shared * cfg.page_size,
+            "ttft_p50_shared_s": summary["ttft_s"]["p50"],
+            "ttft_p50_unshared_s": base_summary["ttft_s"]["p50"],
+            "ttft_p50_ratio": round(ratio, 6),
+            "baseline_throughput_tok_s": base_summary["throughput_tok_s"],
+            "engine": {
+                "slots": cfg.slots,
+                "page_size": cfg.page_size,
+                "decode_steps": steps,
+                "batch_fill_frac": round(
+                    (fe._fill_sum - warm_fill) / (steps * cfg.slots), 6
+                ) if steps else 0.0,
+            },
+        }
+        if events is not None:
+            events.emit("load.summary", **summary)
+            registry.maybe_emit(events, min_interval_s=0.0)
+        print(
+            f"loadgen: prefix leg served {summary['n_requests']} in "
+            f"{summary['duration_s']:.2f}s — hit_rate "
+            f"{summary['prefix']['hit_rate']}, ttft p50 "
+            f"{summary['ttft_s']['p50'] * 1e3:.2f}ms shared vs "
+            f"{base_summary['ttft_s']['p50'] * 1e3:.2f}ms unshared "
+            f"(ratio {summary['prefix']['ttft_p50_ratio']})"
+        )
+
+        # --- stream validation: span-attributed serve.prefix_hit rows -----
+        warnings_out: list = []
+        problems += validate_events(out_dir, warnings_out=warnings_out)
+        for w in warnings_out:
+            print(f"loadgen: warning: {w}")
+        from perceiver_io_tpu.obs.events import merged_events
+
+        stream = merged_events(out_dir)
+        hit_rows = [e for e in stream if e.get("event") == "serve.prefix_hit"]
+        # warm waves hit too (2 waves x 2 sharers) — the stream carries both
+        if len(hit_rows) != fe._n_prefix_hits:
+            problems.append(
+                f"{len(hit_rows)} serve.prefix_hit rows, want {fe._n_prefix_hits}"
+            )
+        if hit_rows and not all(e.get("span_id") for e in hit_rows):
+            problems.append("serve.prefix_hit rows missing span attribution")
+        if hit_rows and not all(
+            0 < e["pages_matched"] <= e["pages_total"] for e in hit_rows
+        ):
+            problems.append("serve.prefix_hit rows with impossible page counts")
+
+        doc = build_load_doc(
+            args.round or _next_round(), summary, spec, manifest=manifest,
+        )
+        if "engine" in doc.get("summary", {}):
+            problems.append(
+                "prefix doc must not carry summary.engine (the engine-gate "
+                "floors are calibrated on the c32 gate model)"
+            )
+        self_diff = diff_load(doc, doc)
+        if not (self_diff["comparable"] and self_diff["ok"]):
+            problems.append("run-vs-itself load diff NOT clean: "
+                            + format_load_diff(self_diff))
+
+        if args.write_artifact:
+            floor_fails = check_doc_floors(doc)
+            if floor_fails:
+                problems += [f"refusing to write artifact: {f}" for f in floor_fails]
+            else:
+                path = os.path.join(_REPO, f"LOAD_r{doc['n']:02d}.json")
+                with open(path, "w") as f:
+                    json.dump(doc, f, indent=1, sort_keys=True)
+                    f.write("\n")
+                print(f"loadgen: wrote {path}")
+
+        problems += check_load_floors()
+
+        if problems:
+            print("loadgen: prefix gate FAILED:")
+            for p in problems:
+                print(f"  - {p}")
+            return 1
+        print(
+            "loadgen: prefix OK — "
+            f"hit_rate {summary['prefix']['hit_rate']} at ttft ratio "
+            f"{summary['prefix']['ttft_p50_ratio']} (legs bit-exact, "
+            "refcounts balanced, index drained)"
+        )
+        return 0
+    except Exception as e:  # noqa: BLE001 — CI must see crash != verdict
+        print(f"loadgen: internal error: {e}", file=sys.stderr)
+        import traceback
+
+        traceback.print_exc()
+        return 3
+    finally:
+        if not keep:
+            shutil.rmtree(out_dir, ignore_errors=True)
+
+
 def dataclasses_replace_indices(specs, base: int):
     """Re-index warmup specs far above the measured range so they can never
     collide with measured requests in per-index surfaces (served_tokens,
@@ -679,7 +998,8 @@ def main(argv=None) -> int:
     p.add_argument("--mode", choices=("closed", "open"), default="closed")
     p.add_argument("--requests", type=int, default=None,
                    help="request count (default: 200, or 24 with --smoke)")
-    p.add_argument("--concurrency", type=int, default=4, help="closed-loop inflight")
+    p.add_argument("--concurrency", type=int, default=None,
+                   help="closed-loop inflight (default: 4, or 16 with --prefix)")
     p.add_argument("--rate", type=float, default=None, help="open-loop arrival rate (req/s)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--smoke", action="store_true",
@@ -691,6 +1011,13 @@ def main(argv=None) -> int:
                         "audit (default 400 requests, 24 with --smoke); "
                         "combine with --mode open --rate R for the open-loop "
                         "engine rate leg (LOAD_r03 / engine_open_achieved_rps)")
+    p.add_argument("--prefix", action="store_true",
+                   help="drive the Shareline prefix-sharing certification "
+                        "(docs/serving.md#prefix-sharing): shared-prefix "
+                        "closed loop on a wide model, sharing-on vs "
+                        "sharing-off legs asserted bit-exact, summary.prefix "
+                        "floors (hit rate, 0.5x TTFT ratio); default 200 "
+                        "requests, 24 with --smoke")
     p.add_argument("--slots", type=int, default=8,
                    help="engine decode slots (batched step width)")
     p.add_argument("--out", default=None, help="run dir (default: a temp dir)")
@@ -711,8 +1038,17 @@ def main(argv=None) -> int:
         return run_diff(args)
     if args.requests is None:
         args.requests = 24 if args.smoke else (400 if args.engine else 200)
+    if args.concurrency is None:
+        # the prefix leg wants the admission queue never empty: a drain gap
+        # drops the shared run's last refcount, expires the index, and the
+        # next arrival republishes instead of sharing
+        args.concurrency = 16 if args.prefix else 4
     if args.mode == "open" and not args.rate:
         p.error("--mode open needs --rate")
+    if args.prefix:
+        if args.mode == "open":
+            p.error("--prefix is a closed-loop certification")
+        return run_prefix_gate(args)
     if args.engine:
         return run_engine_gate(args)
     return run_gate(args)

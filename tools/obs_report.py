@@ -334,6 +334,18 @@ def render(run_dir: str, max_compile_rows: int = 20) -> str:
                 f"  batch_size_at_decode: mean {sum(bsz)/len(bsz):.4g}  "
                 f"min {min(bsz):.4g}  max {max(bsz):.4g}  ({len(bsz)} engine requests)"
             )
+        # prefix sharing (Shareline, docs/serving.md#prefix-sharing): hit
+        # rate over the run's requests plus what the hits came to — pages
+        # referenced instead of recomputed, prompt tokens prefill skipped
+        hit_rows = [e for e in events if e.get("event") == "serve.prefix_hit"]
+        if hit_rows:
+            pages_shared = sum(int(h.get("pages_matched", 0)) for h in hit_rows)
+            skipped = sum(int(h.get("tokens_skipped", 0)) for h in hit_rows)
+            lines.append(
+                f"  prefix_hit_rate: {len(hit_rows) / len(reqs):.3f}  "
+                f"({len(hit_rows)}/{len(reqs)} requests, {pages_shared} pages "
+                f"shared, {skipped} prompt tokens skipped)"
+            )
         # per-tenant rollup (Simline, docs/serving.md#multi-tenant-telemetry):
         # tenant-stamped request rows become one line per tenant — outcome
         # rates, TTFT/TPOT percentiles, and the pages-held peak read from
