@@ -20,6 +20,13 @@ the scope/shape rules, :mod:`dataflow` adds a def-use/provenance engine
 the four dataflow rules — ``rng-key-reuse``, ``dead-compute``,
 ``sharding-flow``, ``cross-program-consistency``. Rule catalog and
 allowlist syntax: docs/static-analysis.md.
+
+:mod:`hostgraph` + :mod:`hostrules` extend the same discipline to the
+HOST side (Hostline): AST/CFG analysis of the serving/obs packages with
+the five protocol rules — ``books-exactness``, ``shared-state-race``,
+``clock-discipline``, ``grant-pairing``, ``event-schema`` — behind
+``tools/hostlint.py`` / ``tasks.py hostlint``
+(docs/static-analysis.md#hostlint).
 """
 
 from perceiver_io_tpu.analysis.check import GraphLintError, Report, check
@@ -55,6 +62,20 @@ from perceiver_io_tpu.analysis.graph import (
     iter_ops,
     trace,
 )
+from perceiver_io_tpu.analysis.hostgraph import (
+    CFG,
+    HostGraph,
+    build_cfg,
+    build_host_graph,
+    build_package_graph,
+)
+from perceiver_io_tpu.analysis.hostrules import (
+    HOST_RULES,
+    HostPolicy,
+    default_host_policy,
+    host_check,
+    load_allowlist,
+)
 from perceiver_io_tpu.analysis.memory import MemoryBreakdown, memory_breakdown
 from perceiver_io_tpu.analysis.rules import (
     RULES,
@@ -85,6 +106,16 @@ __all__ = [
     "FingerprintDiff",
     "GraphFingerprint",
     "GraphLintError",
+    "CFG",
+    "HOST_RULES",
+    "HostGraph",
+    "HostPolicy",
+    "build_cfg",
+    "build_host_graph",
+    "build_package_graph",
+    "default_host_policy",
+    "host_check",
+    "load_allowlist",
     "LintPolicy",
     "MemoryBreakdown",
     "OpNode",

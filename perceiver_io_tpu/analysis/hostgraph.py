@@ -1,0 +1,1050 @@
+"""Host-side AST/CFG analysis engine for the serving stack.
+
+graphlint audits every *compiled* program; this module gives the ~4k lines
+of host-side Python around them (``perceiver_io_tpu/serving/`` +
+``perceiver_io_tpu/obs/``) the same treatment at the source level:
+
+- a per-function **control-flow graph** with exception edges — explicit
+  ``raise`` statements always take the exceptional route; statements that
+  *contain a call* take it only while a ``try`` with handlers is lexically
+  active (anything can raise, but modelling that everywhere would drown
+  every rule in phantom paths); ``finally`` bodies are copied per
+  continuation so a normal completion can never leak onto an exceptional
+  path; ``with`` bodies unwind through a synthetic ``<with-exit>`` node;
+- a **call graph** over ``self.method()`` dispatch (through base classes),
+  module functions, constructor calls, and one level of
+  assigned-constructor type inference (``self.x = Registry()`` /
+  ``v = Registry(); v.m()``), with fnmatch-rooted reachability so rules
+  can ask "everything a scrape handler can run";
+- per-class **attribute access records** — read/write/augmented/subscript/
+  container-mutator/iteration kinds, each stamped with the set of
+  ``with self.<lock>:`` guards lexically held at the access.
+
+The engine is deliberately an under-approximation where Python is dynamic
+(callables passed as parameters, getattr, chained-call receivers): a missed
+edge silences a rule, it never invents a violation. Rules that need an edge
+the resolver cannot see declare the target as an entry context instead
+(see ``hostrules.default_host_policy``).
+
+Everything here is pure ``ast`` — no imports of the analyzed code, no
+devices, no jax. ``build_host_graph`` takes ``{module_name: source}`` so
+tests can lint planted fixtures as easily as the CLI lints the tree.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import os
+from dataclasses import dataclass, field, replace
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+# attribute-mutator method names treated as container writes
+_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "update", "pop", "popleft", "remove", "discard", "clear",
+    "setdefault", "sort", "reverse",
+})
+# builtins / methods whose use of an attribute is an iteration-style read
+_ITER_CALLS = frozenset({"dict", "list", "tuple", "set", "frozenset",
+                         "sorted", "sum", "max", "min", "any", "all"})
+_ITER_METHODS = frozenset({"items", "values", "keys", "copy"})
+# wall-clock calls the clock-discipline rule bans inside injectable contexts
+WALL_CLOCK_CALLS = frozenset({"time.monotonic", "time.time", "time.sleep"})
+
+
+def walk_own(fn_node: ast.AST):
+    """``ast.walk`` over a function body that does NOT descend into nested
+    function/class definitions — those are their own FuncInfo, and a rule
+    walking the outer function must not double-attribute their contents."""
+    queue = list(ast.iter_child_nodes(fn_node))
+    while queue:
+        n = queue.pop(0)
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+                          ast.Lambda)):
+            continue
+        queue.extend(ast.iter_child_nodes(n))
+
+
+def _unparse(node: ast.AST, limit: int = 72) -> str:
+    try:
+        s = ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse is total on valid ASTs
+        s = f"<{type(node).__name__}>"
+    s = " ".join(s.split())
+    return s if len(s) <= limit else s[: limit - 1] + "…"
+
+
+# ---------------------------------------------------------------------------
+# CFG
+# ---------------------------------------------------------------------------
+
+NORMAL = "n"
+EXC = "e"
+
+
+@dataclass
+class CFGNode:
+    idx: int
+    label: str
+    lineno: int
+    stmt: Optional[ast.AST] = None
+    succ: List[Tuple[int, str]] = field(default_factory=list)
+
+    @property
+    def synthetic(self) -> bool:
+        return self.stmt is None
+
+
+@dataclass
+class CFG:
+    nodes: List[CFGNode]
+    entry: int
+    exit: int
+    raise_exit: int
+
+    def node(self, idx: int) -> CFGNode:
+        return self.nodes[idx]
+
+    def render_path(self, path: Sequence[int]) -> str:
+        """Human-readable one-line-per-node rendering of a CFG path."""
+        out = []
+        for idx in path:
+            n = self.nodes[idx]
+            if n.lineno <= 0 and n.label in ("<entry>", "<join>"):
+                continue
+            out.append(f"    line {n.lineno}: {n.label}" if n.lineno > 0
+                       else f"    {n.label}")
+        return "\n".join(out)
+
+
+@dataclass
+class _Ctx:
+    """Where control goes on exception / return / break / continue.
+
+    Callables rather than node ids: a ``finally`` wraps each route in a
+    thunk that lazily stamps out a fresh copy of the finally body wired to
+    that route's concrete target, so every continuation kind traverses its
+    own copy and paths of different kinds never cross-contaminate.
+    """
+
+    exc: Callable[[], int]
+    ret: Callable[[], int]
+    brk: Optional[Callable[[], int]] = None
+    cont: Optional[Callable[[], int]] = None
+    in_handler: bool = False  # inside a try that has except handlers
+
+
+class _CFGBuilder:
+    def __init__(self, fn: ast.AST):
+        self.fn = fn
+        self.nodes: List[CFGNode] = []
+        self.entry = self._new("<entry>", getattr(fn, "lineno", 0))
+        self.exit = self._new("<exit>", 0)
+        self.raise_exit = self._new("<raise-exit>", 0)
+        self._finally_memo: Dict[Tuple[int, int], int] = {}
+
+    def _new(self, label: str, lineno: int, stmt: Optional[ast.AST] = None) -> int:
+        n = CFGNode(idx=len(self.nodes), label=label, lineno=lineno, stmt=stmt)
+        self.nodes.append(n)
+        return n.idx
+
+    def _edge(self, a: int, b: int, kind: str = NORMAL) -> None:
+        if (b, kind) not in self.nodes[a].succ:
+            self.nodes[a].succ.append((b, kind))
+
+    def _link(self, ends: Iterable[int], target: int) -> None:
+        for e in ends:
+            self._edge(e, target)
+
+    # -- public -------------------------------------------------------------
+
+    def build(self) -> CFG:
+        ctx = _Ctx(exc=lambda: self.raise_exit, ret=lambda: self.exit)
+        ends = self._seq(self.fn.body, [self.entry], ctx)
+        self._link(ends, self.exit)
+        return CFG(nodes=self.nodes, entry=self.entry, exit=self.exit,
+                   raise_exit=self.raise_exit)
+
+    # -- statement dispatch ---------------------------------------------------
+
+    def _seq(self, stmts: Sequence[ast.stmt], preds: List[int],
+             ctx: _Ctx) -> List[int]:
+        ends = list(preds)
+        for st in stmts:
+            ends = self._stmt(st, ends, ctx)
+        return ends
+
+    def _stmt(self, st: ast.stmt, preds: List[int], ctx: _Ctx) -> List[int]:
+        if isinstance(st, ast.If):
+            return self._if(st, preds, ctx)
+        if isinstance(st, (ast.While,)):
+            return self._while(st, preds, ctx)
+        if isinstance(st, (ast.For, ast.AsyncFor)):
+            return self._for(st, preds, ctx)
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            return self._with(st, preds, ctx)
+        if isinstance(st, ast.Try):
+            return self._try(st, preds, ctx)
+        if isinstance(st, ast.Return):
+            node = self._new(f"<return> {_unparse(st)}", st.lineno, st)
+            self._link(preds, node)
+            self._maybe_call_exc(node, st, ctx)
+            self._edge(node, ctx.ret())
+            return []
+        if isinstance(st, ast.Raise):
+            node = self._new(_unparse(st), st.lineno, st)
+            self._link(preds, node)
+            self._edge(node, ctx.exc(), EXC)
+            return []
+        if isinstance(st, ast.Break):
+            node = self._new("<break>", st.lineno, st)
+            self._link(preds, node)
+            if ctx.brk is not None:
+                self._edge(node, ctx.brk())
+            return []
+        if isinstance(st, ast.Continue):
+            node = self._new("<continue>", st.lineno, st)
+            self._link(preds, node)
+            if ctx.cont is not None:
+                self._edge(node, ctx.cont())
+            return []
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            # nested defs are their own FuncInfo — a bare marker node here,
+            # carrying no AST, so path predicates never see the nested body
+            node = self._new(f"<def {st.name}>", st.lineno)
+            self._link(preds, node)
+            return [node]
+        # simple statement
+        node = self._new(_unparse(st), st.lineno, st)
+        self._link(preds, node)
+        self._maybe_call_exc(node, st, ctx)
+        if isinstance(st, ast.Assert) and ctx.in_handler:
+            self._edge(node, ctx.exc(), EXC)
+        return [node]
+
+    def _maybe_call_exc(self, node: int, st: ast.stmt, ctx: _Ctx) -> None:
+        """Call-containing statements can raise — but only model that while
+        a handler is lexically in scope, so rules don't chase phantom
+        exceptional paths through straight-line code."""
+        if not ctx.in_handler:
+            return
+        if any(isinstance(n, ast.Call) for n in ast.walk(st)):
+            self._edge(node, ctx.exc(), EXC)
+
+    # -- compound statements --------------------------------------------------
+
+    def _header(self, label: str, lineno: int, expr: Optional[ast.expr]) -> int:
+        """Header node for a compound statement: carries ONLY the header
+        expression, never the nested body — a path predicate walking
+        ``node.stmt`` must not see statements that have their own nodes."""
+        stmt = None
+        if expr is not None:
+            stmt = ast.copy_location(ast.Expr(value=expr), expr)
+        return self._new(label, lineno, stmt)
+
+    def _if(self, st: ast.If, preds: List[int], ctx: _Ctx) -> List[int]:
+        test = self._header(f"<if> {_unparse(st.test)}", st.lineno, st.test)
+        self._link(preds, test)
+        self._maybe_call_exc(test, ast.Expr(value=st.test), ctx)
+        body_ends = self._seq(st.body, [test], ctx)
+        if st.orelse:
+            else_ends = self._seq(st.orelse, [test], ctx)
+            return body_ends + else_ends
+        return body_ends + [test]
+
+    def _loop(self, header: int, body: Sequence[ast.stmt],
+              orelse: Sequence[ast.stmt], ctx: _Ctx,
+              infinite: bool) -> List[int]:
+        join = self._new("<loop-exit>", 0)
+        inner = replace(ctx, brk=lambda: join, cont=lambda: header)
+        body_ends = self._seq(body, [header], inner)
+        self._link(body_ends, header)  # back-edge
+        if not infinite:
+            if orelse:
+                else_ends = self._seq(orelse, [header], ctx)
+                self._link(else_ends, join)
+            else:
+                self._edge(header, join)
+        return [join]
+
+    def _while(self, st: ast.While, preds: List[int], ctx: _Ctx) -> List[int]:
+        header = self._header(f"<while> {_unparse(st.test)}", st.lineno, st.test)
+        self._link(preds, header)
+        infinite = isinstance(st.test, ast.Constant) and bool(st.test.value)
+        return self._loop(header, st.body, st.orelse, ctx, infinite)
+
+    def _for(self, st, preds: List[int], ctx: _Ctx) -> List[int]:
+        header = self._header(
+            f"<for> {_unparse(st.target)} in {_unparse(st.iter)}",
+            st.lineno, st.iter)
+        self._link(preds, header)
+        self._maybe_call_exc(header, ast.Expr(value=st.iter), ctx)
+        return self._loop(header, st.body, st.orelse, ctx, infinite=False)
+
+    def _with(self, st, preds: List[int], ctx: _Ctx) -> List[int]:
+        items = ", ".join(_unparse(i.context_expr) for i in st.items)
+        header_expr = ast.copy_location(
+            ast.Tuple(elts=[i.context_expr for i in st.items], ctx=ast.Load()),
+            st.items[0].context_expr)
+        node = self._header(f"<with> {items}", st.lineno, header_expr)
+        self._link(preds, node)
+        self._maybe_call_exc(node, ast.Expr(value=header_expr), ctx)
+        # exceptional unwinding leaves through a synthetic exit (the context
+        # managers' __exit__ chain) before reaching the outer route
+        outer_exc = ctx.exc
+        unwind_memo: List[int] = []
+
+        def exc_via_unwind() -> int:
+            if not unwind_memo:
+                u = self._new(f"<with-exit> {items}", st.lineno)
+                self._edge(u, outer_exc(), EXC)
+                unwind_memo.append(u)
+            return unwind_memo[0]
+
+        inner = replace(ctx, exc=exc_via_unwind)
+        return self._seq(st.body, [node], inner)
+
+    def _try(self, st: ast.Try, preds: List[int], ctx: _Ctx) -> List[int]:
+        outer = ctx
+        if st.finalbody:
+            fin = st.finalbody
+
+            def wrap(route: Optional[Callable[[], int]]):
+                if route is None:
+                    return None
+
+                def thunk() -> int:
+                    return self._finally_copy(fin, route(), outer)
+
+                return thunk
+
+            outer = replace(ctx, exc=wrap(ctx.exc), ret=wrap(ctx.ret),
+                            brk=wrap(ctx.brk), cont=wrap(ctx.cont))
+
+        if st.handlers:
+            dispatch = self._new("<except-dispatch>", st.lineno)
+            inner = replace(outer, exc=lambda: dispatch, in_handler=True)
+            body_ends = self._seq(st.body, list(preds), inner)
+            if st.orelse:
+                body_ends = self._seq(st.orelse, body_ends, outer)
+            ends = list(body_ends)
+            catch_all = False
+            for h in st.handlers:
+                label = f"<except> {_unparse(h.type) if h.type else ''}".rstrip()
+                hnode = self._header(label, h.lineno, h.type)
+                self._edge(dispatch, hnode, EXC)
+                ends += self._seq(h.body, [hnode], outer)
+                if h.type is None or (
+                    isinstance(h.type, ast.Name)
+                    and h.type.id == "BaseException"
+                ):
+                    catch_all = True
+            if not catch_all:
+                self._edge(dispatch, outer.exc(), EXC)
+        else:
+            body_ends = self._seq(st.body, list(preds), outer)
+            ends = body_ends
+
+        if st.finalbody:
+            # normal completion runs the finally inline toward whatever
+            # statement follows — build one copy now and let its open ends
+            # be ours
+            fentry = self._new("<finally>", st.finalbody[0].lineno)
+            self._link(ends, fentry)
+            ends = self._seq(st.finalbody, [fentry], ctx)
+        return ends
+
+    def _finally_copy(self, fin: Sequence[ast.stmt], target: int,
+                      ctx: _Ctx) -> int:
+        """A fresh copy of the finally body whose ends flow to ``target``.
+        Memoized per (finally-block, target): each continuation kind gets
+        exactly one copy."""
+        key = (id(fin), target)
+        if key in self._finally_memo:
+            return self._finally_memo[key]
+        fentry = self._new("<finally>", fin[0].lineno)
+        self._finally_memo[key] = fentry
+        ends = self._seq(fin, [fentry], ctx)
+        kind = EXC if target == self.raise_exit else NORMAL
+        for e in ends:
+            self._edge(e, target, kind)
+        return fentry
+
+
+def build_cfg(fn: ast.AST) -> CFG:
+    return _CFGBuilder(fn).build()
+
+
+# ---------------------------------------------------------------------------
+# path enumeration
+# ---------------------------------------------------------------------------
+
+def iter_paths(cfg: CFG, start: int, ends: Set[int], *,
+               max_paths: int = 64, max_steps: int = 20000):
+    """Yield simple paths (node-id tuples) from ``start`` to any node in
+    ``ends``. Cycles are skipped (each node at most once per path); the
+    search is bounded by ``max_paths`` emitted and ``max_steps`` expansions,
+    so a pathological CFG degrades to under-approximation, never a hang."""
+    emitted = 0
+    steps = 0
+    stack: List[Tuple[int, Tuple[int, ...], frozenset]] = [
+        (start, (start,), frozenset((start,)))
+    ]
+    while stack and emitted < max_paths and steps < max_steps:
+        node, path, seen = stack.pop()
+        steps += 1
+        if node in ends:
+            emitted += 1
+            yield path
+            continue
+        for nxt, _kind in reversed(cfg.nodes[node].succ):
+            if nxt in seen:
+                continue
+            stack.append((nxt, path + (nxt,), seen | {nxt}))
+
+
+def count_hits_per_path(cfg: CFG, start: int, ends: Set[int],
+                        is_hit: Callable[[int], bool], *,
+                        max_paths: int = 64):
+    """For each simple path start→ends, yield (path, number of hit nodes on
+    it, counting ``start`` itself)."""
+    for path in iter_paths(cfg, start, ends, max_paths=max_paths):
+        yield path, sum(1 for idx in path if is_hit(idx))
+
+
+# ---------------------------------------------------------------------------
+# per-function facts
+# ---------------------------------------------------------------------------
+
+@dataclass
+class AttrAccess:
+    attr: str
+    kind: str          # read|subread|iterread|write|augwrite|subwrite|mutcall
+    lineno: int
+    locks: frozenset   # names of self.<lock> attrs lexically held (with-stack)
+    func: "FuncInfo" = None  # back-reference, filled by the collector
+
+    WRITE_KINDS = ("write", "augwrite", "subwrite", "mutcall")
+    CONTAINER_KINDS = ("subwrite", "mutcall", "iterread")
+
+    @property
+    def is_write(self) -> bool:
+        return self.kind in self.WRITE_KINDS
+
+    @property
+    def site(self) -> str:
+        f = self.func
+        where = f"{f.module}:{f.qualname}" if f is not None else "?"
+        held = ",".join(sorted(self.locks)) if self.locks else "no lock"
+        return f"{where}:{self.lineno} [{self.kind}; {held}]"
+
+
+@dataclass
+class CallRef:
+    dotted: str        # "self.m", "self.attr.m", "Name", "mod.Name", "v.m"
+    node: ast.Call
+    lineno: int
+
+
+@dataclass
+class TimeRef:
+    name: str          # e.g. "time.monotonic"
+    lineno: int
+    kind: str          # "call" | "default"
+
+
+@dataclass
+class FuncInfo:
+    module: str
+    qualname: str
+    name: str
+    node: ast.AST
+    cls: Optional[str]            # enclosing class name (lexically)
+    params: Tuple[str, ...]
+    cfg: CFG = None
+    accesses: List[AttrAccess] = field(default_factory=list)
+    calls: List[CallRef] = field(default_factory=list)
+    time_refs: List[TimeRef] = field(default_factory=list)
+    var_types: Dict[str, str] = field(default_factory=dict)  # local -> class name
+
+    @property
+    def key(self) -> str:
+        return f"{self.module}:{self.qualname}"
+
+    @property
+    def is_init(self) -> bool:
+        return self.name == "__init__"
+
+
+@dataclass
+class ClassInfo:
+    module: str
+    name: str
+    bases: Tuple[str, ...]            # raw base names (last dotted segment)
+    methods: Dict[str, str] = field(default_factory=dict)   # name -> func key
+    attr_types: Dict[str, Set[str]] = field(default_factory=dict)  # attr -> class names
+
+    @property
+    def key(self) -> str:
+        return f"{self.module}:{self.name}"
+
+
+def _dotted(node: ast.expr) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+def _constructor_names(expr: ast.expr) -> List[str]:
+    """Class names (last dotted segment, capitalized convention) that
+    ``expr`` may evaluate to a fresh instance of. Follows IfExp/BoolOp
+    branches — the ``registry if registry is not None else MetricsRegistry()``
+    idiom."""
+    out: List[str] = []
+    if isinstance(expr, ast.Call):
+        d = _dotted(expr.func)
+        if d:
+            last = d.split(".")[-1]
+            if last[:1].isupper():
+                out.append(last)
+    elif isinstance(expr, ast.IfExp):
+        out += _constructor_names(expr.body) + _constructor_names(expr.orelse)
+    elif isinstance(expr, ast.BoolOp):
+        for v in expr.values:
+            out += _constructor_names(v)
+    return out
+
+
+class _FnScan:
+    """Collect attribute accesses (with lock context), call references,
+    wall-clock references and local constructor types for one function."""
+
+    def __init__(self, info: FuncInfo):
+        self.info = info
+        self.locks: List[str] = []
+
+    # -- helpers -------------------------------------------------------------
+
+    def _self_attr(self, node: ast.expr) -> Optional[str]:
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            return node.attr
+        return None
+
+    def _rec(self, attr: str, kind: str, lineno: int) -> None:
+        self.info.accesses.append(AttrAccess(
+            attr=attr, kind=kind, lineno=lineno,
+            locks=frozenset(self.locks), func=self.info))
+
+    # -- driver --------------------------------------------------------------
+
+    def run(self) -> None:
+        for st in self.info.node.body:
+            self._stmt(st)
+        self._defaults()
+
+    def _defaults(self) -> None:
+        a = self.info.node.args
+        for d in list(a.defaults) + [d for d in a.kw_defaults if d is not None]:
+            name = _dotted(d)
+            if name in WALL_CLOCK_CALLS:
+                self.info.time_refs.append(
+                    TimeRef(name=name, lineno=d.lineno, kind="default"))
+
+    def _stmt(self, st: ast.stmt) -> None:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return  # nested defs are their own FuncInfo
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            pushed = 0
+            for item in st.items:
+                a = self._self_attr(item.context_expr)
+                if a is not None:
+                    self.locks.append(a)
+                    pushed += 1
+                else:
+                    self._expr(item.context_expr)
+            for s in st.body:
+                self._stmt(s)
+            for _ in range(pushed):
+                self.locks.pop()
+            return
+        if isinstance(st, ast.Assign):
+            self._expr(st.value)
+            for t in st.targets:
+                self._target(t)
+            self._infer(st)
+            return
+        if isinstance(st, ast.AnnAssign):
+            if st.value is not None:
+                self._expr(st.value)
+            if st.target is not None:
+                self._target(st.target)
+            return
+        if isinstance(st, ast.AugAssign):
+            self._expr(st.value)
+            a = self._self_attr(st.target)
+            if a is not None:
+                self._rec(a, "augwrite", st.lineno)
+            elif isinstance(st.target, ast.Subscript):
+                base = self._self_attr(st.target.value)
+                if base is not None:
+                    self._rec(base, "subwrite", st.lineno)
+                    self._expr(st.target.slice)
+                else:
+                    self._expr(st.target)
+            return
+        if isinstance(st, ast.Delete):
+            for t in st.targets:
+                if isinstance(t, ast.Subscript):
+                    base = self._self_attr(t.value)
+                    if base is not None:
+                        self._rec(base, "subwrite", st.lineno)
+                        continue
+                self._expr(t)
+            return
+        if isinstance(st, (ast.For, ast.AsyncFor)):
+            a = self._self_attr(st.iter)
+            if a is not None:
+                self._rec(a, "iterread", st.lineno)
+            else:
+                self._expr(st.iter)
+            for s in st.body + st.orelse:
+                self._stmt(s)
+            return
+        if isinstance(st, (ast.If, ast.While)):
+            self._expr(st.test)
+            for s in st.body + st.orelse:
+                self._stmt(s)
+            return
+        if isinstance(st, ast.Try):
+            for s in st.body + st.orelse + st.finalbody:
+                self._stmt(s)
+            for h in st.handlers:
+                for s in h.body:
+                    self._stmt(s)
+            return
+        # Return / Expr / Raise / Assert / anything expression-bearing
+        for child in ast.iter_child_nodes(st):
+            if isinstance(child, ast.expr):
+                self._expr(child)
+            elif isinstance(child, ast.stmt):
+                self._stmt(child)
+
+    def _target(self, t: ast.expr) -> None:
+        a = self._self_attr(t)
+        if a is not None:
+            self._rec(a, "write", t.lineno)
+            return
+        if isinstance(t, ast.Subscript):
+            base = self._self_attr(t.value)
+            if base is not None:
+                self._rec(base, "subwrite", t.lineno)
+                self._expr(t.slice)
+                return
+            self._expr(t.value)
+            self._expr(t.slice)
+            return
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for el in t.elts:
+                self._target(el)
+            return
+        if isinstance(t, ast.Starred):
+            self._target(t.value)
+            return
+        if isinstance(t, ast.Attribute):
+            self._expr(t.value)
+
+    def _infer(self, st: ast.Assign) -> None:
+        names = _constructor_names(st.value)
+        if not names:
+            # v2 = v1 propagates a previously inferred local type
+            if isinstance(st.value, ast.Name):
+                names = ([self.info.var_types[st.value.id]]
+                         if st.value.id in self.info.var_types else [])
+        for t in st.targets:
+            if isinstance(t, ast.Name) and names:
+                self.info.var_types[t.id] = names[0]
+
+    # -- expressions ---------------------------------------------------------
+
+    def _expr(self, e: ast.expr) -> None:
+        if e is None:
+            return
+        if isinstance(e, ast.Call):
+            self._call(e)
+            return
+        a = self._self_attr(e)
+        if a is not None:
+            self._rec(a, "read", e.lineno)
+            return
+        if isinstance(e, ast.Subscript):
+            base = self._self_attr(e.value)
+            if base is not None:
+                self._rec(base, "subread", e.lineno)
+                self._expr(e.slice)
+                return
+        if isinstance(e, (ast.GeneratorExp, ast.ListComp, ast.SetComp,
+                          ast.DictComp)):
+            for gen in e.generators:
+                a = self._self_attr(gen.iter)
+                if a is not None:
+                    self._rec(a, "iterread", gen.iter.lineno)
+                else:
+                    self._expr(gen.iter)
+                for cond in gen.ifs:
+                    self._expr(cond)
+            if isinstance(e, ast.DictComp):
+                self._expr(e.key)
+                self._expr(e.value)
+            else:
+                self._expr(e.elt)
+            return
+        for child in ast.iter_child_nodes(e):
+            if isinstance(child, ast.expr):
+                self._expr(child)
+
+    def _call(self, c: ast.Call) -> None:
+        dotted = _dotted(c.func)
+        if dotted:
+            self.info.calls.append(CallRef(dotted=dotted, node=c,
+                                           lineno=c.lineno))
+            if dotted in WALL_CLOCK_CALLS:
+                self.info.time_refs.append(
+                    TimeRef(name=dotted, lineno=c.lineno, kind="call"))
+        func = c.func
+        # container mutator / iteration-method on a self attr
+        if isinstance(func, ast.Attribute):
+            base = self._self_attr(func.value)
+            if base is not None:
+                if func.attr in _MUTATORS:
+                    self._rec(base, "mutcall", c.lineno)
+                elif func.attr in _ITER_METHODS:
+                    self._rec(base, "iterread", c.lineno)
+                # self.attr.method(): receiving attr is at least read
+                else:
+                    self._rec(base, "read", c.lineno)
+            else:
+                self._expr(func.value)
+        elif isinstance(func, ast.Name):
+            if func.id in _ITER_CALLS or func.id == "len":
+                kind = "iterread" if func.id in _ITER_CALLS else "read"
+                for arg in c.args:
+                    a = self._self_attr(arg)
+                    if a is not None:
+                        self._rec(a, kind, c.lineno)
+                    else:
+                        self._expr(arg)
+                for kw in c.keywords:
+                    self._expr(kw.value)
+                return
+        else:
+            self._expr(func)
+        for arg in c.args:
+            if isinstance(arg, ast.Starred):
+                self._expr(arg.value)
+            else:
+                self._expr(arg)
+        for kw in c.keywords:
+            self._expr(kw.value)
+
+
+# ---------------------------------------------------------------------------
+# module / graph collection
+# ---------------------------------------------------------------------------
+
+@dataclass
+class HostGraph:
+    funcs: Dict[str, FuncInfo] = field(default_factory=dict)
+    classes: Dict[str, ClassInfo] = field(default_factory=dict)
+    call_edges: Dict[str, Set[str]] = field(default_factory=dict)
+    # name indexes (last-segment, unique wins)
+    _class_by_name: Dict[str, List[str]] = field(default_factory=dict)
+    _func_by_name: Dict[str, List[str]] = field(default_factory=dict)
+    _cluster: Dict[str, str] = field(default_factory=dict)  # class key -> root
+
+    # -- class hierarchy -----------------------------------------------------
+
+    def cluster_root(self, class_key: str) -> str:
+        seen = set()
+        k = class_key
+        while k in self._cluster and self._cluster[k] != k and k not in seen:
+            seen.add(k)
+            k = self._cluster[k]
+        return k
+
+    def cluster_classes(self, class_key: str) -> List[ClassInfo]:
+        root = self.cluster_root(class_key)
+        return [c for k, c in self.classes.items()
+                if self.cluster_root(k) == root]
+
+    def mro_resolve(self, class_key: str, method: str) -> Optional[str]:
+        """Resolve ``self.method`` for an instance of ``class_key`` —
+        own class first, then bases, then (over-approximately) any class
+        in the inheritance cluster (an instance of a subclass dispatches
+        to its override)."""
+        seen: Set[str] = set()
+        queue = [class_key]
+        while queue:
+            k = queue.pop(0)
+            if k in seen or k not in self.classes:
+                continue
+            seen.add(k)
+            c = self.classes[k]
+            if method in c.methods:
+                return c.methods[method]
+            for b in c.bases:
+                for cand in self._class_by_name.get(b, []):
+                    queue.append(cand)
+        for c in self.cluster_classes(class_key):
+            if method in c.methods:
+                return c.methods[method]
+        return None
+
+    def cluster_attr_types(self, class_key: str, attr: str) -> Set[str]:
+        out: Set[str] = set()
+        for c in self.cluster_classes(class_key):
+            out |= c.attr_types.get(attr, set())
+        return out
+
+    def class_key_of(self, fn: FuncInfo) -> Optional[str]:
+        if fn.cls is None:
+            return None
+        return f"{fn.module}:{fn.cls}"
+
+    def _class_by_simple_name(self, name: str) -> Optional[str]:
+        keys = self._class_by_name.get(name, [])
+        return keys[0] if len(keys) == 1 else None
+
+    # -- reachability --------------------------------------------------------
+
+    def match(self, patterns: Sequence[str]) -> List[FuncInfo]:
+        out = []
+        for f in self.funcs.values():
+            for p in patterns:
+                if (fnmatch.fnmatch(f.key, p)
+                        or fnmatch.fnmatch(f.qualname, p)):
+                    out.append(f)
+                    break
+        return out
+
+    def reachable(self, patterns: Sequence[str]) -> Set[str]:
+        return set(self.reachable_map(patterns))
+
+    def reachable_map(self, patterns: Sequence[str]) -> Dict[str, Optional[str]]:
+        """BFS closure over call edges from every function matching
+        ``patterns``; maps each reached key to its first-discovered caller
+        (``None`` for roots) so findings can render an entry chain."""
+        parents: Dict[str, Optional[str]] = {}
+        queue = []
+        for f in self.match(patterns):
+            if f.key not in parents:
+                parents[f.key] = None
+                queue.append(f.key)
+        while queue:
+            k = queue.pop(0)
+            for nxt in sorted(self.call_edges.get(k, ())):
+                if nxt not in parents:
+                    parents[nxt] = k
+                    queue.append(nxt)
+        return parents
+
+    def chain(self, parents: Dict[str, Optional[str]], key: str) -> List[str]:
+        """Entry-context call chain root→…→key recorded by
+        :meth:`reachable_map`."""
+        out = [key]
+        seen = {key}
+        while parents.get(out[-1]) is not None:
+            nxt = parents[out[-1]]
+            if nxt in seen:
+                break
+            out.append(nxt)
+            seen.add(nxt)
+        return list(reversed(out))
+
+    # -- call resolution -----------------------------------------------------
+
+    def finalize(self) -> "HostGraph":
+        # cluster classes via union on (class, resolvable base) pairs
+        parent: Dict[str, str] = {k: k for k in self.classes}
+
+        def find(x: str) -> str:
+            while parent[x] != x:
+                parent[x] = parent[parent[x]]
+                x = parent[x]
+            return x
+
+        def union(a: str, b: str) -> None:
+            ra, rb = find(a), find(b)
+            if ra != rb:
+                parent[rb] = ra
+
+        for key, cls in self.classes.items():
+            for b in cls.bases:
+                bk = self._class_by_simple_name(b)
+                if bk is not None:
+                    union(bk, key)
+        self._cluster = {k: find(k) for k in self.classes}
+
+        for fn in self.funcs.values():
+            edges = self.call_edges.setdefault(fn.key, set())
+            cls_key = self.class_key_of(fn)
+            for ref in fn.calls:
+                for target in self.resolve_call(fn, cls_key, ref.dotted):
+                    edges.add(target)
+        return self
+
+    def resolve_call(self, fn: FuncInfo, cls_key: Optional[str],
+                     dotted: str) -> List[str]:
+        """Function keys a dotted call text may dispatch to from ``fn``."""
+        parts = dotted.split(".")
+        out: List[str] = []
+        if parts[0] == "self" and cls_key is not None:
+            if len(parts) == 2:
+                t = self.mro_resolve(cls_key, parts[1])
+                if t:
+                    out.append(t)
+            elif len(parts) == 3:
+                # self.attr.method() through inferred attribute types
+                for tname in self.cluster_attr_types(cls_key, parts[1]):
+                    tkey = self._class_by_simple_name(tname)
+                    if tkey:
+                        t = self.mro_resolve(tkey, parts[2])
+                        if t:
+                            out.append(t)
+            return out
+        if len(parts) == 1:
+            name = parts[0]
+            # local constructor-typed variable is handled below; plain names:
+            mk = f"{fn.module}:{name}"
+            if mk in self.funcs:
+                out.append(mk)
+            else:
+                ck = self._class_by_simple_name(name)
+                if ck is not None:
+                    init = self.mro_resolve(ck, "__init__")
+                    if init:
+                        out.append(init)
+                elif len(self._func_by_name.get(name, [])) == 1:
+                    out.append(self._func_by_name[name][0])
+            return out
+        if len(parts) == 2:
+            base, meth = parts
+            if base in fn.var_types:
+                tkey = self._class_by_simple_name(fn.var_types[base])
+                if tkey:
+                    t = self.mro_resolve(tkey, meth)
+                    if t:
+                        out.append(t)
+                return out
+            # mod.Class(...) or mod.func(...) — match the final segment
+            ck = self._class_by_simple_name(meth)
+            if ck is not None and meth[:1].isupper():
+                init = self.mro_resolve(ck, "__init__")
+                if init:
+                    out.append(init)
+            elif len(self._func_by_name.get(meth, [])) == 1:
+                out.append(self._func_by_name[meth][0])
+            return out
+        return out
+
+
+class _ModScan:
+    def __init__(self, graph: HostGraph, module: str, tree: ast.Module):
+        self.graph = graph
+        self.module = module
+        self.tree = tree
+
+    def run(self) -> None:
+        for st in self.tree.body:
+            self._top(st, qual_prefix="", cls=None)
+
+    def _top(self, st: ast.stmt, qual_prefix: str,
+             cls: Optional[ClassInfo]) -> None:
+        if isinstance(st, ast.ClassDef):
+            bases = tuple(
+                d.split(".")[-1] for d in
+                (_dotted(b) for b in st.bases) if d is not None
+            )
+            qual = f"{qual_prefix}{st.name}"
+            info = ClassInfo(module=self.module, name=qual, bases=bases)
+            self.graph.classes[info.key] = info
+            self.graph._class_by_name.setdefault(st.name, []).append(info.key)
+            for sub in st.body:
+                self._top(sub, qual_prefix=f"{qual}.", cls=info)
+            return
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._func(st, qual_prefix, cls)
+            return
+
+    def _func(self, st, qual_prefix: str, cls: Optional[ClassInfo]) -> None:
+        qual = f"{qual_prefix}{st.name}"
+        params = tuple(
+            a.arg for a in (st.args.posonlyargs + st.args.args
+                            + st.args.kwonlyargs)
+        )
+        info = FuncInfo(module=self.module, qualname=qual, name=st.name,
+                        node=st, cls=cls.name if cls else None, params=params)
+        info.cfg = build_cfg(st)
+        _FnScan(info).run()
+        self.graph.funcs[info.key] = info
+        if cls is not None:
+            cls.methods.setdefault(st.name, info.key)
+            # attribute type inference from self.X = Ctor(...) anywhere
+            for sub in ast.walk(st):
+                    if isinstance(sub, ast.Assign):
+                        names = _constructor_names(sub.value)
+                        if not names and isinstance(sub.value, ast.Name) \
+                                and sub.value.id in info.var_types:
+                            names = [info.var_types[sub.value.id]]
+                        if not names:
+                            continue
+                        for t in sub.targets:
+                            if (isinstance(t, ast.Attribute)
+                                    and isinstance(t.value, ast.Name)
+                                    and t.value.id == "self"):
+                                cls.attr_types.setdefault(
+                                    t.attr, set()).update(names)
+        else:
+            self.graph._func_by_name.setdefault(
+                st.name, []).append(info.key)
+        # nested functions (signal-handler closures etc.)
+        for sub in st.body:
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._func(sub, qual_prefix=f"{qual}.<locals>.", cls=cls)
+            elif isinstance(sub, ast.ClassDef):
+                # nested class (ObsServer's request Handler): collect its
+                # methods with the enclosing scope in the qualname
+                self._top(sub, qual_prefix=f"{qual}.<locals>.", cls=cls)
+
+
+def build_host_graph(sources: Dict[str, str]) -> HostGraph:
+    """Build a HostGraph from ``{module_name: python_source}``."""
+    graph = HostGraph()
+    for module, src in sorted(sources.items()):
+        tree = ast.parse(src, filename=module)
+        _ModScan(graph, module, tree).run()
+    return graph.finalize()
+
+
+def build_package_graph(packages: Sequence[Tuple[str, str]]) -> HostGraph:
+    """Build a HostGraph from on-disk packages.
+
+    ``packages`` is a sequence of ``(module_prefix, directory)`` pairs;
+    every ``*.py`` directly inside each directory becomes module
+    ``f"{prefix}.{stem}"``.
+    """
+    sources: Dict[str, str] = {}
+    for prefix, directory in packages:
+        for entry in sorted(os.listdir(directory)):
+            if not entry.endswith(".py") or entry == "__init__.py":
+                continue
+            path = os.path.join(directory, entry)
+            with open(path, "r", encoding="utf-8") as fh:
+                sources[f"{prefix}.{entry[:-3]}"] = fh.read()
+    return build_host_graph(sources)

@@ -1,0 +1,935 @@
+"""Host-side lint rules over :mod:`analysis.hostgraph` — the serving
+stack's protocol invariants, statically checked.
+
+Same registry discipline as the graph rules (PR 3): every rule is **inert
+until armed** by its :class:`HostPolicy` inputs (an absent spec lands the
+rule in ``rules_skipped``, it never guesses), fnmatch allowlists move hits
+to ``report.allowed`` instead of deleting them, and severities can be
+overridden per rule. Results flow through the one existing
+:class:`~perceiver_io_tpu.analysis.check.Report` implementation.
+
+The five rules:
+
+- **books-exactness** — every CFG path out of a function that books
+  ``submitted`` crosses *exactly one* terminal-outcome booking (a direct
+  ``self._n[<terminal>]`` write, a call into a transitively-booking method,
+  or a declared queue handoff), exception edges included. A leak or a
+  double-booking renders its CFG path.
+- **shared-state-race** — attributes written from a serving-loop context
+  and touched from a scrape/handler/signal context must share a common
+  ``with self.<lock>:`` guard on both sides. Container-kind conflicts
+  (subscript writes, mutator calls, iteration reads — the PR-11 histogram
+  and PR-12 breaker-window races) are errors; bare-scalar assignments are
+  GIL-atomic point reads and report at info.
+- **clock-discipline** — no bare ``time.monotonic``/``time.time``/
+  ``time.sleep`` call reachable from a context that accepts an injectable
+  ``clock=``/``sleep=``; the keyword-default seams themselves are reported
+  at info as the recorded allowlist.
+- **grant-pairing** — a ``PageAllocator`` grant flowing out of ``alloc_*``
+  must reach a ``free``/``release`` call, an adopted-by-slot sink, or a
+  return-escape on every path where it is live (the ``is None``
+  backpressure branch is the None-world and exempt); and no declared
+  page-writer call may see a shared grant without an intervening
+  ``cow_fork`` on that path.
+- **event-schema** — every literal event kind passed to ``emit``/
+  ``emit_rows`` must be registered in the known-kinds vocabulary, and
+  ``emit`` calls must statically carry the kind's required fields
+  (harvested through ``**row`` dict-literal locals); unregistered kinds
+  are errors.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import fnmatch
+import json
+from dataclasses import dataclass, field
+from typing import Callable, Dict, FrozenSet, List, Mapping, Optional, Sequence, Set, Tuple
+
+from perceiver_io_tpu.analysis.check import Report, _allowed
+from perceiver_io_tpu.analysis.rules import SEVERITIES, Violation
+from perceiver_io_tpu.analysis.hostgraph import (
+    AttrAccess,
+    CFG,
+    FuncInfo,
+    HostGraph,
+    build_host_graph,
+    iter_paths,
+)
+
+_SEV_RANK = {"info": 0, "warn": 1, "error": 2}
+
+
+# ---------------------------------------------------------------------------
+# policy
+# ---------------------------------------------------------------------------
+
+@dataclass
+class BooksSpec:
+    """Arms books-exactness: where bookings live and what counts terminal."""
+
+    terminal_outcomes: Tuple[str, ...]
+    counter_attr: str = "_n"
+    submit_key: str = "submitted"
+    # only functions matching these patterns are submit-class entries
+    submit_patterns: Tuple[str, ...] = ("*",)
+    # call patterns that hand the booked request to a later drive loop
+    # (fnmatched against the dotted call text, e.g. "self._queue.append")
+    handoffs: Tuple[str, ...] = ()
+
+
+@dataclass
+class ClockSpec:
+    """Arms clock-discipline: what makes a function an injectable context."""
+
+    # extra context roots beyond the auto-detected clock=/sleep= signatures
+    context_patterns: Tuple[str, ...] = ()
+    param_names: Tuple[str, ...] = ("clock", "sleep")
+
+
+@dataclass
+class GrantSpec:
+    """Arms grant-pairing: the allocator surface and its legal sinks."""
+
+    alloc_patterns: Tuple[str, ...] = ("*.alloc_tokens", "*.alloc_tokens_shared")
+    shared_patterns: Tuple[str, ...] = ("*.alloc_tokens_shared",)
+    free_patterns: Tuple[str, ...] = ("*free*", "*release*")
+    # callables whose last dotted segment adopting a grant argument counts
+    # as ownership transfer (slot constructors)
+    adopters: Tuple[str, ...] = ("_EngineSlot",)
+    # call patterns that write into a page passed as an argument; a shared
+    # grant reaching one without a cow_fork on the path is an error
+    page_writers: Tuple[str, ...] = ()
+    fork_patterns: Tuple[str, ...] = ("*cow_fork*",)
+
+
+@dataclass
+class EventSpec:
+    """Arms event-schema: the registered vocabulary and field contracts."""
+
+    known_kinds: FrozenSet[str]
+    required_fields: Mapping[str, Tuple[str, ...]]
+    emit_names: Tuple[str, ...] = ("emit", "emit_rows")
+    # rows-style emitters: vocabulary-checked only (row dicts are built
+    # elsewhere and runtime-validated by obs.events.validate_events)
+    rows_names: Tuple[str, ...] = ("emit_rows",)
+
+
+@dataclass
+class HostPolicy:
+    """Declared entry contexts + per-rule specs. ``None`` disarms a rule."""
+
+    serving_entries: Optional[Tuple[str, ...]] = None
+    scrape_entries: Optional[Tuple[str, ...]] = None
+    signal_entries: Optional[Tuple[str, ...]] = None
+    producer_entries: Optional[Tuple[str, ...]] = None
+    books: Optional[BooksSpec] = None
+    clocks: Optional[ClockSpec] = None
+    grants: Optional[GrantSpec] = None
+    events: Optional[EventSpec] = None
+    severity_overrides: Dict[str, str] = field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# shared helpers
+# ---------------------------------------------------------------------------
+
+def _dotted_calls(stmt: ast.AST):
+    from perceiver_io_tpu.analysis.hostgraph import _dotted
+
+    for n in ast.walk(stmt):
+        if isinstance(n, ast.Call):
+            d = _dotted(n.func)
+            if d:
+                yield d, n
+
+
+def _render(cfg: CFG, path: Sequence[int], head: int = 9, tail: int = 4) -> str:
+    lines = cfg.render_path(path).splitlines()
+    if len(lines) > head + tail + 1:
+        lines = lines[:head] + [f"    … ({len(lines) - head - tail} more)"] + lines[-tail:]
+    return "\n".join(lines)
+
+
+def _book_keys(stmt: ast.AST, counter: str) -> List[str]:
+    """Constant keys ``k`` written via ``self.<counter>[k] = / += …``."""
+    out: List[str] = []
+    for n in ast.walk(stmt):
+        targets: List[ast.expr] = []
+        if isinstance(n, ast.AugAssign):
+            targets = [n.target]
+        elif isinstance(n, ast.Assign):
+            targets = list(n.targets)
+        for t in targets:
+            if (isinstance(t, ast.Subscript)
+                    and isinstance(t.value, ast.Attribute)
+                    and isinstance(t.value.value, ast.Name)
+                    and t.value.value.id == "self"
+                    and t.value.attr == counter
+                    and isinstance(t.slice, ast.Constant)
+                    and isinstance(t.slice.value, str)):
+                out.append(t.slice.value)
+    return out
+
+
+def _books_dynamic_write(stmt: ast.AST, counter: str) -> bool:
+    """True when the statement writes ``self.<counter>[<non-constant>]`` —
+    the parametric terminal booking (``self._n[outcome] += 1`` inside
+    ``_finish(ticket, outcome)``). Callers pass a literal terminal outcome;
+    statically the write books *some* key, which is exactly what the
+    exactly-one-terminal-booking rule needs to count it as a sink."""
+    for n in ast.walk(stmt):
+        targets: List[ast.expr] = []
+        if isinstance(n, ast.AugAssign):
+            targets = [n.target]
+        elif isinstance(n, ast.Assign):
+            targets = list(n.targets)
+        for t in targets:
+            if (isinstance(t, ast.Subscript)
+                    and isinstance(t.value, ast.Attribute)
+                    and isinstance(t.value.value, ast.Name)
+                    and t.value.value.id == "self"
+                    and t.value.attr == counter
+                    and not isinstance(t.slice, ast.Constant)):
+                return True
+    return False
+
+
+def _chain_note(graph: HostGraph, pmap: Dict[str, Optional[str]], key: str) -> str:
+    chain = graph.chain(pmap, key)
+    return " -> ".join(chain) if len(chain) > 1 else key
+
+
+# ---------------------------------------------------------------------------
+# rule: books-exactness
+# ---------------------------------------------------------------------------
+
+def _transitive_bookers(graph: HostGraph, spec: BooksSpec) -> Set[str]:
+    vocab = set(spec.terminal_outcomes)
+    bookers: Set[str] = set()
+    for f in graph.funcs.values():
+        for node in f.cfg.nodes:
+            if node.stmt is not None and (
+                any(k in vocab for k in _book_keys(node.stmt, spec.counter_attr))
+                or _books_dynamic_write(node.stmt, spec.counter_attr)
+            ):
+                bookers.add(f.key)
+                break
+    changed = True
+    while changed:
+        changed = False
+        for f in graph.funcs.values():
+            if f.key in bookers:
+                continue
+            if graph.call_edges.get(f.key, set()) & bookers:
+                # only calls the resolver proved; a booked-through helper
+                # must be reachable by name, not hoped for
+                bookers.add(f.key)
+                changed = True
+    return bookers
+
+
+def _rule_books(graph: HostGraph, policy: HostPolicy) -> List[Violation]:
+    spec = policy.books
+    vocab = set(spec.terminal_outcomes)
+    bookers = _transitive_bookers(graph, spec)
+    out: List[Violation] = []
+
+    for f in graph.match(spec.submit_patterns):
+        cfg = f.cfg
+        submit_nodes = [
+            n.idx for n in cfg.nodes
+            if n.stmt is not None
+            and spec.submit_key in _book_keys(n.stmt, spec.counter_attr)
+        ]
+        if not submit_nodes:
+            continue
+        cls_key = graph.class_key_of(f)
+
+        def is_sink(idx: int) -> bool:
+            n = cfg.nodes[idx]
+            if n.stmt is None:
+                return False
+            if any(k in vocab for k in _book_keys(n.stmt, spec.counter_attr)):
+                return True
+            if _books_dynamic_write(n.stmt, spec.counter_attr):
+                return True
+            for dotted, _call in _dotted_calls(n.stmt):
+                if any(fnmatch.fnmatch(dotted, p) for p in spec.handoffs):
+                    return True
+                for target in graph.resolve_call(f, cls_key, dotted):
+                    if target in bookers:
+                        return True
+            return False
+
+        ends = {cfg.exit, cfg.raise_exit}
+        for start in submit_nodes:
+            for path in iter_paths(cfg, start, ends, max_paths=256):
+                hits = sum(1 for idx in path[1:] if is_sink(idx))
+                if hits == 1:
+                    continue
+                kind = ("books leak: no terminal booking"
+                        if hits == 0 else f"double booking: {hits} terminal bookings")
+                exit_kind = ("raise" if path[-1] == cfg.raise_exit else "return")
+                out.append(Violation(
+                    rule="books-exactness", severity="error",
+                    scope=f"{f.module}:{f.qualname}",
+                    message=(
+                        f"{kind} on a path from the '{spec.submit_key}' "
+                        f"booking at line {cfg.nodes[start].lineno} to the "
+                        f"function {exit_kind}; terminal vocabulary "
+                        f"{sorted(vocab)}; path:\n{_render(cfg, path)}"
+                    ),
+                ))
+                break  # one rendered path per submit site is enough
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rule: shared-state-race
+# ---------------------------------------------------------------------------
+
+_READ_KINDS = ("read", "subread", "iterread")
+
+
+def _rule_race(graph: HostGraph, policy: HostPolicy) -> List[Violation]:
+    writer_pats = tuple(policy.serving_entries or ()) + tuple(
+        policy.producer_entries or ())
+    writer_map = graph.reachable_map(writer_pats)
+    reader_maps: Dict[str, Dict[str, Optional[str]]] = {}
+    if policy.scrape_entries:
+        reader_maps["scrape"] = graph.reachable_map(policy.scrape_entries)
+    if policy.signal_entries:
+        reader_maps["signal"] = graph.reachable_map(policy.signal_entries)
+
+    groups: Dict[Tuple[str, str], Dict[str, List[Tuple[str, AttrAccess]]]] = {}
+    for f in graph.funcs.values():
+        if f.cls is None or f.is_init:
+            continue
+        cls_key = graph.class_key_of(f)
+        root = graph.cluster_root(cls_key)
+        in_writer = f.key in writer_map
+        in_readers = [ctx for ctx, m in reader_maps.items() if f.key in m]
+        if not in_writer and not in_readers:
+            continue
+        for acc in f.accesses:
+            g = groups.setdefault((root, acc.attr), {"w": [], "r": []})
+            if in_writer and acc.is_write:
+                g["w"].append(("serving", acc))
+            for ctx in in_readers:
+                g["r"].append((ctx, acc))
+
+    out: List[Violation] = []
+    for (root, attr), g in sorted(groups.items()):
+        writes, reads = g["w"], g["r"]
+        if not writes or not reads:
+            continue
+        common = None
+        for _ctx, acc in writes + reads:
+            common = acc.locks if common is None else (common & acc.locks)
+        if common:
+            continue  # a shared guard covers every site
+        # severity tiers by crash potential under the GIL: a container
+        # access on the READER side (iteration / subscript of something the
+        # serving thread mutates — the PR-11/PR-12 bug class) is an error;
+        # container mutation observed only through atomic point reads
+        # (len, scalar copy), or augmented scalar writes, is a staleness
+        # hazard (warn); plain-assign scalars read once are info
+        container = set(AttrAccess.CONTAINER_KINDS)
+        reader_kinds = {acc.kind for _c, acc in reads}
+        writer_kinds = {acc.kind for _c, acc in writes}
+        if reader_kinds & container:
+            sev = "error"
+        elif (writer_kinds & container) or "augwrite" in writer_kinds:
+            sev = "warn"
+        else:
+            sev = "info"
+        cls_name = graph.classes[root].name if root in graph.classes else root
+        w_ctx, w = writes[0]
+        # prefer a container-kind site for the rendered conflict
+        for c, acc in writes:
+            if acc.kind in AttrAccess.CONTAINER_KINDS:
+                w_ctx, w = c, acc
+                break
+        r_ctx, r = reads[0]
+        for c, acc in reads:
+            if acc.kind in AttrAccess.CONTAINER_KINDS:
+                r_ctx, r = c, acc
+                break
+        out.append(Violation(
+            rule="shared-state-race", severity=sev,
+            scope=f"{cls_name}.{attr}",
+            message=(
+                f"'{attr}' is written from the {w_ctx} context and touched "
+                f"from the {r_ctx} context with no common lock:\n"
+                f"    write: {w.site}\n"
+                f"      via {_chain_note(graph, writer_map, w.func.key)}\n"
+                f"    read:  {r.site}\n"
+                f"      via {_chain_note(graph, reader_maps[r_ctx], r.func.key)}"
+            ),
+        ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rule: clock-discipline
+# ---------------------------------------------------------------------------
+
+def _rule_clocks(graph: HostGraph, policy: HostPolicy) -> List[Violation]:
+    spec = policy.clocks
+    params = set(spec.param_names)
+    roots: Set[str] = {f.key for f in graph.match(spec.context_patterns)}
+    injectable_clusters: Set[str] = set()
+    for f in graph.funcs.values():
+        if params & set(f.params):
+            roots.add(f.key)
+            if f.cls is not None and f.name == "__init__":
+                injectable_clusters.add(
+                    graph.cluster_root(graph.class_key_of(f)))
+    for f in graph.funcs.values():
+        if f.cls is not None and \
+                graph.cluster_root(graph.class_key_of(f)) in injectable_clusters:
+            roots.add(f.key)
+    pmap = graph.reachable_map(sorted(roots))
+
+    out: List[Violation] = []
+    for key in sorted(pmap):
+        f = graph.funcs[key]
+        for tr in f.time_refs:
+            if tr.kind == "call":
+                out.append(Violation(
+                    rule="clock-discipline", severity="error",
+                    scope=f"{f.module}:{f.qualname}",
+                    message=(
+                        f"bare {tr.name}() at line {tr.lineno} is reachable "
+                        f"from an injectable clock/sleep context "
+                        f"(via {_chain_note(graph, pmap, key)}); thread the "
+                        f"injected seam through instead"
+                    ),
+                ))
+            else:
+                out.append(Violation(
+                    rule="clock-discipline", severity="info",
+                    scope=f"{f.module}:{f.qualname}",
+                    message=(
+                        f"recorded seam default: {tr.name} as keyword "
+                        f"default at line {tr.lineno} (the injection point "
+                        f"itself — expected)"
+                    ),
+                ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rule: grant-pairing
+# ---------------------------------------------------------------------------
+
+def _alloc_sites(f: FuncInfo, spec: GrantSpec):
+    """(var, node_idx, shared) for ``var = <alloc call>`` statements,
+    following IfExp branches (the matched-vs-fresh alloc idiom)."""
+    from perceiver_io_tpu.analysis.hostgraph import _dotted
+
+    def alloc_calls(expr: ast.expr) -> List[str]:
+        found: List[str] = []
+        cands = [expr]
+        if isinstance(expr, ast.IfExp):
+            cands = [expr.body, expr.orelse]
+        for c in cands:
+            if isinstance(c, ast.Call):
+                d = _dotted(c.func)
+                if d and any(fnmatch.fnmatch(d, p) for p in spec.alloc_patterns):
+                    found.append(d)
+        return found
+
+    for node in f.cfg.nodes:
+        st = node.stmt
+        if not isinstance(st, ast.Assign) or len(st.targets) != 1:
+            continue
+        if not isinstance(st.targets[0], ast.Name):
+            continue
+        dots = alloc_calls(st.value)
+        if not dots:
+            continue
+        shared = any(
+            fnmatch.fnmatch(d, p) for d in dots for p in spec.shared_patterns
+        )
+        yield st.targets[0].id, node.idx, shared
+
+
+def _uses_var(node: ast.AST, var: str) -> Tuple[int, int]:
+    """(total loads of var, loads inside an `is None` / `is not None`
+    comparison) in the subtree."""
+    total = none_tests = 0
+    for n in ast.walk(node):
+        if isinstance(n, ast.Compare):
+            if any(isinstance(op, (ast.Is, ast.IsNot)) for op in n.ops) and any(
+                isinstance(c, ast.Constant) and c.value is None
+                for c in n.comparators
+            ):
+                for sub in ast.walk(n):
+                    if isinstance(sub, ast.Name) and sub.id == var \
+                            and isinstance(sub.ctx, ast.Load):
+                        none_tests += 1
+        if isinstance(n, ast.Name) and n.id == var \
+                and isinstance(n.ctx, ast.Load):
+            total += 1
+    return total, none_tests
+
+
+def _is_grant_sink(stmt: ast.AST, var: str, spec: GrantSpec) -> bool:
+    from perceiver_io_tpu.analysis.hostgraph import _dotted
+
+    def mentions(e: ast.AST) -> bool:
+        return any(isinstance(n, ast.Name) and n.id == var
+                   for n in ast.walk(e))
+
+    # return-escape: ownership moves to the caller
+    if isinstance(stmt, ast.Return) and stmt.value is not None \
+            and mentions(stmt.value):
+        return True
+    # store into an attribute / subscript / tuple thereof: adoption
+    if isinstance(stmt, ast.Assign):
+        targets = stmt.targets
+        values = [stmt.value]
+        if (len(targets) == 1 and isinstance(targets[0], ast.Tuple)
+                and isinstance(stmt.value, ast.Tuple)
+                and len(targets[0].elts) == len(stmt.value.elts)):
+            targets, values = targets[0].elts, stmt.value.elts
+        for t, v in zip(targets, values):
+            if isinstance(t, (ast.Attribute, ast.Subscript)) and mentions(v):
+                return True
+    for dotted, call in _dotted_calls(stmt):
+        args_mention = any(
+            mentions(a) for a in list(call.args)
+            + [kw.value for kw in call.keywords]
+        )
+        if not args_mention:
+            continue
+        if any(fnmatch.fnmatch(dotted, p) for p in spec.free_patterns):
+            return True
+        if dotted.split(".")[-1] in spec.adopters:
+            return True
+        if any(fnmatch.fnmatch(dotted, p) for p in spec.fork_patterns):
+            return True
+    return False
+
+
+def _rule_grants(graph: HostGraph, policy: HostPolicy) -> List[Violation]:
+    spec = policy.grants
+    out: List[Violation] = []
+    for f in graph.funcs.values():
+        cfg = f.cfg
+        for var, start, shared in _alloc_sites(f, spec):
+            ends = {cfg.exit, cfg.raise_exit}
+            flagged = False
+            for path in iter_paths(cfg, start, ends, max_paths=256):
+                live = False
+                sunk = False
+                for idx in path[1:]:
+                    st = cfg.nodes[idx].stmt
+                    if st is None:
+                        continue
+                    if _is_grant_sink(st, var, spec):
+                        sunk = True
+                        break
+                    total, none_tests = _uses_var(st, var)
+                    if total > none_tests:
+                        live = True
+                if live and not sunk and not flagged:
+                    flagged = True
+                    out.append(Violation(
+                        rule="grant-pairing", severity="error",
+                        scope=f"{f.module}:{f.qualname}:{var}",
+                        message=(
+                            f"grant '{var}' from the alloc at line "
+                            f"{cfg.nodes[start].lineno} is used but reaches "
+                            f"the function exit with no free/release/"
+                            f"adoption sink on this path:\n"
+                            f"{_render(cfg, path)}"
+                        ),
+                    ))
+            if shared and spec.page_writers:
+                writer_nodes = [
+                    n.idx for n in cfg.nodes
+                    if n.stmt is not None and any(
+                        any(fnmatch.fnmatch(d, p) for p in spec.page_writers)
+                        and any(
+                            isinstance(x, ast.Name) and x.id == var
+                            for a in list(c.args)
+                            + [kw.value for kw in c.keywords]
+                            for x in ast.walk(a)
+                        )
+                        for d, c in _dotted_calls(n.stmt)
+                    )
+                ]
+                for w in writer_nodes:
+                    for path in iter_paths(cfg, start, {w}, max_paths=64):
+                        forked = any(
+                            cfg.nodes[idx].stmt is not None and any(
+                                fnmatch.fnmatch(d, p)
+                                for d, _c in _dotted_calls(cfg.nodes[idx].stmt)
+                                for p in spec.fork_patterns
+                            )
+                            for idx in path[1:-1]
+                        )
+                        if not forked:
+                            out.append(Violation(
+                                rule="grant-pairing", severity="error",
+                                scope=f"{f.module}:{f.qualname}:{var}",
+                                message=(
+                                    f"shared grant '{var}' (alloc at line "
+                                    f"{cfg.nodes[start].lineno}, refcount "
+                                    f"may be >1) reaches the page write at "
+                                    f"line {cfg.nodes[w].lineno} with no "
+                                    f"intervening cow_fork; path:\n"
+                                    f"{_render(cfg, path)}"
+                                ),
+                            ))
+                            break
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rule: event-schema
+# ---------------------------------------------------------------------------
+
+def _dictcomp_const_keys(v: ast.expr) -> Optional[Set[str]]:
+    """Keys of the ``{k: d[k] for k in ("a", "b", …)}`` projection idiom —
+    a DictComp whose single generator iterates a literal of string
+    constants and whose key is the loop variable. None when not that."""
+    if not (isinstance(v, ast.DictComp) and len(v.generators) == 1):
+        return None
+    gen = v.generators[0]
+    if gen.ifs or not isinstance(gen.target, ast.Name):
+        return None
+    if not (isinstance(v.key, ast.Name) and v.key.id == gen.target.id):
+        return None
+    if not isinstance(gen.iter, (ast.Tuple, ast.List, ast.Set)):
+        return None
+    keys: Set[str] = set()
+    for el in gen.iter.elts:
+        if isinstance(el, ast.Constant) and isinstance(el.value, str):
+            keys.add(el.value)
+        else:
+            return None
+    return keys
+
+
+def _dict_literal_keys(fn_node: ast.AST, name: str) -> Tuple[Set[str], bool]:
+    """Statically-known keys of local ``name`` built as a dict literal /
+    ``dict(...)`` call, plus ``name["k"] = …`` augments anywhere in the
+    function. Returns (keys, partial) — partial means some keys are not
+    statically visible (a ``**`` splat or a non-literal build)."""
+    from perceiver_io_tpu.analysis.hostgraph import walk_own
+
+    keys: Set[str] = set()
+    partial = False
+    found = False
+    for n in walk_own(fn_node):
+        if isinstance(n, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == name for t in n.targets
+        ):
+            v = n.value
+            if isinstance(v, ast.Dict):
+                found = True
+                for k in v.keys:
+                    if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                        keys.add(k.value)
+                    else:
+                        partial = True  # **splat inside a dict literal
+            elif (isinstance(v, ast.Call) and isinstance(v.func, ast.Name)
+                  and v.func.id == "dict"):
+                found = True
+                for kw in v.keywords:
+                    if kw.arg is not None:
+                        keys.add(kw.arg)
+                    else:
+                        partial = True
+            elif _dictcomp_const_keys(v) is not None:
+                found = True
+                keys |= _dictcomp_const_keys(v)
+            else:
+                found = True
+                partial = True
+        if (isinstance(n, ast.Assign) and len(n.targets) == 1
+                and isinstance(n.targets[0], ast.Subscript)
+                and isinstance(n.targets[0].value, ast.Name)
+                and n.targets[0].value.id == name
+                and isinstance(n.targets[0].slice, ast.Constant)
+                and isinstance(n.targets[0].slice.value, str)):
+            keys.add(n.targets[0].slice.value)
+    if not found:
+        partial = True
+    return keys, partial
+
+
+def _rule_events(graph: HostGraph, policy: HostPolicy) -> List[Violation]:
+    from perceiver_io_tpu.analysis.hostgraph import walk_own
+
+    spec = policy.events
+    out: List[Violation] = []
+    for f in graph.funcs.values():
+        for n in walk_own(f.node):
+            if not isinstance(n, ast.Call):
+                continue
+            func = n.func
+            if not (isinstance(func, ast.Attribute)
+                    and func.attr in spec.emit_names):
+                continue
+            if not n.args or not (
+                isinstance(n.args[0], ast.Constant)
+                and isinstance(n.args[0].value, str)
+            ):
+                continue  # non-literal kinds are a runtime concern
+            kind = n.args[0].value
+            scope = f"{f.module}:{f.qualname}:{kind}"
+            if kind not in spec.known_kinds:
+                out.append(Violation(
+                    rule="event-schema", severity="error", scope=scope,
+                    message=(
+                        f"unregistered event kind '{kind}' at line "
+                        f"{n.lineno}: not in the known-kinds vocabulary — "
+                        f"register it (and its required fields) in "
+                        f"obs.events before emitting"
+                    ),
+                ))
+                continue
+            if func.attr in spec.rows_names:
+                continue  # rows are runtime-validated per row
+            required = set(spec.required_fields.get(kind, ()))
+            if not required:
+                continue
+            have: Set[str] = set()
+            partial = False
+            for kw in n.keywords:
+                if kw.arg is not None:
+                    have.add(kw.arg)
+                elif isinstance(kw.value, ast.Dict):
+                    for k in kw.value.keys:
+                        if isinstance(k, ast.Constant) \
+                                and isinstance(k.value, str):
+                            have.add(k.value)
+                        else:
+                            partial = True
+                elif isinstance(kw.value, ast.Name):
+                    ks, p = _dict_literal_keys(f.node, kw.value.id)
+                    have |= ks
+                    partial = partial or p
+                elif _dictcomp_const_keys(kw.value) is not None:
+                    have |= _dictcomp_const_keys(kw.value)
+                else:
+                    partial = True
+            missing = required - have
+            if not missing:
+                continue
+            if partial:
+                out.append(Violation(
+                    rule="event-schema", severity="warn", scope=scope,
+                    message=(
+                        f"emit('{kind}') at line {n.lineno}: required "
+                        f"fields {sorted(missing)} not statically visible "
+                        f"(dynamic ** spread); runtime validate_events is "
+                        f"the only check left"
+                    ),
+                ))
+            else:
+                out.append(Violation(
+                    rule="event-schema", severity="error", scope=scope,
+                    message=(
+                        f"emit('{kind}') at line {n.lineno} is missing "
+                        f"required fields {sorted(missing)} "
+                        f"(statically visible: {sorted(have)})"
+                    ),
+                ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# registry + check
+# ---------------------------------------------------------------------------
+
+def _books_armed(p: HostPolicy) -> bool:
+    return p.books is not None
+
+
+def _race_armed(p: HostPolicy) -> bool:
+    return bool(p.serving_entries or p.producer_entries) and bool(
+        p.scrape_entries or p.signal_entries)
+
+
+def _clocks_armed(p: HostPolicy) -> bool:
+    return p.clocks is not None
+
+
+def _grants_armed(p: HostPolicy) -> bool:
+    return p.grants is not None
+
+
+def _events_armed(p: HostPolicy) -> bool:
+    return p.events is not None
+
+
+HOST_RULES: Dict[str, Tuple[Callable[[HostGraph, HostPolicy], List[Violation]],
+                            Callable[[HostPolicy], bool], str]] = {
+    "books-exactness": (_rule_books, _books_armed,
+                        "needs policy.books (BooksSpec)"),
+    "shared-state-race": (_rule_race, _race_armed,
+                          "needs serving + scrape/signal entry contexts"),
+    "clock-discipline": (_rule_clocks, _clocks_armed,
+                         "needs policy.clocks (ClockSpec)"),
+    "grant-pairing": (_rule_grants, _grants_armed,
+                      "needs policy.grants (GrantSpec)"),
+    "event-schema": (_rule_events, _events_armed,
+                     "needs policy.events (EventSpec)"),
+}
+
+
+def host_check(
+    graph,
+    *,
+    policy: HostPolicy,
+    rules: Optional[Sequence[str]] = None,
+    allow: Sequence[str] = (),
+    name: str = "host",
+) -> Report:
+    """Run the host rules over ``graph`` (a :class:`HostGraph` or a
+    ``{module: source}`` dict) and return the standard lint Report."""
+    if isinstance(graph, dict):
+        graph = build_host_graph(graph)
+    selected = list(HOST_RULES) if rules is None else list(rules)
+    unknown = [r for r in selected if r not in HOST_RULES]
+    if unknown:
+        raise ValueError(
+            f"unknown rule(s) {unknown}; registered: {sorted(HOST_RULES)}")
+    bad_sev = {r: s for r, s in policy.severity_overrides.items()
+               if s not in SEVERITIES}
+    if bad_sev:
+        raise ValueError(
+            f"invalid severity override(s) {bad_sev}; valid: {SEVERITIES}")
+
+    rules_run: List[str] = []
+    rules_skipped: List[str] = []
+    violations: List[Violation] = []
+    for rname in selected:
+        fn, armed, why = HOST_RULES[rname]
+        if not armed(policy):
+            rules_skipped.append(f"{rname} ({why})")
+            continue
+        rules_run.append(rname)
+        found = fn(graph, policy)
+        override = policy.severity_overrides.get(rname)
+        if override:
+            found = [dataclasses.replace(v, severity=override) for v in found]
+        violations.extend(found)
+
+    kept = [v for v in violations if not _allowed(v, allow)]
+    allowed = [v for v in violations if _allowed(v, allow)]
+    kept.sort(key=lambda v: (-_SEV_RANK[v.severity], v.key))
+    return Report(
+        name=name, backend="host-ast", n_ops=len(graph.funcs),
+        rules_run=tuple(rules_run), rules_skipped=tuple(rules_skipped),
+        violations=kept, allowed=allowed,
+    )
+
+
+# ---------------------------------------------------------------------------
+# the real-surface policy + committed allowlist
+# ---------------------------------------------------------------------------
+
+def default_host_policy() -> HostPolicy:
+    """The declared entry contexts and rule specs for the real
+    ``perceiver_io_tpu/serving/`` + ``perceiver_io_tpu/obs/`` surface.
+
+    Entry declarations are the honest boundary of the static engine:
+    callables that cross threads as *parameters* (ObsServer's provider
+    callbacks, the metric objects the hot path mutates through chained
+    registry calls) are invisible to name resolution, so each is declared
+    as a root of its context here instead of silently dropping out.
+    """
+    from perceiver_io_tpu.obs.events import KNOWN_EVENT_KINDS, _REQUIRED_FIELDS
+
+    return HostPolicy(
+        serving_entries=(
+            # the drive loops and everything they run
+            "*:RequestFrontEnd.submit", "*:RequestFrontEnd.pump",
+            "*:RequestFrontEnd.run_closed", "*:RequestFrontEnd.run_open",
+            "*:RequestFrontEnd.cancel", "*:RequestFrontEnd.drain",
+            "*:EngineFrontEnd.pump", "*:EngineFrontEnd.run_closed",
+            "*:EngineFrontEnd.run_open", "*:EngineFrontEnd.drain",
+            "*:EngineFrontEnd.recover",
+            # hot-path writers reached through chained registry calls
+            # (self.registry.counter(...).inc() hides the receiver type)
+            "*:Counter.inc", "*:Gauge.set", "*:Gauge.add",
+            "*:Histogram.record", "*:MetricsRegistry.maybe_emit",
+            # the recorder's ring ingest runs on the serving thread
+            "*:FlightRecorder.emit", "*:FlightRecorder.emit_rows",
+            "*:FlightRecorder.observe",
+        ),
+        scrape_entries=(
+            # ThreadingHTTPServer handler thread + the provider callables
+            # it invokes (providers cross as constructor params)
+            "*:ObsServer._handle", "*:ObsServer._slo",
+            "*:RequestFrontEnd.health", "*:RequestFrontEnd.books",
+            "*:RequestFrontEnd.audit", "*:CircuitBreaker.health",
+            "*:MetricsRegistry.to_prometheus", "*:MetricsRegistry.snapshot",
+            "*:Histogram.state", "*:Counter.value", "*:Gauge.value",
+        ),
+        signal_entries=(
+            # SIGUSR1 flight dump + SIGTERM drain run on the main thread's
+            # signal frame, interleaving with whatever was interrupted
+            "*install_signal_handler*", "*:FlightRecorder.dump",
+        ),
+        producer_entries=("*:run_load",),
+        books=BooksSpec(
+            terminal_outcomes=_terminal_outcomes(),
+            counter_attr="_n",
+            submit_key="submitted",
+            submit_patterns=("*submit*", "*recover*"),
+            handoffs=("self._queue.append", "self._parked.append"),
+        ),
+        clocks=ClockSpec(context_patterns=()),
+        grants=GrantSpec(
+            alloc_patterns=("*.alloc_tokens", "*.alloc_tokens_shared"),
+            shared_patterns=("*.alloc_tokens_shared",),
+            free_patterns=("*free*", "*release*"),
+            adopters=("_EngineSlot",),
+            # the engine writes pages only through the compiled join/step
+            # programs today (ROADMAP item 2: no host-side writer reaches a
+            # shared tail page) — these patterns stand guard for when one
+            # appears
+            page_writers=("*write_page*", "*append_into_page*",
+                          "*update_page*"),
+        ),
+        events=EventSpec(
+            known_kinds=frozenset(KNOWN_EVENT_KINDS),
+            required_fields={k: tuple(v) for k, v in _REQUIRED_FIELDS.items()},
+        ),
+    )
+
+
+def _terminal_outcomes() -> Tuple[str, ...]:
+    from perceiver_io_tpu.serving.frontend import TERMINAL_OUTCOMES
+
+    return tuple(TERMINAL_OUTCOMES)
+
+
+def load_allowlist(path: str) -> Tuple[List[str], List[dict]]:
+    """Load a committed allowlist: ``{"entries": [{"pattern":…,
+    "reason":…}]}``. Every entry must carry a non-empty reason — an
+    unexplained suppression is indistinguishable from a weakened rule."""
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    entries = data.get("entries", [])
+    patterns: List[str] = []
+    for i, e in enumerate(entries):
+        pat = e.get("pattern")
+        reason = e.get("reason")
+        if not isinstance(pat, str) or not pat:
+            raise ValueError(f"allowlist entry {i} has no pattern: {e}")
+        if not isinstance(reason, str) or not reason.strip():
+            raise ValueError(
+                f"allowlist entry {i} ({pat!r}) has no reason — every "
+                f"suppression must explain itself")
+        patterns.append(pat)
+    return patterns, entries

@@ -1,0 +1,106 @@
+"""Shared CLI plumbing for the two linters (tools/graphlint.py, tools/hostlint.py).
+
+Both linters present the same surface — ``--rules`` / ``--allow`` /
+``--fail-on`` / ``--json`` — and the same exit-code contract:
+
+- 0 — no violation at/above ``--fail-on`` survived the allowlist;
+- 1 — violations found;
+- 2 — usage error (argparse's own exit code; an unknown ``--rules`` name is
+  a usage error whose message lists the registered rules, NOT a silent
+  skip and NOT a crash);
+- 3 — the lint itself crashed (a rule or target build blew up — CI must
+  not confuse "the linter broke" with either verdict, and with
+  ``--fail-on none`` must not read it as a pass).
+
+The helpers here are the one implementation of that contract; the tools
+keep only their target-building logic. tests/test_hostlint.py pins the
+semantics for both binaries through this module.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Dict, Optional, Tuple
+
+FAIL_ON_CHOICES = ("error", "warn", "info", "none")
+
+
+def add_common_lint_args(
+    parser: argparse.ArgumentParser,
+    *,
+    allow_help: str = "extra allowlist entry (repeatable), fnmatch-ed against "
+                      "'rule' and 'rule:scope'",
+) -> None:
+    """The four shared flags, with shared semantics and help text."""
+    parser.add_argument(
+        "--rules", default=None,
+        help="comma list of rules to run (default: all registered); "
+             "unknown names are a usage error",
+    )
+    parser.add_argument("--allow", action="append", default=[], help=allow_help)
+    parser.add_argument(
+        "--fail-on", choices=FAIL_ON_CHOICES, default="error",
+        help="exit non-zero when any violation at/above this severity "
+             "survives the allowlist",
+    )
+    parser.add_argument(
+        "--json", default=None, metavar="PATH",
+        help="write {target: report} JSON artifact",
+    )
+
+
+def parse_rules(
+    parser: argparse.ArgumentParser,
+    spec: Optional[str],
+    registry,
+    what: str = "rule",
+) -> Optional[Tuple[str, ...]]:
+    """``--rules`` → tuple of names, or None for "all registered".
+
+    A typo'd name must be a USAGE error (argparse exits 2), not a silent
+    skip and not an internal crash (exit 3) — the message lists what is
+    registered so the fix is one copy-paste away."""
+    if not spec:
+        return None
+    names = tuple(r for r in spec.split(",") if r)
+    unknown = [r for r in names if r not in registry]
+    if unknown:
+        parser.error(
+            f"unknown {what}(s) {', '.join(unknown)}; registered {what}s: "
+            f"{', '.join(sorted(registry))}"
+        )
+    return names
+
+
+def lint_crashed(name: str, exc: BaseException) -> int:
+    """Report a crashed lint run and return exit status 3."""
+    import traceback
+
+    traceback.print_exc()
+    print(f"{name} ERROR (rule or target build crashed): {exc}")
+    return 3
+
+
+def finish_lint(
+    name: str,
+    reports: Dict[str, "object"],
+    *,
+    fail_on: str,
+    json_path: Optional[str] = None,
+) -> int:
+    """Print every report, optionally write the JSON artifact, and map the
+    verdict to the shared exit contract (0 clean / 1 violations)."""
+    for report in reports.values():
+        print(report.format())
+        print()
+    if json_path:
+        with open(json_path, "w") as f:
+            json.dump({k: r.to_dict() for k, r in reports.items()}, f, indent=1)
+        print(f"wrote {json_path}")
+    failed = [k for k, r in reports.items() if not r.ok(fail_on)]
+    if failed:
+        print(f"{name} FAILED ({fail_on}+) on: {', '.join(failed)}")
+        return 1
+    print(f"{name} ok ({len(reports)} target(s), fail-on={fail_on})")
+    return 0

@@ -236,7 +236,11 @@ class FlightRecorder:
         except OSError as e:
             warnings.warn(f"flight recorder could not write {path}: {e}")
             return None
-        self.dumps.append(path)
+        with self._lock:
+            # dump() runs on BOTH the serving thread (SLO-breach trigger)
+            # and the signal frame (SIGUSR1): the dumps list shares the
+            # ring's reentrant lock on every touch
+            self.dumps.append(path)
         # through self.emit so the dump event is BOTH in the stream and in
         # the ring (the next dump shows this one happened); flight.dump is
         # not a trigger kind, so this cannot recurse

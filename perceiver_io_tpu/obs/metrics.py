@@ -35,7 +35,7 @@ import math
 import re
 import threading
 import time
-from typing import Dict, Optional
+from typing import Callable, Dict, Optional
 
 # bucket width factor: 2**0.25 per bucket — 4 buckets per octave, ~9% max
 # midpoint error; shared by every histogram so counts merge exactly
@@ -302,11 +302,17 @@ def _prom_name(name: str) -> str:
 class MetricsRegistry:
     """Get-or-create registry of named metrics; the name is the identity
     (asking twice returns the same object, asking with a different type
-    raises)."""
+    raises).
 
-    def __init__(self):
+    ``clock`` drives the :meth:`maybe_emit` rate limit. The front ends
+    pass their own injected clock when they construct the default
+    registry, so a ``ManualClock`` chaos/sim run rate-limits in virtual
+    time instead of silently reading the wall."""
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
         self._metrics: Dict[str, object] = {}
         self._lock = threading.Lock()
+        self._clock = clock
         self._last_emit = 0.0
 
     def _get(self, name: str, cls, help: str):
@@ -360,7 +366,7 @@ class MetricsRegistry:
     def emit_snapshot(self, events) -> None:
         """One ``metrics`` event row with the full snapshot."""
         events.emit("metrics", **self.snapshot())
-        self._last_emit = time.monotonic()
+        self._last_emit = self._clock()
 
     def maybe_emit(self, events, min_interval_s: float = 30.0) -> bool:
         """Rate-limited :meth:`emit_snapshot` — call it opportunistically
@@ -368,7 +374,7 @@ class MetricsRegistry:
         per ``min_interval_s``. Returns True when a row was written."""
         if events is None or not self._metrics:
             return False
-        now = time.monotonic()
+        now = self._clock()
         if now - self._last_emit < min_interval_s:
             return False
         self.emit_snapshot(events)

@@ -972,7 +972,8 @@ class EngineFrontEnd(RequestFrontEnd):
             )
             rec.queue_wait_s = 0.0
             self.records.append(rec)
-            self._n["submitted"] += 1
+            with self._books_lock:
+                self._n["submitted"] += 1
             self._m_submitted.inc()
             if rec.tenant is not None:
                 self._m_submitted.labels(tenant=rec.tenant).inc()
@@ -985,7 +986,8 @@ class EngineFrontEnd(RequestFrontEnd):
                 # no allocation can satisfy
                 reason, detail = verdict
                 rec.outcome, rec.shed_reason = "shed", reason
-                self._n["shed"] += 1
+                with self._books_lock:
+                    self._n["shed"] += 1
                 self._m_shed.inc()
                 if rec.tenant is not None:
                     self._m_shed.labels(tenant=rec.tenant).inc()
@@ -996,7 +998,8 @@ class EngineFrontEnd(RequestFrontEnd):
                                             **detail)
                 shed += 1
                 continue
-            self._n["admitted"] += 1
+            with self._books_lock:
+                self._n["admitted"] += 1
             self._m_admitted.inc()
             if rec.tenant is not None:
                 self._m_admitted.labels(tenant=rec.tenant).inc()

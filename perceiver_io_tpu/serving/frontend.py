@@ -57,6 +57,7 @@ reproducible simulation under a ``ManualClock``.
 from __future__ import annotations
 
 import os
+import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -214,7 +215,10 @@ class RequestFrontEnd:
         self.weight_dtype = weight_dtype
         self.config = config or FrontEndConfig()
         self.events = events
-        self.registry = registry if registry is not None else MetricsRegistry()
+        # the default registry inherits our injected clock so its
+        # maybe_emit rate limit runs in the same (possibly virtual) time
+        self.registry = (registry if registry is not None
+                         else MetricsRegistry(clock=clock))
         self._clock, self._sleep = clock, sleep
         self._injector = injector
         self._fns: Dict[int, Callable] = {}
@@ -227,6 +231,12 @@ class RequestFrontEnd:
         self._busy_until = float(clock())
         self._est_service = float(self.config.est_service_s)
         self._n = {k: 0 for k in ("submitted", "admitted", *TERMINAL_OUTCOMES)}
+        # the outcome dict is mutated by the serving thread and iterated by
+        # the scrape thread (ObsServer -> health/books): every _n mutation
+        # and the books() snapshot hold this lock — a dict resize during
+        # iteration is a RuntimeError, not just a stale read (hostlint
+        # shared-state-race pins this)
+        self._books_lock = threading.Lock()
         self._in_flight = 0
         # Evictline preemption state (populated only by the engine subclass;
         # carried here so books()/audit() speak ONE identity for both front
@@ -347,7 +357,8 @@ class RequestFrontEnd:
             tenant=None if tenant is None else str(tenant),
         )
         self.records.append(rec)
-        self._n["submitted"] += 1
+        with self._books_lock:
+            self._n["submitted"] += 1
         self._m_submitted.inc()
         if rec.tenant is not None:
             # per-tenant child series under the same family — the unlabeled
@@ -398,7 +409,8 @@ class RequestFrontEnd:
                 probe = verdict == "probe"
         if reason is not None:
             rec.outcome, rec.shed_reason = "shed", reason
-            self._n["shed"] += 1
+            with self._books_lock:
+                self._n["shed"] += 1
             self._m_shed.inc()
             if rec.tenant is not None:
                 self._m_shed.labels(tenant=rec.tenant).inc()
@@ -412,7 +424,8 @@ class RequestFrontEnd:
                                         queue_depth=len(self._queue), **detail)
             return rec
         rec.probe = probe
-        self._n["admitted"] += 1
+        with self._books_lock:
+            self._n["admitted"] += 1
         self._m_admitted.inc()
         if rec.tenant is not None:
             self._m_admitted.labels(tenant=rec.tenant).inc()
@@ -449,7 +462,8 @@ class RequestFrontEnd:
     def _finish(self, ticket: _Ticket, outcome: str) -> None:
         rec = ticket.record
         rec.outcome = outcome
-        self._n[outcome] += 1
+        with self._books_lock:
+            self._n[outcome] += 1
         if self.journal is not None:
             # exactly one terminal journal record per finished request —
             # every served path (engine retire, queue cancel/expiry, the
@@ -788,20 +802,23 @@ class RequestFrontEnd:
         the pre-Evictline one. ``evictions``/``resumes``/``recovered`` are
         the preemption/recovery odometers (an evicted-then-resumed request
         is still ONE submission — these count transitions, not requests)."""
-        b = dict(self._n)
+        with self._books_lock:
+            # one locked snapshot: the scrape thread must never iterate _n
+            # while the serving thread books an outcome into it
+            b = dict(self._n)
+            b["terminal"] = sum(self._n[o] for o in TERMINAL_OUTCOMES)
+            admitted_terminal = sum(
+                self._n[o] for o in ("ok", "error", "timeout", "cancelled")
+            )
         b["queued"] = len(self._queue)
         b["in_flight"] = self._in_flight
         b["parked"] = len(self._parked)
-        b["terminal"] = sum(self._n[o] for o in TERMINAL_OUTCOMES)
         b["max_queue_depth"] = self.max_queue_depth
         b["draining"] = self._draining
         b["evictions"] = self._n_evictions
         b["resumes"] = self._n_resumes
         b["recovered"] = self._n_recovered
         live = b["queued"] + b["in_flight"] + b["parked"]
-        admitted_terminal = sum(
-            self._n[o] for o in ("ok", "error", "timeout", "cancelled")
-        )
         b["balanced"] = (
             b["submitted"] == b["terminal"] + live
             and b["admitted"] == admitted_terminal + live

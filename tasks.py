@@ -169,6 +169,18 @@ def graphlint(args):
 
 
 @task
+def hostlint(args):
+    """Static protocol analysis of the host-side serving stack
+    (tools/hostlint.py; docs/static-analysis.md#hostlint): CFG/call-graph
+    rules — books-exactness, shared-state-race, clock-discipline,
+    grant-pairing, event-schema — over perceiver_io_tpu/serving/ + obs/
+    with the committed reasoned allowlist. Pure-AST: no JAX, no compile,
+    sub-second. Gates at warn — an unsuppressed warn is a finding that
+    never got triaged."""
+    run(sys.executable, "tools/hostlint.py", "--fail-on", "warn", *args.rest)
+
+
+@task
 def obs(args):
     """Observability gate (tools/obs_gate.py; docs/observability.md): a
     10-step synthetic fit + instrumented generate requests, event-stream
@@ -231,6 +243,9 @@ def perf(args):
     gate over the real engine control plane — fairness + books + SIM
     floors + per-tenant scrape surface). Extra args go to
     tools/graphcheck.py (e.g. ``--programs train_flat,decode``)."""
+    # hostlint first: the cheapest leg (pure AST, no compile) fails fast
+    # on a serving-protocol regression before anything compiles a graph
+    run(sys.executable, "tools/hostlint.py", "--fail-on", "warn")
     run(sys.executable, "tools/graphcheck.py", *args.rest)
     run(sys.executable, "tools/graphlint.py", "--fail-on", "error")
     # trace-only on purpose: graphcheck just compiled the same five

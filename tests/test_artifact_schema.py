@@ -8,6 +8,8 @@ import json
 import os
 import re
 
+import pytest
+
 from perceiver_io_tpu.analysis.fingerprint import PROGRAMS, validate_contract
 from perceiver_io_tpu.analysis.ledger import validate_ledger
 
@@ -60,7 +62,12 @@ def test_bench_extra_rounds_well_formed():
 
 def test_contract_files_validate_against_schema():
     paths = sorted(glob.glob(os.path.join(CONTRACTS, "*.json")))
-    program_files = [p for p in paths if os.path.basename(p) != "ledger.json"]
+    # ledger.json and hostlint_allow.json are contracts of a different
+    # shape, schema-pinned by their own tests below
+    program_files = [
+        p for p in paths
+        if os.path.basename(p) not in ("ledger.json", "hostlint_allow.json")
+    ]
     assert program_files, "no program contracts committed under contracts/"
     seen = set()
     for path in program_files:
@@ -703,3 +710,40 @@ def test_sim_rounds_monotone_and_well_formed():
             assert isinstance(block, dict), f"{base}: summary.{fam}"
             for p in ("p50", "p99"):
                 assert isinstance(block.get(p), (int, float)), f"{base}: summary.{fam}.{p}"
+
+
+def test_hostlint_allowlist_schema_pinned():
+    """contracts/hostlint_allow.json: every suppression carries a unique
+    pattern and a non-empty reason — an unexplained allowlist entry is
+    indistinguishable from a weakened rule, and load_allowlist refuses it."""
+    from perceiver_io_tpu.analysis.hostrules import load_allowlist
+
+    path = os.path.join(REPO, "contracts", "hostlint_allow.json")
+    doc = json.load(open(path))
+    assert isinstance(doc.get("entries"), list) and doc["entries"]
+    patterns, entries = load_allowlist(path)
+    assert len(patterns) == len(set(patterns)), "duplicate allowlist patterns"
+    for e in entries:
+        assert isinstance(e["pattern"], str) and e["pattern"]
+        assert isinstance(e["reason"], str) and e["reason"].strip()
+        # patterns target a registered rule, not a glob over everything
+        rule = e["pattern"].split(":", 1)[0]
+        from perceiver_io_tpu.analysis.hostrules import HOST_RULES
+
+        assert rule in HOST_RULES, f"{e['pattern']!r} names no registered rule"
+
+
+def test_hostlint_allowlist_rejects_unreasoned_entries(tmp_path):
+    from perceiver_io_tpu.analysis.hostrules import load_allowlist
+
+    p = tmp_path / "allow.json"
+    p.write_text(json.dumps({"entries": [{"pattern": "event-schema:*"}]}))
+    with pytest.raises(ValueError, match="no reason"):
+        load_allowlist(str(p))
+    p.write_text(json.dumps({"entries": [{"pattern": "event-schema:*",
+                                          "reason": "   "}]}))
+    with pytest.raises(ValueError, match="no reason"):
+        load_allowlist(str(p))
+    p.write_text(json.dumps({"entries": [{"reason": "orphaned"}]}))
+    with pytest.raises(ValueError, match="no pattern"):
+        load_allowlist(str(p))

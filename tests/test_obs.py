@@ -1217,3 +1217,23 @@ def test_labeled_metrics_children_semantics_and_snapshot(tmp_path):
     warnings_out = []
     assert validate_events(str(tmp_path), warnings_out=warnings_out) == []
     assert warnings_out == []
+
+
+def test_metrics_registry_rate_limits_on_injected_clock(tmp_path):
+    """Hostlint fix pin (clock-discipline): maybe_emit's rate limit runs on
+    the injected clock, so a virtual-time (ManualClock) run emits snapshots
+    on the virtual timeline instead of silently reading the wall."""
+    from perceiver_io_tpu.obs.metrics import MetricsRegistry
+
+    t = [100.0]
+    reg = MetricsRegistry(clock=lambda: t[0])
+    reg.counter("n").inc()
+    events = EventLog(str(tmp_path), main_process=True)
+    assert reg.maybe_emit(events, min_interval_s=30)
+    assert not reg.maybe_emit(events, min_interval_s=30)  # inside the window
+    t[0] += 29.0
+    assert not reg.maybe_emit(events, min_interval_s=30)  # still inside
+    t[0] += 1.5
+    assert reg.maybe_emit(events, min_interval_s=30)  # virtual window passed
+    rows = [e for e in read_events(tmp_path) if e["event"] == "metrics"]
+    assert len(rows) == 2

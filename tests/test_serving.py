@@ -628,3 +628,71 @@ def test_run_load_open_loop_with_injected_clock_is_wall_clock_free(tiny_model, t
     assert [r.queue_wait_s for r in report.records] == [0.0] * 4
     assert report.summary["duration_s"] == pytest.approx(offsets[-1], abs=1e-6)
     assert clock() == pytest.approx(offsets[-1], abs=1e-6)
+
+
+# ------------------------------------------- hostlint true-positive pins
+
+
+def test_books_snapshot_is_consistent_under_scrape_hammer(tiny_model, tmp_path):
+    """Hostlint fix pin (shared-state-race:RequestFrontEnd._n): a scrape
+    thread hammering books() while the serving thread books outcomes must
+    always see a CONSISTENT terminal decomposition — the per-outcome counts
+    and their sum come from one _books_lock'd snapshot, never a torn read
+    taken mid-booking."""
+    import threading
+
+    from perceiver_io_tpu.serving.frontend import TERMINAL_OUTCOMES
+
+    fe, events, clock = make_frontend(tiny_model, tmp_path)
+    stop = threading.Event()
+    torn = []
+
+    def scrape():
+        while not stop.is_set():
+            b = fe.books()
+            if b["terminal"] != sum(b[o] for o in TERMINAL_OUTCOMES):
+                torn.append(b)
+
+    t = threading.Thread(target=scrape)
+    t.start()
+    try:
+        fe.run_closed(SPEC.draw(6, 50), concurrency=2)
+    finally:
+        stop.set()
+        t.join()
+    assert torn == [], f"torn books snapshot(s): {torn[:3]}"
+    assert fe.books()["balanced"] and fe.audit() == []
+
+
+def test_default_registry_shares_the_injected_clock(tiny_model, tmp_path):
+    """Hostlint fix pin (clock-discipline:MetricsRegistry): when the front
+    end builds its default registry, the registry's rate-limit clock IS the
+    front end's injected clock — a ManualClock run rate-limits metrics
+    emission in virtual time, not off the wall."""
+    fe, events, clock = make_frontend(tiny_model, tmp_path)
+    assert fe.registry._clock is clock
+
+
+def test_flightrec_dumps_list_consistent_under_concurrent_emit(tmp_path):
+    """Hostlint fix pin (shared-state-race:FlightRecorder.dumps): dump()
+    appends to the dumps list under the ring's lock, so dumps triggered
+    from the serving thread and the signal frame interleave without losing
+    entries; every returned path is recorded, in order."""
+    import threading
+
+    rec = FlightRecorder(None, out_dir=str(tmp_path), max_dumps=64)
+    stop = threading.Event()
+
+    def chatter():
+        while not stop.is_set():
+            rec.emit("probe", step=1)
+
+    t = threading.Thread(target=chatter)
+    t.start()
+    try:
+        paths = [rec.dump("sigusr1") for _ in range(16)]
+    finally:
+        stop.set()
+        t.join()
+    paths = [p for p in paths if p is not None]
+    assert paths and rec.dumps == paths

@@ -31,6 +31,8 @@ Exit codes: 0 — no violation at/above ``--fail-on``; 1 — violations found;
 2 — usage error (e.g. an unknown ``--rules``/``--programs`` name — the
 message lists what is registered); 3 — a rule or target build CRASHED (the
 lint itself is broken, which CI must not confuse with either verdict).
+The contract is shared with tools/hostlint.py through
+perceiver_io_tpu/analysis/lintcli.py.
 
 Rule catalog and allowlist syntax: docs/static-analysis.md.
 """
@@ -40,6 +42,13 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+
+from perceiver_io_tpu.analysis.lintcli import (
+    add_common_lint_args,
+    finish_lint,
+    lint_crashed,
+    parse_rules,
+)
 
 
 def _ensure_devices(n: int) -> None:
@@ -67,18 +76,11 @@ def main(argv=None) -> int:
                         "or a comma list; the sharded pair re-execs with "
                         "virtual CPU devices when the host is short. This is "
                         "the dataflow-rule gate `tasks.py perf` runs")
-    p.add_argument("--rules", default=None,
-                   help="comma list of rules to run (default: all registered); "
-                        "unknown names are a usage error")
-    p.add_argument("--allow", action="append", default=[],
-                   help="extra allowlist entry (repeatable), fnmatch-ed against "
-                        "'rule' and 'rule:scope' — e.g. 'hot-concat:*decode*'")
-    p.add_argument("--fail-on", choices=("error", "warn", "info", "none"),
-                   default="error",
-                   help="exit non-zero when any violation at/above this "
-                        "severity survives the allowlist")
-    p.add_argument("--json", default=None, metavar="PATH",
-                   help="write {target: report} JSON artifact")
+    add_common_lint_args(
+        p,
+        allow_help="extra allowlist entry (repeatable), fnmatch-ed against "
+                   "'rule' and 'rule:scope' — e.g. 'hot-concat:*decode*'",
+    )
     p.add_argument("--compiled", dest="compiled", action="store_true", default=None,
                    help="force lowering+compiling (the donation/collective rules)")
     p.add_argument("--no-compiled", dest="compiled", action="store_false",
@@ -100,19 +102,9 @@ def main(argv=None) -> int:
                         "and a derived collective budget) or the GSPMD step (off)")
     args = p.parse_args(argv)
 
-    rules = None
-    if args.rules:
-        # a typo'd rule name must be a USAGE error (exit 2), not a silent
-        # skip and not an internal crash (exit 3) — list what exists
-        from perceiver_io_tpu.analysis.rules import RULES
+    from perceiver_io_tpu.analysis.rules import RULES
 
-        rules = tuple(r for r in args.rules.split(",") if r)
-        unknown = [r for r in rules if r not in RULES]
-        if unknown:
-            p.error(
-                f"unknown rule(s) {', '.join(unknown)}; registered rules: "
-                f"{', '.join(sorted(RULES))}"
-            )
+    rules = parse_rules(p, args.rules, RULES)
 
     programs = None
     if args.programs:
@@ -182,26 +174,10 @@ def main(argv=None) -> int:
         # exit 3, distinct from 1 (violations found): CI must not read "the
         # linter itself broke" as "the graph got worse" — or, with
         # --fail-on none, as a pass
-        import traceback
+        return lint_crashed("graphlint", e)
 
-        traceback.print_exc()
-        print(f"graphlint ERROR (rule or target build crashed): {e}")
-        return 3
-
-    for report in reports.values():
-        print(report.format())
-        print()
-    if args.json:
-        with open(args.json, "w") as f:
-            json.dump({k: r.to_dict() for k, r in reports.items()}, f, indent=1)
-        print(f"wrote {args.json}")
-
-    failed = [k for k, r in reports.items() if not r.ok(args.fail_on)]
-    if failed:
-        print(f"graphlint FAILED ({args.fail_on}+) on: {', '.join(failed)}")
-        return 1
-    print(f"graphlint ok ({len(reports)} target(s), fail-on={args.fail_on})")
-    return 0
+    return finish_lint("graphlint", reports, fail_on=args.fail_on,
+                       json_path=args.json)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,113 @@
+"""hostlint CLI — static protocol analysis of the host-side serving stack.
+
+Where graphlint lints the *compiled graphs*, hostlint lints the *Python
+that drives them*: it parses ``perceiver_io_tpu/serving/`` and
+``perceiver_io_tpu/obs/`` into per-function CFGs (exception edges
+included), a call graph rooted at the declared entry contexts (drive
+loops, ObsServer handlers, signal handlers, the loadgen producer) and
+per-class attribute access sets, then runs the five protocol rules —
+books-exactness, shared-state-race, clock-discipline, grant-pairing,
+event-schema (catalog: docs/static-analysis.md#hostlint):
+
+    python tools/hostlint.py                      # the committed gate
+    python tools/hostlint.py --fail-on warn
+    python tools/hostlint.py --rules books-exactness,shared-state-race
+    python tools/hostlint.py --json hostlint.json
+    python tools/hostlint.py --no-default-allow   # show every raw finding
+    python tools/hostlint.py --paths serving=some/dir  # lint a fixture tree
+
+The committed allowlist (``contracts/hostlint_allow.json``) carries one
+reasoned entry per accepted finding on the real surface — an entry without
+a non-empty ``reason`` fails to load. ``--allow`` adds ad-hoc entries on
+top; ``--no-default-allow`` drops the committed file (the raw-surface
+view used when triaging a new rule).
+
+Exit codes (shared with tools/graphlint.py via analysis/lintcli.py):
+0 — clean at ``--fail-on``; 1 — violations; 2 — usage error (unknown
+``--rules`` name lists the registry); 3 — the lint itself crashed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # `python tools/hostlint.py` from anywhere
+    sys.path.insert(0, _REPO)
+
+from perceiver_io_tpu.analysis.lintcli import (  # noqa: E402
+    add_common_lint_args,
+    finish_lint,
+    lint_crashed,
+    parse_rules,
+)
+
+DEFAULT_ALLOWLIST = os.path.join(_REPO, "contracts", "hostlint_allow.json")
+DEFAULT_PATHS = (
+    ("serving", os.path.join(_REPO, "perceiver_io_tpu", "serving")),
+    ("obs", os.path.join(_REPO, "perceiver_io_tpu", "obs")),
+)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    add_common_lint_args(
+        p,
+        allow_help="extra allowlist entry (repeatable), fnmatch-ed against "
+                   "'rule' and 'rule:scope' — e.g. "
+                   "'shared-state-race:RequestFrontEnd.*'",
+    )
+    p.add_argument(
+        "--paths", action="append", default=[], metavar="PREFIX=DIR",
+        help="lint these package trees instead of the default "
+             "serving/+obs/ pair (repeatable; PREFIX becomes the module "
+             "prefix in violation scopes) — fixture trees in tests use this",
+    )
+    p.add_argument(
+        "--no-default-allow", action="store_true",
+        help="ignore the committed allowlist "
+             "(contracts/hostlint_allow.json) — the raw-surface triage view",
+    )
+    args = p.parse_args(argv)
+
+    from perceiver_io_tpu.analysis.hostrules import HOST_RULES
+
+    rules = parse_rules(p, args.rules, HOST_RULES)
+
+    packages = list(DEFAULT_PATHS)
+    if args.paths:
+        packages = []
+        for spec in args.paths:
+            prefix, sep, d = spec.partition("=")
+            if not sep or not prefix or not d:
+                p.error(f"--paths wants PREFIX=DIR, got {spec!r}")
+            packages.append((prefix, d))
+
+    allow = list(args.allow)
+    try:
+        from perceiver_io_tpu.analysis.hostgraph import build_package_graph
+        from perceiver_io_tpu.analysis.hostrules import (
+            default_host_policy,
+            host_check,
+            load_allowlist,
+        )
+
+        if not args.no_default_allow and os.path.exists(DEFAULT_ALLOWLIST):
+            committed, _entries = load_allowlist(DEFAULT_ALLOWLIST)
+            allow = list(committed) + allow
+        graph = build_package_graph(packages)
+        report = host_check(
+            graph, policy=default_host_policy(), rules=rules,
+            allow=tuple(allow),
+        )
+    except Exception as e:  # noqa: BLE001 — a crashed lint is not a verdict
+        return lint_crashed("hostlint", e)
+
+    return finish_lint("hostlint", {"host": report}, fail_on=args.fail_on,
+                       json_path=args.json)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
