@@ -858,6 +858,13 @@ def default_host_policy() -> HostPolicy:
             "*:EngineFrontEnd.pump", "*:EngineFrontEnd.run_closed",
             "*:EngineFrontEnd.run_open", "*:EngineFrontEnd.drain",
             "*:EngineFrontEnd.recover",
+            # the fleet router's submit surface and drive loop (Fleetline,
+            # serving/router.py) — dispatch, step, drain and failover all
+            # touch the replica table the scrape thread reads
+            "*:FleetRouter.submit", "*:FleetRouter.pump",
+            "*:FleetRouter.run_closed", "*:FleetRouter.step",
+            "*:FleetRouter.drain_replica", "*:FleetRouter.check_replicas",
+            "*:FleetRouter.failover",
             # hot-path writers reached through chained registry calls
             # (self.registry.counter(...).inc() hides the receiver type)
             "*:Counter.inc", "*:Gauge.set", "*:Gauge.add",
@@ -872,6 +879,8 @@ def default_host_policy() -> HostPolicy:
             "*:ObsServer._handle", "*:ObsServer._slo",
             "*:RequestFrontEnd.health", "*:RequestFrontEnd.books",
             "*:RequestFrontEnd.audit", "*:CircuitBreaker.health",
+            "*:FleetRouter.health", "*:FleetRouter.books",
+            "*:FleetRouter.audit",
             "*:MetricsRegistry.to_prometheus", "*:MetricsRegistry.snapshot",
             "*:Histogram.state", "*:Counter.value", "*:Gauge.value",
         ),

@@ -380,6 +380,13 @@ _REQUIRED_FIELDS: Dict[str, tuple] = {
         "n_requests", "n_tenants", "offered_rps", "achieved_rps",
         "fairness_jain", "max_starvation_age_s",
     ),
+    # Fleetline (serving/router.py, docs/serving.md#fleet): replica
+    # lifecycle transitions on the fleet router (join / drain / drained /
+    # dead / degraded / restored), and the journal failover — a dead
+    # replica's write-ahead journal replayed onto a survivor, the
+    # fleet-level half of the Evictline recovery audit trail
+    "serve.replica": ("replica_id", "transition"),
+    "serve.failover": ("dead_replica", "survivor", "n_replayed"),
 }
 
 # OPTIONAL fields validated WHEN PRESENT (type-checked, never required —
@@ -417,6 +424,17 @@ _OPTIONAL_FIELD_TYPES: Dict[str, Dict[str, tuple]] = {
     "serve.recover": {"tenant": (str,)},
     # Shareline: tenant identity and the token count the skip saved
     "serve.prefix_hit": {"tenant": (str,), "tokens_skipped": (int, float)},
+    # Fleetline: the replica's outstanding depth at the transition and a
+    # free-form reason ("heartbeat_timeout", "injected_kill", "sigterm") —
+    # optional so minimal transition rows stay valid
+    "serve.replica": {"reason": (str,), "outstanding": (int, float)},
+    # Fleetline: how many of the dead replica's requests were parked vs
+    # re-queued on the survivor, and the dead journal's path for post-mortem
+    "serve.failover": {
+        "n_parked": (int, float), "n_queued": (int, float),
+        "n_already_complete": (int, float), "n_shed": (int, float),
+        "journal": (str,),
+    },
 }
 
 # the closed terminal-outcome vocabulary of `request` rows (the serving

@@ -21,6 +21,9 @@ checked on the way through:
 - ``blast`` — a ``probe.blast`` blast-radius report (Probeline sentinel
   attribution, obs/probes.py);
 - ``sentinel`` — a ``fault.spike`` / ``fault.halt`` sentinel trip;
+- ``failover`` — a ``serve.failover`` row (Fleetline, serving/router.py):
+  a dead replica's journal replayed onto a survivor — the dump names the
+  dead replica and freezes the ring around the handoff;
 - ``sigusr1`` — on demand from outside (:meth:`install_signal_handler`),
   the classic "the run looks wrong, dump what you have" lever.
 
@@ -190,6 +193,12 @@ class FlightRecorder:
             # the circuit breaker tripping IS the post-mortem moment: the
             # ring holds the error/sentinel rows that opened it
             return "breaker"
+        elif event == "serve.failover":
+            # a replica died and its journal was replayed onto a survivor
+            # (Fleetline, serving/router.py): the dump names the dead
+            # replica and freezes the ring around the handoff — the fleet
+            # post-mortem entry point
+            return "failover"
         return None
 
     def ring(self) -> List[Dict]:

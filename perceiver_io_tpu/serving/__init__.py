@@ -11,7 +11,9 @@ request reaches exactly one terminal outcome
 (``ok | error | timeout | shed | cancelled``), auditable via
 ``RequestFrontEnd.books()``. ``serving.faultinject`` provides the
 deterministic fault injector and manual clock ``tools/chaos.py``'s
-``serve_*`` scenarios certify the whole shell with.
+``serve_*`` scenarios certify the whole shell with. ``serving.router``
+(Fleetline) runs N engine replicas behind one submit surface with
+least-outstanding dispatch, drain/join, and journal-backed failover.
 
 See docs/robustness.md#serving-hardening.
 """
@@ -49,6 +51,11 @@ from perceiver_io_tpu.serving.pages import (  # noqa: F401
     PageGrant,
     PageStats,
 )
+from perceiver_io_tpu.serving.router import (  # noqa: F401
+    FleetConfig,
+    FleetRouter,
+    ReplicaHandle,
+)
 
 __all__ = [
     "EngineConfig",
@@ -72,4 +79,7 @@ __all__ = [
     "FrontEndRecord",
     "DecodePathFailure",
     "RequestFrontEnd",
+    "FleetConfig",
+    "FleetRouter",
+    "ReplicaHandle",
 ]

@@ -450,6 +450,55 @@ class EngineFrontEnd(RequestFrontEnd):
         if released:
             self.prefix_index.expire_pages(released)
 
+    def _fork_shared_append_page(self, ca_grant, append_pos: int):
+        """Copy-on-write guard on the decode append path: if the CA page
+        that token position ``append_pos`` writes into is SHARED (held by
+        a prefix co-owner), fork it via ``PageAllocator.cow_fork`` and copy
+        the page's device rows into the fresh page — the append then lands
+        in bytes this grant exclusively owns, never in the co-owner's.
+
+        Returns the (possibly forked) grant, or None when the pool has no
+        fresh page to fork into (the caller sheds/backs off exactly like a
+        failed allocation — the original grant is untouched). With the
+        current whole-page sharing cap (``_match_prefix`` caps matches to
+        whole pages strictly inside the context region) the append page is
+        never shared and this is a no-op guard; a partially-filled shared
+        tail page would hit the fork path.
+        """
+        ps = self.engine_config.page_size
+        page_slot = append_pos // ps
+        page = ca_grant.pages[page_slot]
+        if page not in ca_grant.shared_pages:
+            return ca_grant
+        forked = self.ca_alloc.cow_fork(ca_grant, page)
+        if forked is None:
+            return None
+        fresh = forked.pages[page_slot]
+        # the device copy is the caller's job (pages.cow_fork contract):
+        # duplicate the shared page's pool rows into the fresh page so the
+        # co-owner's resident tokens survive this grant's appends
+        caches = list(self._state["cache"])
+        pool = caches[0]
+        updates = dict(k=pool.k.at[fresh].set(pool.k[page]),
+                       v=pool.v.at[fresh].set(pool.v[page]))
+        if pool.k_scale is not None:
+            updates["k_scale"] = pool.k_scale.at[fresh].set(pool.k_scale[page])
+            updates["v_scale"] = pool.v_scale.at[fresh].set(pool.v_scale[page])
+        caches[0] = pool.replace(**updates)
+        self._state = dict(self._state, cache=tuple(caches))
+        if "draft_cache" in self._state:
+            # drafter CA pool mirrors the flagship's page ids — same copy
+            dcaches = list(self._state["draft_cache"])
+            dpool = dcaches[0]
+            dupd = dict(k=dpool.k.at[fresh].set(dpool.k[page]),
+                        v=dpool.v.at[fresh].set(dpool.v[page]))
+            if dpool.k_scale is not None:
+                dupd["k_scale"] = dpool.k_scale.at[fresh].set(dpool.k_scale[page])
+                dupd["v_scale"] = dpool.v_scale.at[fresh].set(dpool.v_scale[page])
+            dcaches[0] = dpool.replace(**dupd)
+            self._state = dict(self._state, draft_cache=tuple(dcaches))
+        return forked
+
     def _try_join(self, ticket: _Ticket, slot_id: int) -> bool:
         """Prefill the ticket's request and land it in ``slot_id``. Returns
         False (ticket stays queued) when pages are short RIGHT NOW; raises
@@ -475,6 +524,15 @@ class EngineFrontEnd(RequestFrontEnd):
         if sa_grant is None:
             self._free_ca(ca_grant)
             return False
+        if ca_grant.shared_pages:
+            # COW guard: the first decode append (CA position prompt_len)
+            # must never write into a page a prefix co-owner still reads
+            forked = self._fork_shared_append_page(ca_grant, rec.prompt_len)
+            if forked is None:
+                self._free_ca(ca_grant)
+                self.sa_alloc.free(sa_grant)
+                return False  # pool dry for the fork: wait like any alloc miss
+            ca_grant = forked
         self._queue.remove(ticket)
         self._set_queue_gauge()
         now = float(self._clock())
@@ -918,11 +976,29 @@ class EngineFrontEnd(RequestFrontEnd):
 
     # -- crash recovery (Evictline) ------------------------------------------
 
-    def recover(self, journal) -> dict:
+    def recover(self, journal, handoff_id: Optional[str] = None) -> dict:
         """Re-admit a dead engine's non-terminal requests from its
         write-ahead journal (``serving.journal.RequestJournal`` or a path)
         into THIS fresh engine, and adopt the journal so both incarnations'
         records share one file — the cross-restart books close over it.
+
+        Replay is IDEMPOTENT on request index: an index this engine already
+        carries (queued, in a slot, parked, or terminal) is skipped — so
+        applying the same journal twice, or replaying a journal onto a
+        survivor that already adopted some of its requests, is a no-op on
+        the second pass (the ``skipped`` count in the summary says how many
+        were deduped).
+
+        Two recovery shapes share this seam. A fresh engine WITHOUT its own
+        journal (the restart case) ADOPTS the journal — both incarnations
+        append to one file. A survivor WITH its own journal (fleet
+        failover, serving/router.py) KEEPS it: each adopted request is
+        re-journaled (submitted/admitted/progress) into the survivor's own
+        file where its terminal record will land, and the dead journal gets
+        a ``recovered`` record carrying ``handoff=<handoff_id>`` (default:
+        this engine's journal path) so its books close and a third replay
+        cannot double-adopt (``RequestJournal.pending`` excludes handed-off
+        entries).
 
         Every journaled ``submitted`` without a ``terminal`` comes back:
         requests with journaled progress are PARKED (prompt + progress
@@ -943,8 +1019,13 @@ class EngineFrontEnd(RequestFrontEnd):
         ``serve.recover`` event per request; returns a summary dict."""
         from perceiver_io_tpu.serving.journal import RequestJournal
 
-        ec, mcfg = self.engine_config, self.model.config
-        if ec.max_ca_tokens > mcfg.max_seq_len or ec.max_sa_tokens > mcfg.max_latents:
+        ec = self.engine_config
+        # the sim-scale engine has no model (service times stand in for the
+        # compiled programs) — and no window to slide, so no geometry check
+        mcfg = getattr(self.model, "config", None)
+        if mcfg is not None and (
+            ec.max_ca_tokens > mcfg.max_seq_len or ec.max_sa_tokens > mcfg.max_latents
+        ):
             # the construction-time no-slide check only fires when a journal
             # (or eviction) was configured — recover() can adopt a journal
             # onto any engine, so the replay's geometry contract re-checks
@@ -957,12 +1038,40 @@ class EngineFrontEnd(RequestFrontEnd):
             )
         if not isinstance(journal, RequestJournal):
             journal = RequestJournal(journal)
-        self.journal = journal
+        handoff_mode = self.journal is not None and self.journal is not journal
+        if handoff_mode:
+            own = self.journal  # the survivor keeps its own ledger
+            if handoff_id is None:
+                handoff_id = own.path
+        else:
+            self.journal = journal
+            own = journal
         now = float(self._clock())
         eos = self._gen_config.eos_token_id
-        n = done_already = shed = 0
+        n = done_already = shed = skipped = 0
+        known = {r.index for r in self.records}
         for entry in journal.pending():
+            if entry.index in known:
+                # idempotence: this engine already carries the index
+                # (double-replay, or a failover racing an earlier adoption)
+                skipped += 1
+                continue
             spec = entry.spec()
+            if handoff_mode:
+                # re-journal the adopted request into the survivor's own
+                # ledger (terminal will land there), then close it in the
+                # dead one — every index terminal-exactly-once FLEET-wide
+                jfields = dict(
+                    prompt_len=int(entry.prompt_len),
+                    max_new_tokens=int(entry.max_new_tokens),
+                    input_ids=list(entry.input_ids),
+                    rng_seed=int(entry.rng_seed),
+                    deadline_s=(None if entry.deadline_s is None
+                                else float(entry.deadline_s)),
+                )
+                if entry.tenant is not None:
+                    jfields["tenant"] = entry.tenant
+                own.append("submitted", entry.index, **jfields)
             rec = FrontEndRecord(
                 index=entry.index,
                 prompt_len=int(entry.prompt_len),
@@ -991,8 +1100,13 @@ class EngineFrontEnd(RequestFrontEnd):
                 self._m_shed.inc()
                 if rec.tenant is not None:
                     self._m_shed.labels(tenant=rec.tenant).inc()
-                journal.append("terminal", entry.index, outcome="shed",
-                               shed_reason=reason)
+                own.append("terminal", entry.index, outcome="shed",
+                           shed_reason=reason)
+                if handoff_mode:
+                    # close the dead ledger too: the shed verdict lives in
+                    # the survivor's journal, the handoff marker here
+                    journal.append("recovered", entry.index,
+                                   tokens_resumed=0, handoff=str(handoff_id))
                 self._emit_frontend_request(rec, shed_reason=reason,
                                             queue_depth=len(self._queue),
                                             **detail)
@@ -1020,7 +1134,18 @@ class EngineFrontEnd(RequestFrontEnd):
                 self.served_tokens[entry.index] = tokens
             self._n_recovered += 1
             self._m_recovered.inc()
-            journal.append("recovered", entry.index, tokens_resumed=len(tokens))
+            if handoff_mode:
+                own.append("admitted", entry.index)
+                if tokens:
+                    # the adopted progress, re-journaled: a later crash of
+                    # the SURVIVOR replays prompt + these + its own tokens
+                    own.append("progress", entry.index, tokens=tokens)
+                journal.append("recovered", entry.index,
+                               tokens_resumed=len(tokens),
+                               handoff=str(handoff_id))
+            else:
+                journal.append("recovered", entry.index,
+                               tokens_resumed=len(tokens))
             if self.events is not None:
                 row = dict(request_index=entry.index, tokens_resumed=len(tokens))
                 if entry.tenant is not None:
@@ -1062,6 +1187,7 @@ class EngineFrontEnd(RequestFrontEnd):
             "queued": len(self._queue),
             "already_complete": done_already,
             "shed": shed,
+            "skipped": skipped,
         }
 
     # -- the engine loop -----------------------------------------------------

@@ -23,6 +23,19 @@ cooperating model:
   circuit breaker — the injection exercises the whole in-graph detection
   path, not a mock.
 
+The fleet tier (Fleetline, ``serving/router.py``) adds **replica**
+coordinates on top of the request ones:
+
+- :meth:`FaultInjector.kill_replica_at` — raise :class:`EngineCrash` out of
+  a named replica's Nth drive step (the "whole process died" class at fleet
+  scale; the router's failover replays the dead replica's journal onto a
+  survivor);
+- :meth:`FaultInjector.brownout_replica` — multiply a replica's service
+  time by a factor (consumed through :meth:`latency_factor` by the
+  sim-scale engine): the replica stays alive and healthy-looking at the
+  RPC level while its EWMA step time degrades, which is exactly the
+  failure health-based routing must detect.
+
 Explicit coordinates make scenarios exactly replayable;
 :meth:`seeded_kills` draws coordinates from a seeded generator for
 soak-style runs (deterministic for a given seed, same discipline as
@@ -130,6 +143,8 @@ class FaultInjector:
         self._stalls: Dict[Tuple[int, Optional[int]], float] = {}
         self._prefill_fails: Dict[int, List[BaseException]] = {}
         self._poisoned: set = set()
+        self._replica_kills: Dict[str, int] = {}
+        self._brownouts: Dict[str, float] = {}
         self.injected: List[dict] = []  # audit: what actually fired
 
     # -- planning -----------------------------------------------------------
@@ -188,6 +203,35 @@ class FaultInjector:
         self._poisoned.add(int(request_index))
         return self
 
+    def kill_replica_at(self, replica_id: str, step: int) -> "FaultInjector":
+        """Tear a named REPLICA down on its ``step``-th drive step (0-based,
+        counted by the replica's own drive loop): raises
+        :class:`EngineCrash` from :meth:`on_replica_step` — the fleet-scale
+        "process died" coordinate the router's journal failover is
+        certified against (``tools/chaos.py serve_fleet_failover``)."""
+        self._replica_kills[str(replica_id)] = int(step)
+        return self
+
+    def brownout_replica(self, replica_id: str,
+                         factor: float) -> "FaultInjector":
+        """Degrade a named replica: its service time is multiplied by
+        ``factor`` (> 1) until :meth:`clear_brownout`. Consumed through
+        :meth:`latency_factor` by the sim-scale engine's service-time
+        sampling — the replica stays in the fleet, it just gets slow."""
+        if float(factor) <= 0:
+            raise ValueError(f"brownout factor must be > 0, got {factor}")
+        self._brownouts[str(replica_id)] = float(factor)
+        self.injected.append({"kind": "brownout", "replica": str(replica_id),
+                              "factor": float(factor)})
+        return self
+
+    def clear_brownout(self, replica_id: str) -> "FaultInjector":
+        """Restore a browned-out replica to nominal service time."""
+        if self._brownouts.pop(str(replica_id), None) is not None:
+            self.injected.append({"kind": "brownout_clear",
+                                  "replica": str(replica_id)})
+        return self
+
     def seeded_kills(self, n_requests: int, rate: float, max_token: int = 4,
                      seed: int = 0) -> "FaultInjector":
         """Draw kill coordinates from a seeded generator: each request is
@@ -231,6 +275,28 @@ class FaultInjector:
             self.injected.append({"kind": "prefill_fail", "request": request_index,
                                   "error": repr(e)})
             raise e
+
+    def on_replica_step(self, replica_id: str, step: int) -> None:
+        """Called by the fleet router's drive loop once per replica step;
+        raises the planted :class:`EngineCrash` when the armed step is
+        reached (one-shot — the coordinate is popped so failover's replay
+        on a survivor cannot re-fire it)."""
+        armed = self._replica_kills.get(str(replica_id))
+        if armed is not None and int(step) >= armed:
+            self._replica_kills.pop(str(replica_id))
+            self.injected.append({"kind": "replica_kill",
+                                  "replica": str(replica_id),
+                                  "step": int(step)})
+            raise EngineCrash(
+                f"injected replica crash: {replica_id} at step {step}"
+            )
+
+    def latency_factor(self, replica_id: Optional[str]) -> float:
+        """The service-time multiplier currently in force for a replica
+        (1.0 when nominal or unnamed) — the brownout consumption seam."""
+        if replica_id is None:
+            return 1.0
+        return self._brownouts.get(str(replica_id), 1.0)
 
     def params_for(self, request_index: int, params):
         """Params the request should be served with (poisoned or not)."""

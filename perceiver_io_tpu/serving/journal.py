@@ -36,6 +36,15 @@ Books balance ACROSS the restart: both engine incarnations append to the
 same file, so :meth:`books`/:meth:`audit` close over the union —
 ``submitted == terminal`` by request index once the recovered engine
 drains.
+
+Fleet failover (Fleetline, ``serving/router.py``) adds a second recovery
+shape: the dead replica's journal is replayed onto a SURVIVOR that keeps
+its own journal. The survivor re-journals each adopted request into its
+own file (where its terminal record will land), and the dead journal gets
+a ``recovered`` record with ``handoff`` naming the survivor — a handed-off
+entry counts as CLOSED in the dead journal's :meth:`books`/:meth:`audit`
+(its terminal outcome lives in the survivor's ledger) and is excluded from
+:meth:`pending` so a third replay cannot double-adopt it.
 """
 
 from __future__ import annotations
@@ -61,7 +70,7 @@ class JournalEntry:
     __slots__ = (
         "index", "prompt_len", "max_new_tokens", "input_ids", "rng_seed",
         "deadline_s", "tenant", "admitted", "tokens", "terminal",
-        "evictions", "recovered",
+        "evictions", "recovered", "handoff",
     )
 
     def __init__(self, index: int):
@@ -77,6 +86,9 @@ class JournalEntry:
         self.terminal: Optional[str] = None
         self.evictions = 0
         self.recovered = False
+        # set when a fleet failover handed this request to another replica's
+        # journal (the survivor's id): closed HERE, terminal THERE
+        self.handoff: Optional[str] = None
 
     def spec(self):
         """The reconstructed ``obs.loadgen.RequestSpec`` (numpy prompt)."""
@@ -197,6 +209,9 @@ class RequestJournal:
                 entry.evictions += 1
             elif kind == "recovered":
                 entry.recovered = True
+                handoff = row.get("handoff")
+                if handoff is not None:
+                    entry.handoff = str(handoff)
             elif kind == "terminal":
                 entry.terminal = row.get("outcome")
         return state
@@ -206,10 +221,14 @@ class RequestJournal:
         in first-submission order. An entry whose ``submitted`` record was
         torn/unparseable (no spec identity to rebuild) is EXCLUDED — it
         cannot be recovered, and :meth:`audit` reports it rather than
-        recover() dying mid-way and taking the intact requests with it."""
+        recover() dying mid-way and taking the intact requests with it.
+        A handed-off entry (fleet failover already adopted it elsewhere)
+        is likewise excluded — replaying this journal a second time onto
+        yet another replica must not double-adopt."""
         return [
             e for e in self.replay().values()
             if e.terminal is None and e.prompt_len is not None
+            and e.handoff is None
         ]
 
     # -- the books across the restart ---------------------------------------
@@ -223,6 +242,12 @@ class RequestJournal:
         state = self.replay()
         submitted = [e.index for e in state.values() if e.prompt_len is not None]
         terminal = [e.index for e in state.values() if e.terminal is not None]
+        # a handed-off request is closed in THIS ledger (its terminal
+        # outcome lives in the adopting replica's journal)
+        closed = [
+            e.index for e in state.values()
+            if e.terminal is not None or e.handoff is not None
+        ]
         outcomes: Dict[str, int] = {}
         for e in state.values():
             if e.terminal is not None:
@@ -230,11 +255,12 @@ class RequestJournal:
         return {
             "submitted": len(submitted),
             "terminal": len(terminal),
-            "pending": len(submitted) - len(terminal),
+            "pending": len(submitted) - len(closed),
             "recovered": sum(1 for e in state.values() if e.recovered),
+            "handed_off": sum(1 for e in state.values() if e.handoff is not None),
             "evictions": sum(e.evictions for e in state.values()),
             "outcomes": outcomes,
-            "balanced": set(submitted) == set(terminal),
+            "balanced": set(submitted) == set(closed),
         }
 
     def audit(self) -> List[str]:
@@ -256,6 +282,11 @@ class RequestJournal:
             if idx not in state or state[idx].prompt_len is None:
                 problems.append(f"request {idx}: terminal without a submitted record")
         for e in state.values():
+            if e.terminal is None and e.handoff is not None:
+                # fleet failover closed this entry here: its terminal
+                # outcome is owed by (and audited in) the adopting
+                # replica's journal, not this one
+                continue
             if e.terminal is None:
                 if e.prompt_len is None:
                     # progress/admitted rows whose submitted record was torn

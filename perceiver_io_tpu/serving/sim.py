@@ -249,10 +249,15 @@ class SimEngineFrontEnd(EngineFrontEnd):
         registry=None,
         journal=None,
         injector=None,
+        replica_id: Optional[str] = None,
     ):
         clock = clock if clock is not None else ManualClock()
         if not hasattr(clock, "advance"):
             raise TypeError("SimEngineFrontEnd needs a ManualClock-style clock")
+        # Fleetline: the replica coordinate a FaultInjector brownout keys
+        # on — every sampled service time is scaled by the injector's
+        # latency_factor for this replica (1.0 when nominal/unnamed)
+        self.replica_id = None if replica_id is None else str(replica_id)
         # the sequential front end's host surface (queue, breaker, books,
         # tracer, labeled serve_* counters) — skipping EngineFrontEnd's
         # jax/model construction on purpose
@@ -328,6 +333,15 @@ class SimEngineFrontEnd(EngineFrontEnd):
         # the timeline (the real engine reads wall perf_counter here)
         return float(self._clock())
 
+    def _latency_factor(self) -> float:
+        """The brownout multiplier in force for this replica (Fleetline:
+        ``FaultInjector.brownout_replica`` degrades a named replica's
+        service times without taking it out of the fleet)."""
+        if self._injector is None:
+            return 1.0
+        factor = getattr(self._injector, "latency_factor", None)
+        return 1.0 if factor is None else float(factor(self.replica_id))
+
     # -- join / step / resume, virtual-time editions -------------------------
 
     def _try_join(self, ticket, slot_id: int) -> bool:
@@ -366,7 +380,7 @@ class SimEngineFrontEnd(EngineFrontEnd):
         # matched join is charged only the UNMATCHED token fraction — the
         # real shared prefill skips exactly the matched pages' embed +
         # CA k/v compute, so its service span shrinks proportionally
-        ttft = self.service_model.sample_prefill(self._rng)
+        ttft = self.service_model.sample_prefill(self._rng) * self._latency_factor()
         if matched:
             skip = len(matched) * self.engine_config.page_size
             ttft *= (rec.prompt_len - skip) / rec.prompt_len
@@ -415,7 +429,9 @@ class SimEngineFrontEnd(EngineFrontEnd):
         # over the active slots' sampled per-token times — the slowest slot
         # gates the batch, the interference the noisy-neighbor scenario
         # measures
-        per = {sid: self.service_model.sample_tpot(self._rng) for sid in active}
+        factor = self._latency_factor()
+        per = {sid: self.service_model.sample_tpot(self._rng) * factor
+               for sid in active}
         dt = max(per.values())
         self.clock.advance(dt)
         self._engine_steps += 1
@@ -461,7 +477,9 @@ class SimEngineFrontEnd(EngineFrontEnd):
             slot.span = Span(name="request", parent_id=None, attrs=attrs)
         # resume replay costs one prefill-shaped service span (prompt +
         # served prefix), exactly the real engine's replay structure
-        self.clock.advance(self.service_model.sample_prefill(self._rng))
+        self.clock.advance(
+            self.service_model.sample_prefill(self._rng) * self._latency_factor()
+        )
         rec.attempts += 1
         n = slot.tokens_out
         slot.tokens_out = n + 1
@@ -638,6 +656,197 @@ def run_sim(
         })
         fe.registry.maybe_emit(events, min_interval_s=0.0)
     return SimReport(summary=summary, frontend=fe, duration_s=duration_s)
+
+
+# ---------------------------------------------------------------------------
+# Fleetline: the fleet-scale discrete-event simulation
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class FleetSimReport:
+    """:func:`run_fleet_sim`'s result: the fleet summary, the router (fleet
+    books/health inspectable), the per-replica front ends, and the fleet
+    timeline (the latest replica clock)."""
+
+    summary: Dict
+    router: object
+    frontends: List[SimEngineFrontEnd]
+    duration_s: float
+
+
+def summarize_fleet_sim(router, tenants: List[TenantSpec],
+                        duration_s: float) -> Dict:
+    """The fleet-sim summary: topline achieved/offered rates and token
+    throughput across every replica, demand-normalized Jain fairness, max
+    starvation age, the FLEET books identity (``FleetRouter.books``), and
+    one per-replica block each (state, terminals, step EWMA)."""
+    duration_s = max(float(duration_s), 1e-9)
+    books = router.books()
+    with router._lock:
+        handles = list(router._replicas.values())
+    records = [r for h in handles for r in h.frontend.records]
+    terminal = [r for r in records if r.outcome is not None]
+    ok = [r for r in terminal if r.outcome == "ok"]
+    starve = [float(r.queue_wait_s) for r in ok if r.queue_wait_s is not None]
+    offered_rps = sum(t.rate_rps for t in tenants)
+    shares = []
+    per_tenant: Dict[str, Dict] = {}
+    for t in tenants:
+        tok = [r for r in ok if r.tenant == t.name]
+        achieved = len(tok) / duration_s
+        shares.append(achieved / t.rate_rps)
+        per_tenant[t.name] = {
+            "offered_rps": round(t.rate_rps, 6),
+            "achieved_rps": round(achieved, 6),
+            "ok": len(tok),
+            "tokens_out": sum(r.tokens_out for r in tok),
+        }
+    per_replica: Dict[str, Dict] = {}
+    for h in handles:
+        b = books["replicas"][h.replica_id]
+        per_replica[h.replica_id] = {
+            "state": h.state,
+            "degraded": h.degraded,
+            "steps": h.steps,
+            "ewma_step_s": h.ewma_step_s,
+            "submitted": b["submitted"],
+            "terminal": b["terminal"],
+            "ok": b["ok"],
+            "shed": b["shed"],
+        }
+    # distinct workload requests = dispatches minus the shed re-dispatch
+    # retries (each retry re-submits the SAME index to another replica)
+    n_requests = books["dispatched"] - books["requeued"]
+    return {
+        "mode": "fleet_sim",
+        "n_replicas": len(handles),
+        "n_requests": n_requests,
+        "n_tenants": len(tenants),
+        "duration_s": round(duration_s, 6),
+        "offered_rps": round(offered_rps, 6),
+        "achieved_rps": round(len(ok) / duration_s, 6),
+        "throughput_tok_s": round(sum(r.tokens_out for r in ok) / duration_s, 6),
+        "shed_rate": round(books["outcomes"]["shed"] / max(n_requests, 1), 6),
+        "fairness_jain": round(jain_fairness(shares), 6),
+        "max_starvation_age_s": round(max(starve), 6) if starve else 0.0,
+        "evictions": sum(b["evictions"] for b in books["replicas"].values()),
+        "failovers": books["failovers"],
+        "requeued": books["requeued"],
+        "tokens_out": sum(r.tokens_out for r in ok),
+        "books": {k: v for k, v in books.items() if k != "replicas"},
+        "books_balanced": books["balanced"],
+        "tenants": per_tenant,
+        "replicas": per_replica,
+    }
+
+
+def run_fleet_sim(
+    tenants: List[TenantSpec],
+    *,
+    n_replicas: int,
+    service_model: ServiceTimeModel,
+    engine_config: Optional[EngineConfig] = None,
+    config=None,
+    events=None,
+    registry=None,
+    seed: int = 1,
+    vocab_size: int = 64,
+    deadline_s: Optional[float] = None,
+    injector=None,
+    fleet_config=None,
+    journal_dir: Optional[str] = None,
+) -> FleetSimReport:
+    """Drive the merged multi-tenant workload through a
+    :class:`~perceiver_io_tpu.serving.router.FleetRouter` over
+    ``n_replicas`` :class:`SimEngineFrontEnd` replicas, each on its OWN
+    :class:`ManualClock` — a discrete-event fleet where replica timelines
+    advance independently, exactly like N processes on N hosts. The drive
+    is next-event: arrivals are admitted once the earliest live replica
+    clock reaches their offset, and the earliest-clock replica with work
+    takes the next step (causality — a replica never serves a request
+    "before" another replica's past). The fleet duration is the LATEST
+    replica clock, so throughput honestly reflects parallel service: the
+    ``sim_fleet`` chaos gate certifies ≥1.7× scaling from 1 to 2 replicas
+    on this loop. ``journal_dir`` gives each replica a write-ahead journal
+    (required for kill/failover runs); ``injector`` feeds both the
+    router's replica-kill coordinates and the replicas' brownouts."""
+    from collections import deque as _deque
+
+    from perceiver_io_tpu.serving.journal import RequestJournal
+    from perceiver_io_tpu.serving.router import FleetConfig, FleetRouter
+
+    if int(n_replicas) < 1:
+        raise ValueError("run_fleet_sim needs n_replicas >= 1")
+    clocks = [ManualClock() for _ in range(int(n_replicas))]
+
+    def fleet_now() -> float:
+        # the router's fleet clock: the latest replica timeline (monotonic
+        # — each ManualClock only moves forward)
+        return max(c.now for c in clocks)
+
+    router = FleetRouter(
+        clock=fleet_now, events=events, registry=registry,
+        config=fleet_config or FleetConfig(), injector=injector,
+    )
+    fes: List[SimEngineFrontEnd] = []
+    for i, clk in enumerate(clocks):
+        rid = f"r{i}"
+        journal = None
+        if journal_dir is not None:
+            import os
+
+            journal = RequestJournal(
+                os.path.join(journal_dir, f"journal-{rid}.jsonl")
+            )
+        fe = SimEngineFrontEnd(
+            service_model=service_model, engine_config=engine_config,
+            clock=clk, seed=seed + i, config=config, events=events,
+            registry=registry, journal=journal, injector=injector,
+            replica_id=rid,
+        )
+        fes.append(fe)
+        router.add_replica(rid, fe)
+
+    specs, offsets = build_multi_tenant_workload(tenants, vocab_size=vocab_size)
+    pending = _deque(zip(specs, offsets))
+    while True:
+        router.check_replicas()
+        live = router._steppable()
+        if not live:
+            break
+        workers = [r for r in live if router._has_work(r.frontend)]
+        frontier = min(float(r.frontend._clock())
+                       for r in (workers or live))
+        while pending and pending[0][1] <= frontier:
+            spec, off = pending.popleft()
+            router.submit(spec, arrival_s=off, deadline_s=deadline_s)
+        workers = [r for r in live if router._has_work(r.frontend)]
+        if not workers:
+            if not pending:
+                break
+            # idle fleet: jump every timeline to the next arrival
+            off = pending[0][1]
+            for c in clocks:
+                c.advance_to(off)
+            continue
+        # causality: the earliest-clock replica with work takes the step
+        rep = min(workers,
+                  key=lambda r: (float(r.frontend._clock()), r.replica_id))
+        router.step(rep.replica_id)
+    duration_s = fleet_now()
+    summary = summarize_fleet_sim(router, tenants, duration_s)
+    if events is not None:
+        events.emit("sim.summary", **{
+            k: summary[k] for k in (
+                "n_requests", "n_tenants", "offered_rps", "achieved_rps",
+                "fairness_jain", "max_starvation_age_s", "duration_s",
+                "shed_rate", "evictions", "books_balanced",
+            )
+        })
+        router.registry.maybe_emit(events, min_interval_s=0.0)
+    return FleetSimReport(summary=summary, router=router, frontends=fes,
+                          duration_s=duration_s)
 
 
 # ---------------------------------------------------------------------------

@@ -238,7 +238,11 @@ def perf(args):
     serve_kill_mid_decode,serve_crash_recover --smoke``: a mid-decode kill
     through the hardened front end with the clean-books audit, plus an
     engine crash recovered token-exactly from the write-ahead journal with
-    books balanced across the restart), and the simulation smoke
+    books balanced across the restart), the fleet-chaos smoke
+    (``tools/chaos.py --scenarios serve_fleet_failover --smoke``: a
+    replica killed mid-decode behind the FleetRouter, its journal
+    replayed token-exactly onto the survivor with fleet books balanced),
+    and the simulation smoke
     (``tools/sim.py --smoke``: the Simline multi-tenant discrete-event
     gate over the real engine control plane — fairness + books + SIM
     floors + per-tenant scrape surface). Extra args go to
@@ -284,6 +288,13 @@ def perf(args):
     run(sys.executable, "tools/chaos.py", "--scenarios",
         "serve_kill_mid_decode,serve_crash_recover,serve_prefix_storm",
         "--smoke")
+    # fleet-chaos smoke leg (Fleetline, docs/serving.md#fleet): kill a
+    # REPLICA mid-decode behind the FleetRouter — the survivor replays its
+    # write-ahead journal token-exactly, the fleet books balance across
+    # the handoff, one flight dump names the dead replica (the full
+    # serve_fleet_*/sim_fleet family runs under `tasks.py chaos`)
+    run(sys.executable, "tools/chaos.py", "--scenarios",
+        "serve_fleet_failover", "--smoke")
     # simulation smoke leg (Simline): two tenants at ~1k simulated req/s
     # through the REAL engine front end under a ManualClock — books +
     # fairness + per-tenant /metrics///slo + self-diff, SIM ledger floors

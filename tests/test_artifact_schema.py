@@ -658,6 +658,78 @@ def test_shareline_event_vocabulary_pinned(tmp_path):
     assert any("prefix" in p for p in problems), problems
 
 
+def test_fleet_event_vocabulary_pinned(tmp_path):
+    """The Fleetline vocabulary (ISSUE 20): ``serve.replica`` (replica
+    lifecycle transitions on the fleet router) and ``serve.failover`` (a
+    dead replica's journal replayed onto a survivor) are KNOWN kinds with
+    required-field enforcement — the failover row carries the replay
+    accounting the post-mortem reads (``n_replayed`` required; the parked/
+    queued/already-complete/shed split and the dead journal's path optional
+    and type-pinned). Minimal transition rows stay valid (``reason`` and
+    ``outstanding`` are optional), missing required fields fail hard."""
+    from perceiver_io_tpu.obs.events import (
+        _OPTIONAL_FIELD_TYPES,
+        _REQUIRED_FIELDS,
+        EVENT_SCHEMA_VERSION,
+        KNOWN_EVENT_KINDS,
+        validate_events,
+    )
+
+    for kind in ("serve.replica", "serve.failover"):
+        assert kind in KNOWN_EVENT_KINDS, kind
+    assert set(_REQUIRED_FIELDS["serve.replica"]) == {"replica_id", "transition"}
+    assert set(_REQUIRED_FIELDS["serve.failover"]) == {
+        "dead_replica", "survivor", "n_replayed"
+    }
+    assert _OPTIONAL_FIELD_TYPES["serve.replica"]["reason"] == (str,)
+    assert "outstanding" in _OPTIONAL_FIELD_TYPES["serve.replica"]
+    for field in ("n_parked", "n_queued", "n_already_complete", "n_shed"):
+        assert field in _OPTIONAL_FIELD_TYPES["serve.failover"], field
+        assert field not in _REQUIRED_FIELDS["serve.failover"], field
+    assert _OPTIONAL_FIELD_TYPES["serve.failover"]["journal"] == (str,)
+
+    def write_stream(rows):
+        path = tmp_path / "events.jsonl"
+        with open(path, "w") as f:
+            for row in rows:
+                f.write(json.dumps({"ts": 1.0, "schema_version": EVENT_SCHEMA_VERSION, **row}) + "\n")
+        return str(path)
+
+    good = write_stream(
+        [
+            {"event": "serve.replica", "replica_id": "r0", "transition": "join"},
+            {"event": "serve.replica", "replica_id": "r0", "transition": "dead",
+             "reason": "heartbeat_timeout", "outstanding": 3},
+            {"event": "serve.failover", "dead_replica": "r0", "survivor": "r1",
+             "n_replayed": 5, "n_parked": 2, "n_queued": 3,
+             "n_already_complete": 0, "n_shed": 0,
+             "journal": "runs/journal-r0.jsonl"},
+            # a minimal failover row (no optional accounting) stays valid
+            {"event": "serve.failover", "dead_replica": "r0", "survivor": "r1",
+             "n_replayed": 0},
+        ]
+    )
+    warnings_out = []
+    assert validate_events(good, strict_spans=False, warnings_out=warnings_out) == []
+    assert warnings_out == []
+
+    # missing required fields: hard failures; malformed optionals: problems
+    bad = write_stream([
+        {"event": "serve.replica", "replica_id": "r0"},
+        {"event": "serve.replica", "transition": "join", "reason": 7},
+        {"event": "serve.failover", "dead_replica": "r0", "survivor": "r1"},
+        {"event": "serve.failover", "dead_replica": "r0", "survivor": "r1",
+         "n_replayed": 5, "n_parked": "two", "journal": 9},
+    ])
+    problems = validate_events(bad, strict_spans=False)
+    assert any("[serve.replica]: missing field 'transition'" in p for p in problems)
+    assert any("[serve.replica]: missing field 'replica_id'" in p for p in problems)
+    assert any("[serve.failover]: missing field 'n_replayed'" in p for p in problems)
+    assert any("reason" in p for p in problems), problems
+    assert any("n_parked" in p for p in problems), problems
+    assert any("journal" in p for p in problems), problems
+
+
 def test_sim_rounds_monotone_and_well_formed():
     """SIM_r*.json — the committed discrete-event certification artifacts
     (ISSUE 16): contiguous round numbering and the machine-read surface

@@ -243,11 +243,64 @@ def test_recover_books_complete_stream_without_replay(model_and_params, tmp_path
     fe = _engine(model, params)
     info = fe.recover(j)
     assert info == {"recovered": 1, "parked": 0, "queued": 0,
-                    "already_complete": 1, "shed": 0}
+                    "already_complete": 1, "shed": 0, "skipped": 0}
     books = fe.books()
     assert books["ok"] == 1 and books["balanced"], books
     assert j.books()["balanced"]
     assert fe.served_tokens[spec.index] == full
+
+
+def test_recover_is_idempotent_on_request_index(model_and_params, tmp_path):
+    """Fleetline satellite (ISSUE 20): replay is IDEMPOTENT on request
+    index — an index this engine already carries (queued, parked, or
+    terminal) is deduped, so applying the same journal twice never
+    double-admits. The second pass answers all-zeros except ``skipped``,
+    the books don't move, and the streams still serve token-exact ONCE."""
+    model, params = model_and_params
+    jpath = str(tmp_path / "journal_idem.jsonl")
+    specs = _specs(6)
+    fe1 = _engine(model, params, journal=jpath,
+                  injector=FaultInjector().crash_at(2, 1))
+    with pytest.raises(EngineCrash):
+        fe1.run_closed(specs, concurrency=6)
+    journal = RequestJournal(jpath)
+    owed = journal.pending()
+    assert len(owed) >= 2, "crash too late — nothing left to dedupe"
+
+    fe2 = _engine(model, params)
+    first = fe2.recover(journal)
+    assert first["recovered"] == len(owed) and first["skipped"] == 0
+    submitted = fe2.books()["submitted"]
+    # second pass BEFORE the replays drain: every still-pending index is
+    # already carried (queued or parked) — deduped, nothing re-admitted
+    # (the parked/queued depths in the summary are point-reads: unmoved)
+    still_owed = journal.pending()  # already-complete ones booked terminal
+    assert len(still_owed) == len(owed) - first["already_complete"]
+    second = fe2.recover(journal)
+    assert second == {"recovered": 0, "parked": first["parked"],
+                      "queued": first["queued"], "already_complete": 0,
+                      "shed": 0, "skipped": len(still_owed)}, second
+    assert second["skipped"] >= 2, "nothing deduped — the test is vacuous"
+    assert fe2.books()["submitted"] == submitted
+
+    fe2.pump()
+    books = fe2.books()
+    assert books["balanced"] and books["parked"] == 0, books
+    assert books["ok"] == len(owed), books
+    # third pass AFTER the drain: the adopted journal's books are closed,
+    # nothing pends — recover is a complete no-op
+    third = fe2.recover(journal)
+    assert third == {"recovered": 0, "parked": 0, "queued": 0,
+                     "already_complete": 0, "shed": 0, "skipped": 0}, third
+    assert fe2.audit() == []
+    jb = journal.books()
+    assert jb["balanced"] and jb["pending"] == 0, jb
+    assert jb["submitted"] == 6 and jb["outcomes"] == {"ok": 6}, jb
+    served = dict(fe1.served_tokens)
+    served.update(fe2.served_tokens)
+    for spec in specs:
+        want = _sequential_tokens(model, params, spec)
+        assert served.get(spec.index) == want, spec.index
 
 
 def test_cancel_reaches_parked_request(model_and_params):

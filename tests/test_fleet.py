@@ -1,0 +1,358 @@
+"""Fleetline tests (ISSUE 20): the replicated-engine router
+(``serving/router.py``) — least-outstanding dispatch with deterministic
+ties, bounded re-dispatch of ADMISSION sheds only, graceful drain with
+zero attributable sheds, journal-backed failover through the
+``EngineFrontEnd.recover`` handoff seam (fleet books identity: nothing
+lost, nothing served twice), heartbeat-timeout death on the injected
+clock, brownout degradation (EWMA vs the fleet floor) steering dispatch
+off a slow replica, and the fleet health/books/metrics surfaces.
+
+No jax computation runs anywhere in this file: every replica is a
+``SimEngineFrontEnd`` (sampled service times over the REAL host control
+plane) on a shared ``ManualClock``, which is the wall-clock-free property
+the chaos scenarios (``tools/chaos.py serve_fleet_*``) certify at scale.
+Token-exact failover on the compiled engine is pinned there and in
+``tests/test_evictline.py``; this file pins the ROUTER's laws.
+"""
+
+import os
+
+import pytest
+
+from perceiver_io_tpu.obs.events import EventLog, merged_events, validate_events
+from perceiver_io_tpu.obs.loadgen import WorkloadSpec
+from perceiver_io_tpu.obs.metrics import MetricsRegistry
+from perceiver_io_tpu.serving import (
+    EngineConfig,
+    FaultInjector,
+    FleetConfig,
+    FleetRouter,
+    FrontEndConfig,
+    ManualClock,
+    RequestJournal,
+)
+from perceiver_io_tpu.serving.sim import ServiceTimeModel, SimEngineFrontEnd
+
+VOCAB = 64
+
+MODEL = ServiceTimeModel(
+    prefill_p50_s=0.002, prefill_p99_s=0.004,
+    tpot_p50_s=0.0005, tpot_p99_s=0.001, source="test_synthetic",
+)
+
+
+def _specs(n, seed=13):
+    return WorkloadSpec(seed=seed, prompt_lens=(8, 12),
+                        max_new_tokens=(3, 4)).draw(n, VOCAB)
+
+
+def _fleet(n=2, *, clock=None, events=None, registry=None, config=None,
+           injector=None, journal_dir=None, max_queue=64):
+    """A fleet of ``n`` sim replicas on ONE shared ManualClock.
+
+    Breaker and admission projection are off so the tests steer admission
+    with ``max_queue`` alone; journals only where a test fails over."""
+    clock = clock if clock is not None else ManualClock()
+    router = FleetRouter(clock=clock, events=events, registry=registry,
+                        config=config, injector=injector)
+    fes = {}
+    for i in range(n):
+        rid = f"r{i}"
+        fe = SimEngineFrontEnd(
+            service_model=MODEL,
+            engine_config=EngineConfig(slots=4, page_size=8,
+                                       max_ca_tokens=24, max_sa_tokens=8),
+            clock=clock, seed=7 + i, replica_id=rid,
+            config=FrontEndConfig(max_queue=max_queue,
+                                  admission_projection=False, breaker=None),
+            events=events, registry=registry, injector=injector,
+            journal=(os.path.join(journal_dir, f"journal-{rid}.jsonl")
+                     if journal_dir else None),
+        )
+        router.add_replica(rid, fe)
+        fes[rid] = fe
+    return router, fes, clock
+
+
+# ---------------------------------------------------------------- dispatch
+
+
+def test_least_outstanding_dispatch_alternates_and_drains_clean():
+    """An idle fleet alternates submissions (least-outstanding with the
+    deterministic replica-id tie-break), the labeled ``router_dispatch``
+    children decompose the unlabeled total, and a full pump drains to
+    balanced fleet books with an empty audit."""
+    registry = MetricsRegistry()
+    router, fes, clock = _fleet(3, registry=registry)
+    recs = [router.submit(s) for s in _specs(6)]
+    with router._lock:
+        assigned = dict(router._assigned)
+    # 6 submissions over 3 idle replicas: r0 r1 r2 r0 r1 r2
+    assert [assigned[i] for i in range(6)] == ["r0", "r1", "r2"] * 2
+    assert router.books()["dispatched"] == 6
+    done = router.pump()
+    assert done == 6 and all(r.outcome == "ok" for r in recs)
+    books = router.books()
+    assert books["balanced"] and books["outcomes"]["ok"] == 6, books
+    assert books["requeued"] == 0 and books["failovers"] == 0
+    assert router.audit() == []
+    # metrics: per-replica children sum to the family total
+    disp = registry.counter("router_dispatch_total")
+    assert disp.value == 6
+    assert sum(disp.labels(replica=r).value for r in fes) == 6
+    text = registry.to_prometheus()
+    assert 'router_dispatch_total{replica="r0"}' in text
+    assert "router_replicas_active" in text
+
+
+def test_redispatch_bounded_to_admission_sheds():
+    """An admission shed (queue full, ZERO tokens served) is retried on the
+    other replica — counted in ``requeued`` — and when every replica sheds,
+    the LAST verdict comes back instead of an unbounded spin. The fleet
+    books stay balanced with every dispatch accounted."""
+    router, fes, clock = _fleet(2, max_queue=2)
+    specs = _specs(6)
+    for s in specs[:4]:
+        router.submit(s)  # fills both 2-deep queues, nothing stepped yet
+    assert all(router._outstanding(fe) == 2 for fe in fes.values())
+    rec = router.submit(specs[4])  # shed on r0, re-dispatched, shed on r1
+    assert rec.outcome == "shed"
+    books = router.books()
+    assert books["requeued"] == 1 and books["dispatched"] == 6, books
+    assert books["outcomes"]["shed"] == 2  # one verdict per replica tried
+    assert books["balanced"], books
+    router.pump()
+    books = router.books()
+    assert books["outcomes"]["ok"] == 4 and books["balanced"], books
+    assert router.audit() == []
+
+
+def test_submit_with_no_dispatchable_replica_raises():
+    router, fes, clock = _fleet(1)
+    router.drain_replica("r0")  # idle: drains immediately
+    with router._lock:
+        assert router._replicas["r0"].state == "drained"
+    with pytest.raises(RuntimeError, match="no dispatchable replica"):
+        router.submit(_specs(1)[0])
+    # and a duplicate join is refused loudly
+    with pytest.raises(ValueError, match="already in the fleet"):
+        router.add_replica("r0", fes["r0"])
+
+
+# ------------------------------------------------------------- drain / join
+
+
+def test_drain_sheds_nothing_and_routes_around(tmp_path):
+    """The SIGTERM path: draining a replica stops NEW dispatch immediately
+    while the drive loop finishes its outstanding work — zero sheds, the
+    late arrivals all land on the survivor, and the drained replica's
+    lifecycle reads join -> drain -> drained on the event stream."""
+    events = EventLog(str(tmp_path), main_process=True)
+    router, fes, clock = _fleet(2, events=events)
+    specs = _specs(6)
+    for s in specs[:4]:
+        router.submit(s)
+    router.step()
+    assert router._outstanding(fes["r0"]) >= 1  # still owes work
+    r0_submitted = fes["r0"].books()["submitted"]
+    router.drain_replica("r0")
+    late = [router.submit(s) for s in specs[4:]]
+    router.pump()
+    books = router.books()
+    assert books["outcomes"]["shed"] == 0 and books["outcomes"]["ok"] == 6, books
+    assert books["balanced"], books
+    with router._lock:
+        assert router._replicas["r0"].state == "drained"
+        for s in specs[4:]:
+            assert router._assigned[s.index] == "r1"
+    assert fes["r0"].books()["submitted"] == r0_submitted  # no post-drain dispatch
+    assert all(r.outcome == "ok" for r in late)
+    transitions = [e["transition"] for e in merged_events(str(tmp_path))
+                   if e.get("event") == "serve.replica"
+                   and e.get("replica_id") == "r0"]
+    assert transitions == ["join", "drain", "drained"]
+    assert router.audit() == []
+
+
+# ----------------------------------------------------------------- failover
+
+
+def test_failover_replays_journal_onto_survivor(tmp_path):
+    """An injected replica kill mid-drive: the dead replica's journal
+    replays onto the survivor (handoff mode — the dead ledger closes with
+    handoff markers, pending drops to zero), every orphan re-lands exactly
+    once, the span-attributed ``serve.failover`` row carries the replay
+    accounting, and a second failover of the same replica is a no-op."""
+    events = EventLog(str(tmp_path), main_process=True)
+    injector = FaultInjector().kill_replica_at("r0", 2)
+    router, fes, clock = _fleet(2, events=events, injector=injector,
+                                journal_dir=str(tmp_path))
+    specs = _specs(6)
+    recs = router.run_closed(specs, concurrency=6)
+    assert len(recs) == 6
+    # NOTE: an orphaned request's ORIGINAL record froze with the dead
+    # replica — its terminal outcome lives on the survivor's replay
+    # record, which is why the assertions below read the fleet books
+    books = router.books()
+    assert books["failovers"] == 1 and books["balanced"], books
+    assert books["orphaned"] >= 1
+    assert books["orphaned"] == books["readmitted"] + books["readmit_skipped"]
+    assert books["outcomes"]["ok"] == 6 and books["outcomes"]["shed"] == 0
+    with router._lock:
+        assert router._replicas["r0"].state == "dead"
+        assert router._replicas["r1"].state == "active"
+        # every index the dead replica owned re-points at the survivor
+        assert set(router._assigned.values()) == {"r1"}
+    # the dead ledger closed by handoff: nothing pends, books balance
+    dead_j = RequestJournal(os.path.join(str(tmp_path), "journal-r0.jsonl"))
+    jb = dead_j.books()
+    assert jb["balanced"] and jb["pending"] == 0, jb
+    assert jb.get("handed_off", 0) >= 1, jb
+    assert dead_j.pending() == [] and dead_j.audit() == []
+    rows = [e for e in merged_events(str(tmp_path))
+            if e.get("event") == "serve.failover"]
+    assert len(rows) == 1
+    row = rows[0]
+    assert row["dead_replica"] == "r0" and row["survivor"] == "r1"
+    assert row["n_replayed"] == books["readmitted"]
+    assert row.get("span_id"), "failover row lost its span attribution"
+    assert validate_events(str(tmp_path), strict_spans=False) == []
+    # idempotence at the fleet level: the replica is already dead
+    assert router.failover("r0") is None
+    assert router.books()["failovers"] == 1
+    assert router.audit() == []
+
+
+def test_heartbeat_timeout_declares_death_and_fails_over(tmp_path):
+    """A stale heartbeat on the injected clock first EXCLUDES the replica
+    from dispatch, then ``check_replicas`` declares it dead (reason
+    ``heartbeat_timeout``) and replays its journal onto the fresh
+    survivor — the fleet finishes every accepted request."""
+    events = EventLog(str(tmp_path), main_process=True)
+    router, fes, clock = _fleet(
+        2, events=events, journal_dir=str(tmp_path),
+        config=FleetConfig(heartbeat_timeout_s=1.0),
+    )
+    specs = _specs(5)
+    for s in specs[:4]:
+        router.submit(s)  # alternates: r0 owns 2, r1 owns 2
+    assert router._outstanding(fes["r0"]) == 2
+    clock.advance(2.0)  # both heartbeats stale now
+    router.heartbeat("r1")  # an external prober keeps r1 fresh
+    rec = router.submit(specs[4])  # r0 is stale: excluded from dispatch
+    with router._lock:
+        assert router._assigned[specs[4].index] == "r1"
+    assert router.check_replicas() == ["r0"]
+    books = router.books()
+    assert books["failovers"] == 1 and books["readmitted"] == 2, books
+    router.pump()
+    books = router.books()
+    assert books["balanced"] and books["outcomes"]["ok"] == 5, books
+    assert books["outcomes"]["shed"] == 0
+    dead_rows = [e for e in merged_events(str(tmp_path))
+                 if e.get("event") == "serve.replica"
+                 and e.get("transition") == "dead"]
+    assert len(dead_rows) == 1 and dead_rows[0]["replica_id"] == "r0"
+    assert dead_rows[0]["reason"] == "heartbeat_timeout"
+    assert rec.outcome == "ok"
+    assert router.audit() == []
+
+
+# ----------------------------------------------------------------- brownout
+
+
+def test_brownout_degrades_then_restores(tmp_path):
+    """A browned-out replica (injected latency factor) crosses the EWMA
+    threshold and flips ``degraded`` — dispatch sorts it last even when it
+    is the least loaded — and clearing the brownout decays the EWMA back
+    under the threshold, flipping it ``restored``. Both flips land on the
+    event stream naming the replica."""
+    events = EventLog(str(tmp_path), main_process=True)
+    injector = FaultInjector().brownout_replica("r1", 10.0)
+    router, fes, clock = _fleet(
+        2, events=events, injector=injector,
+        config=FleetConfig(brownout_factor=3.0),
+    )
+    specs = _specs(40, seed=5)
+    pending = list(specs)
+
+    def top_up():
+        # keep BOTH replicas busy so each drive step updates both EWMAs
+        for rid, fe in fes.items():
+            while pending and router._outstanding(fe) < 2:
+                rec = pending.pop(0)
+                fe.submit(rec)  # direct: pin EWMA behavior, not routing
+                with router._lock:
+                    router._dispatched += 1
+                    router._assigned[int(rec.index)] = rid
+
+    def degraded(rid):
+        with router._lock:
+            return router._replicas[rid].degraded
+
+    for _ in range(200):
+        top_up()
+        router.step()
+        if degraded("r1"):
+            break
+    assert degraded("r1") and not degraded("r0")
+    # degraded sorts LAST: r1 idle-er than r0 still loses the pick
+    while router._outstanding(fes["r1"]) > 0 and pending:
+        top_up()
+        router.step()
+    assert router._pick().replica_id == "r0"
+    injector.clear_brownout("r1")
+    for _ in range(200):
+        top_up()
+        router.step()
+        if not degraded("r1"):
+            break
+    assert not degraded("r1")
+    router.pump()
+    books = router.books()
+    assert books["balanced"] and books["outcomes"]["shed"] == 0, books
+    flips = [(e["replica_id"], e["transition"])
+             for e in merged_events(str(tmp_path))
+             if e.get("event") == "serve.replica"
+             and e.get("transition") in ("degraded", "restored")]
+    assert ("r1", "degraded") in flips and ("r1", "restored") in flips
+    assert all(rid == "r1" for rid, _ in flips)
+    assert router.audit() == []
+
+
+# -------------------------------------------------------- health and books
+
+
+def test_health_and_books_shapes():
+    """The scrape surfaces: ``health()`` is the /healthz provider (fleet
+    status over per-replica rows, each embedding the replica's own engine
+    health), ``books()`` is the fleet accounting identity — both read
+    clean on a fresh fleet and stay coherent across a drain."""
+    router, fes, clock = _fleet(2)
+    h = router.health()
+    assert h["status"] == "ok"
+    assert h["n_replicas"] == 2 and h["n_dispatchable"] == 2
+    assert h["dispatched"] == 0 and h["failovers"] == 0
+    for rid in ("r0", "r1"):
+        row = h["replicas"][rid]
+        assert row["state"] == "active" and row["dispatchable"]
+        assert row["degraded"] is False and row["outstanding"] == 0
+        assert row["heartbeat_age_s"] is not None
+        assert isinstance(row["engine"], dict) and "status" in row["engine"]
+    books = router.books()
+    assert books["balanced"]
+    assert set(books) == {
+        "submitted", "terminal", "live", "orphaned", "dispatched",
+        "requeued", "failovers", "readmitted", "readmit_skipped",
+        "outcomes", "replicas", "balanced",
+    }
+    for s in _specs(2):
+        router.submit(s)
+    router.drain_replica("r1")
+    h = router.health()
+    assert h["status"] == "ok"  # r0 still dispatchable
+    assert h["n_dispatchable"] == 1
+    router.pump()
+    h = router.health()
+    assert h["replicas"]["r1"]["state"] == "drained"
+    assert router.books()["balanced"] and router.audit() == []

@@ -343,6 +343,64 @@ def test_recovery_rebuilds_refcounts(model_and_params, tmp_path):
         assert served.get(spec.index) == want, spec.index
 
 
+def test_engine_cow_fork_seam_protects_co_owner(model_and_params):
+    """The engine end of the COW contract (``pages.cow_fork`` leaves the
+    device copy to its caller — Fleetline satellite, ISSUE 20): a write
+    into a SHARED append page goes through ``_fork_shared_append_page``,
+    which forks the grant AND duplicates the page's pool rows into the
+    fresh page — the co-owner's resident bytes survive untouched, the
+    appender owns a bit-identical private copy, and the allocator books
+    stay clean. An unshared append page passes through untouched (today's
+    whole-page sharing cap makes that every production append); a dry pool
+    answers ``None`` with the grant and the device pool unchanged (the
+    caller sheds exactly like a failed allocation)."""
+    model, params = model_and_params
+    fe = _engine(model, params)
+    a = fe.ca_alloc
+    g1 = a.alloc_tokens(16)                    # publisher: 2 pages
+    g2 = a.alloc_tokens_shared(24, g1.pages)   # shares both + 1 fresh tail
+    tail = g2.pages[1]
+    assert a.refcount(tail) == 2
+
+    # plant sentinel rows in the shared page so the device copy is visible
+    pool = fe._state["cache"][0]
+    marker_k = jnp.full(pool.k.shape[1:], 7.0, pool.k.dtype)
+    marker_v = jnp.full(pool.v.shape[1:], -3.0, pool.v.dtype)
+    caches = list(fe._state["cache"])
+    caches[0] = pool.replace(k=pool.k.at[tail].set(marker_k),
+                             v=pool.v.at[tail].set(marker_v))
+    fe._state = dict(fe._state, cache=tuple(caches))
+
+    forked = fe._fork_shared_append_page(g2, 12)  # position in page slot 1
+    assert forked is not None and forked.grant_id == g2.grant_id
+    fresh = forked.pages[1]
+    assert fresh != tail and forked.pages[0] == g2.pages[0]
+    assert forked.shared_pages == (g2.pages[0],)
+    pool = fe._state["cache"][0]
+    assert np.array_equal(np.asarray(pool.k[fresh]), np.asarray(marker_k))
+    assert np.array_equal(np.asarray(pool.v[fresh]), np.asarray(marker_v))
+    assert np.array_equal(np.asarray(pool.k[tail]), np.asarray(marker_k))
+    assert a.refcount(tail) == 1 and a.holders(tail) == [g1.grant_id]
+    assert a.refcount(fresh) == 1
+    assert a.audit() == []
+
+    # unshared append page: identity passthrough, no fork, no copy
+    assert fe._fork_shared_append_page(forked, 20) is forked
+
+    # pool dry: None, nothing torn on host or device (slot 0 still shared)
+    hog = a.alloc_tokens(a.pages_free * 8)
+    assert hog is not None and a.pages_free == 0
+    k_before = np.asarray(fe._state["cache"][0].k)
+    assert fe._fork_shared_append_page(forked, 4) is None
+    assert np.array_equal(np.asarray(fe._state["cache"][0].k), k_before)
+    assert a.audit() == []
+
+    a.free(hog)
+    a.free(forked)
+    a.free(g1)
+    assert a.pages_used == 0 and a._rc == {}
+
+
 # -------------------------------------------------- decode_shared pin
 
 

@@ -101,6 +101,32 @@ exactly one terminal outcome, zero leaked worker slots):
                               ``serve.recover`` events name each
                               re-admission.
 
+Fleetline scenarios (serving/router.py — N engine replicas behind one
+``FleetRouter`` submit surface, docs/serving.md#fleet):
+
+- ``serve_fleet_failover`` — a REPLICA dies mid-decode (an injected
+                              ``EngineCrash`` at a replica-step
+                              coordinate): the router replays its
+                              write-ahead journal onto the survivor,
+                              which finishes every journaled request
+                              token-exactly; the FLEET books balance
+                              across the handoff (every index exactly
+                              one terminal outcome, zero double-served
+                              tokens), the dead journal closes with
+                              handoff markers, and exactly one flight
+                              dump names the dead replica.
+- ``serve_fleet_brownout``  — one replica browns out (injected service-
+                              time inflation): the EWMA health check
+                              flips it ``degraded`` and least-outstanding
+                              dispatch drains traffic onto the healthy
+                              replica while the slow one STAYS in the
+                              fleet — no failover, books balanced.
+- ``serve_fleet_drain``     — a mid-run graceful drain: dispatch to the
+                              draining replica stops (post-drain
+                              submissions land only on the survivor),
+                              its outstanding work finishes, and ZERO
+                              sheds are attributable to the drain.
+
 Simline scenarios (serving/sim.py — the REAL engine control plane under a
 ManualClock with sampled service times; no jax, no model,
 docs/serving.md#multi-tenant-telemetry):
@@ -119,6 +145,14 @@ docs/serving.md#multi-tenant-telemetry):
                               tenant's planted TTFT bound trips flight
                               dumps naming ONLY its rows while the bulk
                               tenant's generous bound never fires.
+- ``sim_fleet``             — Fleetline scale certification: the SAME
+                              10k-req/s merged workload through 1 then 2
+                              replicas on the discrete-event fleet loop
+                              (per-replica clocks, causal next-event
+                              drive); 2 replicas must deliver >= 1.7x
+                              the token throughput with the committed
+                              ``sim_fairness_jain``/``sim_starvation_age_s``
+                              floors held on BOTH runs.
 
 ``--scenarios`` accepts fnmatch globs: ``--scenarios 'serve_*'`` runs the
 serving family standalone, ``--scenarios 'elastic_*,preempt'`` composes.
@@ -247,14 +281,14 @@ def _events(run_dir, kind):
 
 
 def _assert_span_attributed(run_dir):
-    """Spanline contract (ISSUE 8, extended by Evictline and Shareline):
-    every fault.*/resume — and every per-request preemption or sharing
-    event (``serve.evict``/``serve.resume``/``serve.recover``/
-    ``serve.prefix_hit``) — in a chaos run must carry a span_id whose span
-    row is in the same stream: an incident nobody can attribute to its
-    step/request is an incident half-logged. Accepts both layouts
-    (training runs log under ``logs/``, serving scenarios at the run dir
-    root)."""
+    """Spanline contract (ISSUE 8, extended by Evictline, Shareline and
+    Fleetline): every fault.*/resume — and every per-request preemption,
+    sharing or fleet-handoff event (``serve.evict``/``serve.resume``/
+    ``serve.recover``/``serve.prefix_hit``/``serve.failover``) — in a
+    chaos run must carry a span_id whose span row is in the same stream:
+    an incident nobody can attribute to its step/request is an incident
+    half-logged. Accepts both layouts (training runs log under ``logs/``,
+    serving scenarios at the run dir root)."""
     path = os.path.join(run_dir, "logs", "events.jsonl")
     if not os.path.exists(path):
         path = os.path.join(run_dir, "events.jsonl")
@@ -266,7 +300,7 @@ def _assert_span_attributed(run_dir):
         if r.get("event", "").startswith("fault.")
         or r.get("event") in ("resume", "resume.reshard", "probe.blast",
                               "serve.evict", "serve.resume", "serve.recover",
-                              "serve.prefix_hit")
+                              "serve.prefix_hit", "serve.failover")
     ]
     for r in audited:
         assert r.get("span_id") in span_ids, (
@@ -1400,6 +1434,255 @@ def scenario_serve_crash_recover(tmp):
 
 
 # ---------------------------------------------------------------------------
+# Fleetline scenarios: N engine replicas behind one FleetRouter submit
+# surface (serving/router.py; docs/serving.md#fleet) — replica death,
+# brownout and graceful drain, wall-clock-free on the injected clock
+# ---------------------------------------------------------------------------
+
+
+def _audit_fleet(router, run_dir, tag, expect_drained=True):
+    """The fleet analog of ``_audit_serving``: the fleet books identity
+    closes (``Σ submitted == dispatched + re-admissions``, every orphan
+    re-homed exactly once), every live replica's own audit is empty, every
+    dead replica's journal is handoff-closed, and the event stream
+    validates with NO problems and NO forward-compat warnings."""
+    from perceiver_io_tpu.obs.events import validate_events
+
+    problems = router.audit(expect_drained=expect_drained)
+    assert not problems, f"{tag}: fleet audit failed: {problems}"
+    warnings_out = []
+    stream_problems = validate_events(run_dir, warnings_out=warnings_out)
+    assert not stream_problems, f"{tag}: event stream invalid: {stream_problems}"
+    assert not warnings_out, f"{tag}: unexpected schema warnings: {warnings_out}"
+    return router.books()
+
+
+def scenario_serve_fleet_failover(tmp):
+    """Fleetline failover: TWO real engines behind the router; an injected
+    replica kill (``EngineCrash`` at a replica-step coordinate — the
+    SIGKILL analog, no accounting seam catches it) lands MID-DECODE on
+    r0. The router must declare r0 dead, replay its write-ahead journal
+    onto r1 through the recover handoff seam, and the survivor must
+    finish every journaled request TOKEN-EXACTLY vs the uninterrupted
+    sequential reference. The fleet books balance across the handoff —
+    every submitted index reaches exactly one terminal outcome fleet-wide,
+    the orphan count equals the re-admissions (zero double-served
+    tokens), the dead journal closes with handoff markers — and exactly
+    one flight dump (trigger ``failover``) names the dead replica."""
+    from perceiver_io_tpu.serving import (
+        EngineConfig,
+        EngineFrontEnd,
+        FaultInjector,
+    )
+    from perceiver_io_tpu.serving.router import FleetRouter
+
+    model, params = _serving_model()
+    n = 4 if SMOKE else 6
+    for tag, base in _evict_gen_configs():
+        recorder, clock, run_dir = _serve_env(tmp, f"serve_fleet_failover_{tag}")
+        injector = FaultInjector(clock=clock).kill_replica_at("r0", 2)
+        router = FleetRouter(clock=clock, events=recorder, injector=injector)
+        engine_cfg = EngineConfig(slots=4, page_size=8, max_ca_tokens=16,
+                                  max_sa_tokens=8)
+        fes = {}
+        for rid in ("r0", "r1"):
+            fes[rid] = EngineFrontEnd(
+                model, params, num_latents=4, base_config=base,
+                engine_config=engine_cfg, events=recorder, clock=clock,
+                sleep=clock.sleep,
+                journal=os.path.join(run_dir, f"journal-{rid}.jsonl"),
+            )
+            router.add_replica(rid, fes[rid])
+        specs = _evict_workload(n)
+        router.run_closed(specs, concurrency=n)
+        books = _audit_fleet(router, run_dir, f"serve_fleet_failover_{tag}")
+        # the kill was real and the fleet absorbed it: one failover, the
+        # dead replica's frozen work re-homed exactly once, all served
+        assert books["failovers"] == 1, books
+        assert books["orphaned"] >= 1, (
+            f"r0 died owing nothing — the failover is vacuous: {books}"
+        )
+        assert books["orphaned"] == books["readmitted"], books
+        assert books["outcomes"]["ok"] == n and books["outcomes"]["shed"] == 0, books
+        assert router._replicas["r0"].state == "dead"
+        assert router._replicas["r1"].state == "active"
+        # mid-decode proof: at least one request crossed the handoff with
+        # tokens already served (parked on the survivor, resumed there)
+        fo_rows = [e for e in _stream(run_dir) if e.get("event") == "serve.failover"]
+        assert len(fo_rows) == 1, fo_rows
+        fo = fo_rows[0]
+        assert fo["dead_replica"] == "r0" and fo["survivor"] == "r1", fo
+        assert fo["n_replayed"] == books["readmitted"], (fo, books)
+        assert fo["n_parked"] >= 1, (
+            f"no request crossed the handoff MID-decode: {fo} — "
+            "the token-exact replay claim is vacuous"
+        )
+        # the dead journal is CLOSED by handoff markers: nothing pending,
+        # every non-terminal entry explicitly handed to the survivor
+        jb = fes["r0"].journal.books()
+        assert jb["balanced"] and jb["handed_off"] >= 1, jb
+        assert len(fes["r0"].journal.pending()) == 0, jb
+        assert fes["r0"].journal.audit() == [], fes["r0"].journal.audit()
+        # token-exact ACROSS the handoff: merged served streams (survivor
+        # wins for handed-off indices) equal the uninterrupted reference
+        served = dict(fes["r0"].served_tokens)
+        served.update(fes["r1"].served_tokens)
+        for spec in specs:
+            want = _sequential_reference(model, params, spec, base)
+            got = served.get(spec.index)
+            assert got == want, (
+                f"serve_fleet_failover[{tag}] request {spec.index}: "
+                f"fleet {got} != sequential {want}"
+            )
+        # exactly one flight dump, and it names the dead replica
+        dumps = sorted(
+            f for f in os.listdir(run_dir) if f.startswith("flight-failover-")
+        )
+        assert len(dumps) == 1, dumps
+        with open(os.path.join(run_dir, dumps[0])) as f:
+            payload = json.load(f)
+        assert payload["trigger"] == "failover", payload["trigger"]
+        assert payload["trigger_event"]["dead_replica"] == "r0", payload
+        n_attr = _assert_span_attributed(run_dir)
+        # the survivor's pages came back exact after the storm
+        assert fes["r1"].ca_alloc.pages_used == 0 and fes["r1"].sa_alloc.pages_used == 0
+        assert fes["r1"].ca_alloc.audit() == [] and fes["r1"].sa_alloc.audit() == []
+        print(
+            f"chaos: serve_fleet_failover[{tag}] ok — r0 killed mid-decode "
+            f"owing {books['orphaned']} ({fo['n_parked']} mid-stream), r1 "
+            f"replayed all {fo['n_replayed']} from the journal, fleet books "
+            f"balanced across the handoff ({n}/{n} ok), streams token-exact, "
+            f"1 flight dump, {n_attr} events span-attributed"
+        )
+
+
+def scenario_serve_fleet_brownout(tmp):
+    """Fleetline brownout: replica r1's service times are inflated 5x by
+    the injector (a slow host, not a dead one). The router's per-step
+    EWMA health check must flip r1 ``degraded`` (a ``serve.replica``
+    transition row) and least-outstanding dispatch must drain traffic
+    onto the healthy r0 — while r1 STAYS in the fleet (no failover, its
+    in-flight work finishes). Books balance at full scale."""
+    from perceiver_io_tpu.serving import EngineConfig, FaultInjector, FrontEndConfig
+    from perceiver_io_tpu.serving.sim import TenantSpec, run_fleet_sim
+
+    window = 0.04 if SMOKE else 0.08
+    tenants = [
+        TenantSpec("burst", rate_rps=5000.0, n_requests=int(5000 * window), seed=11),
+        TenantSpec("steady", rate_rps=3500.0, n_requests=int(3500 * window), seed=22),
+    ]
+    recorder, _clock, run_dir = _serve_env(tmp, "serve_fleet_brownout")
+    injector = FaultInjector().brownout_replica("r1", 5.0)
+    report = run_fleet_sim(
+        tenants, n_replicas=2, service_model=_sim_service_model(),
+        engine_config=EngineConfig(slots=16, page_size=8, max_ca_tokens=32,
+                                   max_sa_tokens=16),
+        # queue deep enough that ROUTING PREFERENCE decides placement:
+        # a saturated healthy replica would shed and re-dispatch overflow
+        # onto the slow one, muddying the drain signal
+        config=FrontEndConfig(max_queue=1024, admission_projection=False,
+                              breaker=None),
+        events=recorder, injector=injector,
+    )
+    s = report.summary
+    books = _audit_fleet(report.router, run_dir, "serve_fleet_brownout")
+    assert s["books_balanced"] and s["failovers"] == 0, (s, books)
+    assert books["outcomes"]["shed"] == 0, (
+        f"queue overflow contaminated the routing signal: {books['outcomes']}"
+    )
+    # the health check SAW the brownout: r1 degraded, r0 clean
+    assert s["replicas"]["r1"]["degraded"] is True, s["replicas"]
+    assert s["replicas"]["r0"]["degraded"] is False, s["replicas"]
+    # ...and dispatch ACTED on it: traffic drained onto the healthy
+    # replica (the browned-out one still served its early admissions)
+    r0_sub = s["replicas"]["r0"]["submitted"]
+    r1_sub = s["replicas"]["r1"]["submitted"]
+    assert r0_sub >= 3 * max(r1_sub, 1), (
+        f"brownout did not drain traffic: r0 {r0_sub} vs r1 {r1_sub}"
+    )
+    assert s["replicas"]["r1"]["state"] == "active", s["replicas"]
+    assert s["replicas"]["r1"]["submitted"] >= 1, (
+        f"r1 never dispatched — the drain claim is vacuous: {s['replicas']}"
+    )
+    # the flip is a first-class transition row naming the slow replica
+    degraded_rows = [
+        e for e in _stream(run_dir)
+        if e.get("event") == "serve.replica" and e.get("transition") == "degraded"
+    ]
+    assert degraded_rows and all(
+        e["replica_id"] == "r1" for e in degraded_rows
+    ), degraded_rows
+    print(
+        f"chaos: serve_fleet_brownout ok — r1 browned out 5x and flipped "
+        f"degraded, dispatch drained onto r0 ({r0_sub} vs {r1_sub} submitted), "
+        f"no failover, {s['n_requests']} requests booked balanced"
+    )
+
+
+def scenario_serve_fleet_drain(tmp):
+    """Fleetline graceful drain: r0 is drained MID-RUN with work in
+    flight. Dispatch to it must stop immediately (every post-drain
+    submission lands on r1), its outstanding work must finish (state
+    ``drained``, not a shed in sight), and the fleet books must close
+    with ZERO sheds attributable to the drain — because the replica's own
+    ``drain()`` gate is never raised while it still owes tokens."""
+    from perceiver_io_tpu.serving import EngineConfig, EngineFrontEnd
+    from perceiver_io_tpu.serving.router import FleetRouter
+
+    model, params = _serving_model()
+    n = 4 if SMOKE else 6
+    tag, base = _evict_gen_configs()[0]  # greedy: the drain certifies routing
+    recorder, clock, run_dir = _serve_env(tmp, "serve_fleet_drain")
+    router = FleetRouter(clock=clock, events=recorder)
+    engine_cfg = EngineConfig(slots=4, page_size=8, max_ca_tokens=16,
+                              max_sa_tokens=8)
+    fes = {}
+    for rid in ("r0", "r1"):
+        fes[rid] = EngineFrontEnd(
+            model, params, num_latents=4, base_config=base,
+            engine_config=engine_cfg, events=recorder, clock=clock,
+            sleep=clock.sleep,
+        )
+        router.add_replica(rid, fes[rid])
+    specs = _evict_workload(n + 2)
+    for spec in specs[:n]:
+        router.submit(spec)
+    router.step()  # both replicas now mid-decode
+    assert router._outstanding(fes["r0"]) >= 1, (
+        "r0 idle at drain time — the mid-run claim is vacuous"
+    )
+    r0_submitted_at_drain = fes["r0"].books()["submitted"]
+    router.drain_replica("r0")
+    late = [router.submit(spec) for spec in specs[n:]]
+    router.pump()
+    books = _audit_fleet(router, run_dir, "serve_fleet_drain")
+    # zero sheds attributable to the drain — or to anything else
+    assert books["outcomes"]["shed"] == 0, books
+    assert books["outcomes"]["ok"] == n + 2, books
+    assert router._replicas["r0"].state == "drained"
+    # dispatch stopped AT the drain: r0 took nothing after it...
+    assert fes["r0"].books()["submitted"] == r0_submitted_at_drain, (
+        fes["r0"].books(), r0_submitted_at_drain
+    )
+    # ...and every late submission landed on the survivor, served ok
+    assert all(router._assigned[r.index] == "r1" for r in late), router._assigned
+    assert all(r.outcome == "ok" for r in late), [vars(r) for r in late]
+    # the drain lifecycle is first-class in the stream: drain -> drained
+    transitions = [
+        e["transition"] for e in _stream(run_dir)
+        if e.get("event") == "serve.replica" and e.get("replica_id") == "r0"
+    ]
+    assert transitions == ["join", "drain", "drained"], transitions
+    assert fes["r0"].ca_alloc.pages_used == 0 and fes["r1"].ca_alloc.pages_used == 0
+    print(
+        f"chaos: serve_fleet_drain ok — r0 drained mid-run with "
+        f"{r0_submitted_at_drain} in its books, finished them all, "
+        f"{len(late)} post-drain submissions routed to r1, "
+        f"{n + 2}/{n + 2} ok with zero sheds"
+    )
+
+
+# ---------------------------------------------------------------------------
 # Simline scenarios: multi-tenant pressure at simulated scale — the real
 # engine control plane under a ManualClock with sampled service times
 # (serving/sim.py; docs/serving.md#multi-tenant-telemetry). No jax, no
@@ -1659,6 +1942,73 @@ def scenario_sim_prefix_skew(tmp):
     )
 
 
+def scenario_sim_fleet(tmp):
+    """Fleetline scale certification: the SAME merged workload — 10k
+    offered req/s across three tenants — through 1 then 2 replicas on
+    the discrete-event fleet loop (per-replica ManualClocks, causal
+    next-event drive, fleet duration = the latest replica timeline). Two
+    replicas must deliver >= 1.7x the single-replica token throughput
+    (the replication claim: near-linear scaling, honestly measured on
+    independent timelines), and BOTH runs must hold the committed
+    ``sim_fairness_jain`` (>= 0.9) and ``sim_starvation_age_s`` (<= 1.0)
+    floors with fleet books balanced — scale that costs fairness or
+    starves a tenant is not scale the ledger accepts."""
+    from perceiver_io_tpu.serving import EngineConfig, FrontEndConfig
+    from perceiver_io_tpu.serving.sim import TenantSpec, run_fleet_sim
+
+    window = 0.06 if SMOKE else 0.12
+    def _tenants():
+        return [
+            TenantSpec("burst", rate_rps=5000.0,
+                       n_requests=int(5000 * window), seed=11),
+            TenantSpec("steady", rate_rps=3500.0,
+                       n_requests=int(3500 * window), seed=22),
+            TenantSpec("trickle", rate_rps=1500.0,
+                       n_requests=int(1500 * window), seed=33),
+        ]
+
+    engine_cfg = EngineConfig(slots=16, page_size=8, max_ca_tokens=32,
+                              max_sa_tokens=16)
+    fe_cfg = FrontEndConfig(max_queue=256, admission_projection=False,
+                            breaker=None)
+    summaries = {}
+    for n_replicas in (1, 2):
+        recorder, _clock, run_dir = _serve_env(tmp, f"sim_fleet_{n_replicas}")
+        report = run_fleet_sim(
+            _tenants(), n_replicas=n_replicas,
+            service_model=_sim_service_model(), engine_config=engine_cfg,
+            config=fe_cfg, events=recorder,
+        )
+        s = report.summary
+        _audit_fleet(report.router, run_dir, f"sim_fleet_{n_replicas}")
+        assert s["books_balanced"], s["books"]
+        assert s["offered_rps"] >= 10000.0, s["offered_rps"]
+        # the committed sim floors hold at EVERY fleet size
+        assert s["fairness_jain"] >= 0.9, (
+            f"sim_fleet[{n_replicas}]: fairness {s['fairness_jain']} "
+            f"under the committed floor: {s['tenants']}"
+        )
+        assert s["max_starvation_age_s"] <= 1.0, (
+            f"sim_fleet[{n_replicas}]: starvation "
+            f"{s['max_starvation_age_s']}s over the committed ceiling"
+        )
+        summaries[n_replicas] = s
+    ratio = summaries[2]["throughput_tok_s"] / summaries[1]["throughput_tok_s"]
+    assert ratio >= 1.7, (
+        f"2 replicas scaled only {ratio:.3f}x "
+        f"({summaries[1]['throughput_tok_s']} -> "
+        f"{summaries[2]['throughput_tok_s']} tok/s) — under the 1.7x bar"
+    )
+    print(
+        f"chaos: sim_fleet ok — {summaries[2]['n_requests']} requests at "
+        f"{summaries[2]['offered_rps']:.0f} offered rps, "
+        f"{summaries[1]['throughput_tok_s']:.1f} -> "
+        f"{summaries[2]['throughput_tok_s']:.1f} tok/s ({ratio:.2f}x >= 1.7x), "
+        f"fairness {summaries[2]['fairness_jain']} / starvation "
+        f"{summaries[2]['max_starvation_age_s']}s floors held at both sizes"
+    )
+
+
 SCENARIOS = {
     "preempt": scenario_preempt,
     "preempt_mesh": scenario_preempt_mesh,
@@ -1681,9 +2031,13 @@ SCENARIOS = {
     "serve_evict_storm": scenario_serve_evict_storm,
     "serve_prefix_storm": scenario_serve_prefix_storm,
     "serve_crash_recover": scenario_serve_crash_recover,
+    "serve_fleet_failover": scenario_serve_fleet_failover,
+    "serve_fleet_brownout": scenario_serve_fleet_brownout,
+    "serve_fleet_drain": scenario_serve_fleet_drain,
     "sim_tenant_storm": scenario_sim_tenant_storm,
     "sim_noisy_neighbor": scenario_sim_noisy_neighbor,
     "sim_prefix_skew": scenario_sim_prefix_skew,
+    "sim_fleet": scenario_sim_fleet,
 }
 
 
